@@ -1,0 +1,25 @@
+"""production_stack_tpu — a TPU-native LLM serving stack.
+
+A from-scratch reimplementation of the capabilities of
+vllm-project/production-stack, designed TPU-first:
+
+- ``engine/``   JAX/XLA/Pallas inference engine (the reference delegates this
+                layer to vLLM; here it is first-class): paged KV cache in HBM,
+                ragged paged attention kernels, continuous-batching scheduler,
+                OpenAI-compatible server speaking the same ``/metrics``
+                contract the reference router scrapes
+                (reference: src/vllm_router/stats/engine_stats.py:63-76).
+- ``models/``   Model families (Llama, Mixtral MoE, ...) as functional JAX
+                with stacked-layer ``lax.scan`` and mesh-sharded parameters.
+- ``ops/``      TPU kernels: ragged paged attention (Pallas + XLA reference),
+                RoPE, norms, sampling.
+- ``parallel/`` Device-mesh construction and PartitionSpec rules for
+                tp/dp/pp/sp/ep over ICI (reference parallelism inventory:
+                SURVEY.md §2.9).
+- ``router/``   The L7 data plane: OpenAI-compatible request router with
+                round-robin / session / prefix-aware / KV-aware /
+                disaggregated-prefill routing (reference:
+                src/vllm_router/routers/routing_logic.py).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,146 @@
+"""Weight materialisation: random-init or HF safetensors → sharded pytree.
+
+Model-weight delivery in the reference is PVC/NFS + an HF-downloader sidecar
+(SURVEY.md §5.4; scripts/huggingface_downloader.py in the reference). Here the
+engine loads safetensors straight from a local path (the chart mounts the same
+PVC) and shards each tensor onto the mesh as it is loaded, so a 70B never
+materialises unsharded on one host.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models.registry import get_model
+from production_stack_tpu.parallel.shardings import (
+    ShardingRules,
+    logical_to_sharding,
+    rules_for_model,
+)
+
+
+def init_or_load(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    seed: int = 0,
+) -> dict:
+    rules = rules or rules_for_model(cfg, mesh)
+    if cfg.weights_path and glob.glob(os.path.join(cfg.weights_path, "*.safetensors")):
+        return load_safetensors(cfg, mesh, rules)
+    return init_random(cfg, mesh, rules, seed)
+
+
+def init_random(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules, seed: int) -> dict:
+    model = get_model(cfg)
+    specs = model.param_specs(cfg)
+    out_shardings = jax.tree_util.tree_map(
+        lambda axes: logical_to_sharding(axes, mesh, rules),
+        specs,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    init_fn = jax.jit(model.init_params, static_argnums=0, out_shardings=out_shardings)
+    return init_fn(cfg, jax.random.PRNGKey(seed))
+
+
+# --- HF checkpoint mapping (Llama/Mixtral family) ---------------------------
+
+def _hf_key_map(cfg: ModelConfig, i: int) -> dict[str, tuple[str, str]]:
+    """HF tensor name → (our layer param name, reshape rule) for layer i."""
+    m = {
+        f"model.layers.{i}.input_layernorm.weight": ("attn_norm", "copy"),
+        f"model.layers.{i}.self_attn.q_proj.weight": ("wq", "proj_q"),
+        f"model.layers.{i}.self_attn.k_proj.weight": ("wk", "proj_kv"),
+        f"model.layers.{i}.self_attn.v_proj.weight": ("wv", "proj_kv"),
+        f"model.layers.{i}.self_attn.o_proj.weight": ("wo", "proj_o"),
+        f"model.layers.{i}.post_attention_layernorm.weight": ("mlp_norm", "copy"),
+    }
+    if cfg.architecture == "mixtral" and cfg.num_experts > 0:
+        m[f"model.layers.{i}.block_sparse_moe.gate.weight"] = ("router", "t")
+        for x in range(cfg.num_experts):
+            m[f"model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight"] = (f"w_gate.{x}", "t")
+            m[f"model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight"] = (f"w_up.{x}", "t")
+            m[f"model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight"] = (f"w_down.{x}", "t")
+    else:
+        m[f"model.layers.{i}.mlp.gate_proj.weight"] = ("w_gate", "t")
+        m[f"model.layers.{i}.mlp.up_proj.weight"] = ("w_up", "t")
+        m[f"model.layers.{i}.mlp.down_proj.weight"] = ("w_down", "t")
+    return m
+
+
+def _convert(name_rule: str, w: np.ndarray, cfg: ModelConfig) -> np.ndarray:
+    H, KH, D, E = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.hidden_size
+    if name_rule == "copy":
+        return w
+    if name_rule == "t":  # HF linear stores (out, in); we use (in, out)
+        return w.T
+    if name_rule == "proj_q":  # (H*D, E) -> (E, H, D)
+        return w.reshape(H, D, E).transpose(2, 0, 1)
+    if name_rule == "proj_kv":  # (KH*D, E) -> (E, KH, D)
+        return w.reshape(KH, D, E).transpose(2, 0, 1)
+    if name_rule == "proj_o":  # (E, H*D) -> (H, D, E)
+        return w.reshape(E, H, D).transpose(1, 2, 0)
+    raise ValueError(name_rule)
+
+
+def load_safetensors(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules) -> dict:
+    from safetensors import safe_open
+
+    model = get_model(cfg)
+    specs = model.param_specs(cfg)
+    dt = cfg.jax_dtype
+
+    # gather all tensors lazily across shards
+    files = sorted(glob.glob(os.path.join(cfg.weights_path, "*.safetensors")))
+    handles = [safe_open(f, framework="np") for f in files]
+    index: dict[str, int] = {}
+    for fi, h in enumerate(handles):
+        for k in h.keys():
+            index[k] = fi
+
+    def get(name: str) -> np.ndarray:
+        return handles[index[name]].get_tensor(name)
+
+    def put(arr: np.ndarray, axes) -> jax.Array:
+        return jax.device_put(
+            jnp.asarray(arr, dtype=dt), logical_to_sharding(axes, mesh, rules)
+        )
+
+    params: dict = {
+        "embed": put(get("model.embed_tokens.weight"), specs["embed"]),
+        "final_norm": put(get("model.norm.weight"), specs["final_norm"]),
+    }
+    if not cfg.tie_word_embeddings:
+        head = get("lm_head.weight").T if "lm_head.weight" in index else get(
+            "model.embed_tokens.weight"
+        ).T
+        params["lm_head"] = put(head, specs["lm_head"])
+
+    layers: dict[str, list] = {}
+    for i in range(cfg.num_layers):
+        per_expert: dict[str, list] = {}
+        for hf_name, (ours, rule) in _hf_key_map(cfg, i).items():
+            w = _convert(rule, get(hf_name), cfg)
+            if "." in ours:  # expert weights collected then stacked
+                base, xi = ours.split(".")
+                per_expert.setdefault(base, []).append((int(xi), w))
+            else:
+                layers.setdefault(ours, []).append(w)
+        for base, items in per_expert.items():
+            items.sort()
+            layers.setdefault(base, []).append(np.stack([w for _, w in items]))
+
+    params["layers"] = {
+        k: put(np.stack(v), specs["layers"][k]) for k, v in layers.items()
+    }
+    for h in handles:
+        del h
+    return params

@@ -1,0 +1,3 @@
+from production_stack_tpu.models.registry import get_model
+
+__all__ = ["get_model"]

@@ -1,0 +1,29 @@
+"""Model registry: architecture name → functional model module.
+
+Every module exposes ``param_specs(cfg)``, ``init_params(cfg, key)``,
+``forward_tokens(cfg, params, tokens, positions, attend, kv_caches)``,
+``logits_from_hidden(cfg, params, hidden)`` and ``forward_dense(...)``.
+Mixtral reuses the Llama stack (its attention/MLP wiring is selected by
+``cfg.architecture`` inside the shared layer code).
+"""
+
+from __future__ import annotations
+
+from types import ModuleType
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.models import llama
+
+_REGISTRY: dict[str, ModuleType] = {
+    "llama": llama,
+    "mixtral": llama,  # shared stack; MoE block chosen via cfg.architecture
+}
+
+
+def get_model(cfg: ModelConfig) -> ModuleType:
+    try:
+        return _REGISTRY[cfg.architecture]
+    except KeyError:
+        raise ValueError(
+            f"unknown architecture {cfg.architecture!r}; known: {sorted(_REGISTRY)}"
+        ) from None

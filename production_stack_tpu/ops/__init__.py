@@ -1,0 +1,4 @@
+from production_stack_tpu.ops.norms import rms_norm, layer_norm
+from production_stack_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["rms_norm", "layer_norm", "apply_rope", "rope_frequencies"]

@@ -1,0 +1,36 @@
+"""Rotary position embeddings (Llama-style, half-rotation layout).
+
+Computed per-token from a flat positions vector so ragged/continuous batches
+(each token at its own absolute position) work without per-sequence reshapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies, shape (head_dim // 2,) in float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponents)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    theta: float = 10000.0,
+    scaling: float = 1.0,
+) -> jnp.ndarray:
+    """Apply RoPE.
+
+    x: (..., T, H, D) — any leading dims, T tokens, H heads, D head_dim.
+    positions: (..., T) int32 absolute positions per token.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq / scaling  # (..., T, D/2)
+    cos = jnp.cos(angles)[..., None, :]  # (..., T, 1, D/2)
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,15 @@
+from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh, local_mesh
+from production_stack_tpu.parallel.shardings import (
+    ShardingRules,
+    logical_to_sharding,
+    rules_for_model,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "local_mesh",
+    "ShardingRules",
+    "logical_to_sharding",
+    "rules_for_model",
+]

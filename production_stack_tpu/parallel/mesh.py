@@ -1,0 +1,99 @@
+"""Device-mesh construction for the serving engine.
+
+TPU-first parallelism: a single logical ``jax.sharding.Mesh`` with named axes
+
+    ("data", "stage", "seq", "tensor", "expert")
+
+- ``data``   replica data parallelism (whole-model replicas within one process;
+             cross-pod replica DP is the router's job, as in the reference's
+             replicaCount + load balancing — SURVEY.md §2.9).
+- ``stage``  pipeline stages (multi-slice over DCN; reference uses Ray + PP,
+             helm/templates/ray-cluster.yaml — we use GSPMD stage sharding).
+- ``seq``    sequence/context parallelism axis for ring attention (the
+             reference has none, SURVEY.md §5.7; here it is first-class).
+- ``tensor`` tensor parallelism over ICI (reference passes
+             --tensor-parallel-size through to vLLM).
+- ``expert`` expert parallelism for MoE layers.
+
+Axes of size 1 cost nothing: XLA inserts no collectives for them, so the
+same model code runs unchanged from 1 chip to a multi-host pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_STAGE = "stage"
+AXIS_SEQ = "seq"
+AXIS_TENSOR = "tensor"
+AXIS_EXPERT = "expert"
+
+MESH_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_SEQ, AXIS_TENSOR, AXIS_EXPERT)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape; -1 on data axis means "use all remaining devices"."""
+
+    data: int = 1
+    stage: int = 1
+    seq: int = 1
+    tensor: int = -1
+    expert: int = 1
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        sizes = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+        if unknown:
+            known = math.prod(v for v in sizes.values() if v != -1)
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} does not use all {n_devices} devices"
+            )
+        return MeshConfig(**sizes)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.stage, self.seq, self.tensor, self.expert)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the 5-axis logical mesh over the given (default: all) devices.
+
+    Uses ``jax.experimental.mesh_utils`` device ordering when available so
+    that the tensor axis — the most communication-hungry — lands on
+    ICI-adjacent chips.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = (config or MeshConfig()).resolved(len(devices))
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(
+            config.shape, devices=np.asarray(devices)
+        )
+    except Exception:
+        device_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+def local_mesh() -> Mesh:
+    """Single-process mesh over all visible devices, all on the tensor axis."""
+    return build_mesh(MeshConfig())

@@ -1,0 +1,37 @@
+"""Test config: force CPU with 8 virtual devices so every sharding/mesh test
+runs without TPU hardware (mirrors the reference's no-GPU router CI,
+SURVEY.md §4). Must run before jax is imported anywhere."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The TPU tunnel's sitecustomize imports jax at interpreter start and pins
+# JAX_PLATFORMS=axon in config before conftest runs; override at runtime
+# (backends are not initialised yet at collection time).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    assert jax.device_count() == 8
+    return build_mesh(MeshConfig(data=2, tensor=4))
+
+
+@pytest.fixture(scope="session")
+def tp_mesh():
+    from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(tensor=-1))
