@@ -1,0 +1,90 @@
+"""Paged attention over the block-table KV cache — XLA reference path.
+
+One function serves both phases of continuous batching:
+
+- decode: S = 1, every running slot advances one token;
+- (chunked) prefill: S = chunk length, the chunk's KV has already been
+  scattered into the cache, so queries attend to the full paged context.
+
+This implementation gathers the (bucketed) context KV via the block table and
+runs a masked softmax-matmul — simple, correct, and what CPU CI runs. On TPU
+the Pallas kernel in ``paged_attention_pallas.py`` replaces it on the decode
+hot path: it walks the block table with async HBM→VMEM DMA and never
+materialises the gather.
+
+Shapes:
+  q:            (B, S, H, D)
+  k/v cache:    (KH, num_blocks, block_size, D)   (single layer; KV-heads
+                lead so the TP shard axis is dim 0 — see kv_cache.py)
+  block_tables: (B, M) int32 — padded with 0s beyond the sequence's blocks
+  context_lens: (B,)  int32 — total tokens in cache per sequence (incl. chunk)
+  q_positions:  (B, S) int32 — absolute position per query token, -1 for pad
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    B, S, H, D = q.shape
+    KH, _, block_size, _ = k_cache.shape
+    M = block_tables.shape[1]
+    G = H // KH
+    scale = scale if scale is not None else D**-0.5
+
+    # Gather context: (KH, B, M, bs, D) -> (B, Tc, KH, D)
+    k = k_cache[:, block_tables].reshape(KH, B, M * block_size, D).transpose(1, 2, 0, 3)
+    v = v_cache[:, block_tables].reshape(KH, B, M * block_size, D).transpose(1, 2, 0, 3)
+
+    kv_pos = jnp.arange(M * block_size, dtype=jnp.int32)[None, :]  # (1, Tc)
+    valid_kv = kv_pos < context_lens[:, None]  # (B, Tc)
+    causal = kv_pos[:, None, :] <= q_positions[:, :, None]  # (B, S, Tc)
+    valid_q = q_positions >= 0  # (B, S)
+    mask = valid_kv[:, None, :] & causal & valid_q[:, :, None]
+
+    qg = q.reshape(B, S, KH, G, D)
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    denom = probs.sum(axis=-1, keepdims=True)
+    probs = probs / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def write_kv_to_cache(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    slot_mapping: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new KV for T tokens into the block pool.
+
+    k/v: (T, KH, D); caches: (KH, N, bs, D); slot_mapping: (T,) flat indices
+    block*block_size+offset, -1 for padding (dropped). Returns updated caches
+    (XLA performs the update in place when the caller donates the buffers).
+    """
+    KH, n, bs, D = k_cache.shape
+    # negative (padding) slots would wrap in JAX indexing; remap them past the
+    # end so mode="drop" discards them
+    slots = jnp.where(slot_mapping < 0, n * bs, slot_mapping)
+    flat_k = k_cache.reshape(KH, n * bs, D)
+    flat_v = v_cache.reshape(KH, n * bs, D)
+    flat_k = flat_k.at[:, slots].set(k.astype(flat_k.dtype).swapaxes(0, 1), mode="drop")
+    flat_v = flat_v.at[:, slots].set(v.astype(flat_v.dtype).swapaxes(0, 1), mode="drop")
+    return flat_k.reshape(KH, n, bs, D), flat_v.reshape(KH, n, bs, D)

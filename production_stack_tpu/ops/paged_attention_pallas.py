@@ -1,0 +1,151 @@
+"""Pallas TPU kernel: paged decode attention.
+
+The decode hot loop of the serving engine. Per (sequence, kv-head) grid cell
+the kernel walks that sequence's block table, DMAs each KV block HBM→VMEM,
+and maintains a flash-attention running softmax over the G grouped query
+heads. The gather that the XLA reference path materialises
+(ops/paged_attention.py) never exists here — HBM traffic is exactly the live
+context, which is what makes decode HBM-bandwidth-optimal on TPU
+(PAPERS.md: Ragged Paged Attention).
+
+Double-buffered: block j+1's DMA is issued before block j is processed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # (B, M) SMEM
+    context_lens_ref,  # (B,)  SMEM
+    # blocked inputs
+    q_ref,  # (1, 1, G, D) VMEM
+    k_hbm,  # (KH, N, bs, D) ANY/HBM — heads lead; DMA slices leading dims only
+    v_hbm,
+    # output
+    o_ref,  # (1, 1, G, D) VMEM
+    # scratch
+    k_scr,  # (2, bs, D) VMEM
+    v_scr,
+    sems,  # DMA sems (2, 2)
+    *,
+    block_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    ctx = context_lens_ref[b]
+    nblocks = pl.cdiv(ctx, block_size)
+    G, D = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32)  # (G, D)
+
+    def dma_k(slot, j):
+        bid = block_tables_ref[b, j]
+        return pltpu.make_async_copy(
+            k_hbm.at[kh, bid], k_scr.at[slot], sems.at[slot, 0]
+        )
+
+    def dma_v(slot, j):
+        bid = block_tables_ref[b, j]
+        return pltpu.make_async_copy(
+            v_hbm.at[kh, bid], v_scr.at[slot], sems.at[slot, 1]
+        )
+
+    @pl.when(nblocks > 0)
+    def _():
+        dma_k(0, 0).start()
+        dma_v(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < nblocks)
+        def _():
+            dma_k(nxt, j + 1).start()
+            dma_v(nxt, j + 1).start()
+
+        dma_k(slot, j).wait()
+        dma_v(slot, j).wait()
+        k = k_scr[slot].astype(jnp.float32)  # (bs, D)
+        v = v_scr[slot].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (G, bs)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((G, 1), NEG_INF, jnp.float32),
+        jnp.zeros((G, 1), jnp.float32),
+        jnp.zeros((G, D), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, nblocks, body, init)
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,  # (B, H, D)
+    k_cache: jnp.ndarray,  # (KH, N, bs, D)
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # (B, M) int32
+    context_lens: jnp.ndarray,  # (B,) int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, H, D = q.shape
+    KH, _, block_size, _ = k_cache.shape
+    G = H // KH
+    scale = D**-0.5
+
+    q4 = q.reshape(B, KH, G, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KH),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, D), lambda b, kh, *_: (b, kh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, D), lambda b, kh, *_: (b, kh, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, D), k_cache.dtype),
+            pltpu.VMEM((2, block_size, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_size=block_size, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, KH, G, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables, context_lens, q4, k_cache, v_cache)
+    return out.reshape(B, H, D)
