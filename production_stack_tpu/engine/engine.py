@@ -1,0 +1,266 @@
+"""LLMEngine: the synchronous engine core (scheduler + model runner).
+
+``step()`` runs one scheduler decision on device and returns per-request
+increments. The async server (engine/server.py) drives it from an executor
+thread; tests and the benchmark drive it directly.
+
+This layer is the TPU-native replacement for the vLLM engine the reference
+stack assumes exists underneath it (SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence as Seq
+
+import numpy as np
+from jax.sharding import Mesh
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kv_cache import slot_mapping_for
+from production_stack_tpu.engine.model_runner import ModelRunner
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.scheduler import Scheduler
+from production_stack_tpu.engine.sequence import (
+    RequestOutput,
+    Sequence,
+    SequenceStatus,
+)
+from production_stack_tpu.engine.tokenizer import get_tokenizer
+from production_stack_tpu.parallel.mesh import build_mesh
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        mesh: Optional[Mesh] = None,
+        params: Optional[dict] = None,
+        num_blocks: Optional[int] = None,
+    ):
+        self.config = config
+        self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
+        self.tokenizer = get_tokenizer(config.model.tokenizer)
+        self.runner = ModelRunner(config, self.mesh, params, num_blocks)
+        self.scheduler = Scheduler(
+            config.scheduler, config.cache, self.runner.num_blocks
+        )
+        B = config.scheduler.max_num_seqs
+        M = self.runner.max_blocks_per_seq
+        # persistent decode-batch host arrays (rewritten in place each step)
+        self._tokens = np.zeros(B, np.int32)
+        self._positions = np.zeros(B, np.int32)
+        self._block_tables = np.zeros((B, M), np.int32)
+        self._context_lens = np.zeros(B, np.int32)
+        self._slot_mapping = np.full(B, -1, np.int32)
+        self._temps = np.zeros(B, np.float32)
+        self._top_ps = np.ones(B, np.float32)
+        self._top_ks = np.full(B, -1, np.int32)
+        self._seeds = np.zeros(B, np.uint32)
+        self._steps = np.zeros(B, np.int32)
+        self._slot_seq: dict[int, Sequence] = {}
+        # metrics
+        self.total_prompt_tokens = 0
+        self.total_output_tokens = 0
+
+    # -- request intake ------------------------------------------------------
+    def add_request(
+        self,
+        request_id: str,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[Seq[int]] = None,
+        sampling: Optional[SamplingParams] = None,
+    ) -> Sequence:
+        if prompt_token_ids is None:
+            assert prompt is not None, "prompt or prompt_token_ids required"
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        if not prompt_token_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_token_ids) > self.config.model.max_model_len - 1:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} exceeds max_model_len "
+                f"{self.config.model.max_model_len}"
+            )
+        sampling = (sampling or SamplingParams()).clamped(
+            self.config.model.max_model_len, len(prompt_token_ids)
+        )
+        seq = Sequence(request_id, list(prompt_token_ids), sampling)
+        self.scheduler.add(seq)
+        self.total_prompt_tokens += len(prompt_token_ids)
+        return seq
+
+    def abort_request(self, request_id: str) -> bool:
+        seq = self.scheduler.abort(request_id)
+        if seq is not None and seq.slot in self._slot_seq:
+            del self._slot_seq[seq.slot]
+        return seq is not None
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_work()
+
+    # -- the step ------------------------------------------------------------
+    def step(self) -> list[RequestOutput]:
+        out = self.scheduler.schedule()
+        if out.is_empty:
+            return []
+        if out.prefill is not None:
+            return self._run_prefill(out.prefill)
+        return self._run_decode(out.decodes)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.scheduler.prefill_buckets:
+            if b >= n:
+                return min(b, self.config.model.max_model_len)
+        return self.config.model.max_model_len
+
+    def _run_prefill(self, sp) -> list[RequestOutput]:
+        seq = sp.seq
+        bs = self.config.cache.block_size
+        bucket = self._bucket(sp.chunk_len)
+        chunk_tokens = seq.token_ids[sp.chunk_start : sp.chunk_start + sp.chunk_len]
+
+        tokens = np.zeros(bucket, np.int32)
+        tokens[: sp.chunk_len] = chunk_tokens
+        positions = np.full(bucket, -1, np.int32)
+        positions[: sp.chunk_len] = np.arange(sp.chunk_start, sp.chunk_start + sp.chunk_len)
+        slot_mapping = np.full(bucket, -1, np.int32)
+        slot_mapping[: sp.chunk_len] = slot_mapping_for(
+            seq.block_ids, sp.chunk_start, sp.chunk_len, bs
+        )
+        table = np.zeros(self.runner.max_blocks_per_seq, np.int32)
+        table[: len(seq.block_ids)] = seq.block_ids
+
+        context_len = sp.chunk_start + sp.chunk_len
+        logits = self.runner.prefill(
+            tokens, positions, table, context_len, slot_mapping, sp.chunk_len - 1
+        )
+        seq.num_computed_tokens = context_len
+
+        if not seq.prefill_done:
+            return []  # more chunks to go
+
+        seq.status = SequenceStatus.RUNNING
+        self._slot_seq[seq.slot] = seq
+        if seq.output_token_ids:
+            # preemption-recompute: context rebuilt, newest token still the
+            # pending decode input — nothing to sample from this prefill
+            return []
+
+        # prompt complete → sample the first token
+        s = seq.sampling
+        token = int(
+            self.runner.sample(
+                logits[None],
+                np.asarray([s.temperature], np.float32),
+                np.asarray([s.top_p], np.float32),
+                np.asarray([s.top_k], np.int32),
+                np.asarray([s.seed or 0], np.uint32),
+                np.asarray([0], np.int32),
+            )[0]
+        )
+        seq.first_token_time = time.monotonic()
+        seq.output_token_ids.append(token)
+        self.total_output_tokens += 1
+        return self._postprocess([seq], [token])
+
+    def _run_decode(self, decodes: list[Sequence]) -> list[RequestOutput]:
+        bs = self.config.cache.block_size
+        self._context_lens[:] = 0
+        self._slot_mapping[:] = -1
+        for seq in decodes:
+            i = seq.slot
+            pos = seq.num_computed_tokens  # index of the incoming token
+            self._tokens[i] = seq.token_ids[pos]
+            self._positions[i] = pos
+            n = len(seq.block_ids)
+            self._block_tables[i, :n] = seq.block_ids
+            self._context_lens[i] = pos + 1
+            self._slot_mapping[i] = seq.block_ids[pos // bs] * bs + pos % bs
+            s = seq.sampling
+            self._temps[i] = s.temperature
+            self._top_ps[i] = s.top_p
+            self._top_ks[i] = s.top_k
+            self._seeds[i] = s.seed or 0
+            self._steps[i] = len(seq.output_token_ids)
+
+        logits = self.runner.decode(
+            self._tokens, self._positions, self._block_tables,
+            self._context_lens, self._slot_mapping,
+        )
+        tokens = self.runner.sample(
+            logits, self._temps, self._top_ps, self._top_ks, self._seeds, self._steps
+        )
+        new_tokens = []
+        for seq in decodes:
+            t = int(tokens[seq.slot])
+            seq.num_computed_tokens += 1
+            seq.output_token_ids.append(t)
+            new_tokens.append(t)
+            self.total_output_tokens += 1
+        return self._postprocess(decodes, new_tokens)
+
+    def _postprocess(self, seqs: list[Sequence], tokens: list[int]) -> list[RequestOutput]:
+        outputs = []
+        for seq, tok in zip(seqs, tokens):
+            status = self._check_stop(seq, tok)
+            if status is not None:
+                self.scheduler.finish(seq, status)
+                self._slot_seq.pop(seq.slot, None)
+                seq.finish_time = time.monotonic()
+            outputs.append(
+                RequestOutput(
+                    request_id=seq.request_id,
+                    new_token_ids=[tok],
+                    finished=status is not None,
+                    finish_reason=seq.finish_reason(),
+                    num_prompt_tokens=seq.num_prompt_tokens,
+                    num_output_tokens=len(seq.output_token_ids),
+                    num_cached_tokens=seq.num_cached_tokens,
+                )
+            )
+        return outputs
+
+    def _check_stop(self, seq: Sequence, token: int) -> Optional[SequenceStatus]:
+        s = seq.sampling
+        if not s.ignore_eos and self.tokenizer.eos_id is not None and token == self.tokenizer.eos_id:
+            return SequenceStatus.FINISHED_STOPPED
+        if token in s.stop_token_ids:
+            return SequenceStatus.FINISHED_STOPPED
+        if len(seq.output_token_ids) >= s.max_tokens:
+            return SequenceStatus.FINISHED_LENGTH
+        if seq.num_tokens >= self.config.model.max_model_len:
+            return SequenceStatus.FINISHED_LENGTH
+        return None
+
+    # -- metrics (the /metrics contract) -------------------------------------
+    def stats(self) -> dict:
+        alloc = self.scheduler.allocator
+        return {
+            "num_requests_running": self.scheduler.num_running,
+            "num_requests_waiting": self.scheduler.num_waiting,
+            "gpu_cache_usage_perc": alloc.usage,
+            "gpu_prefix_cache_hits_total": alloc.prefix_hits,
+            "gpu_prefix_cache_queries_total": alloc.prefix_queries,
+            "prompt_tokens_total": self.total_prompt_tokens,
+            "generation_tokens_total": self.total_output_tokens,
+        }
+
+    # -- convenience for tests / offline use ---------------------------------
+    def generate(
+        self,
+        prompts: list[str] | list[list[int]],
+        sampling: Optional[SamplingParams] = None,
+        max_steps: int = 100_000,
+    ) -> dict[str, list[int]]:
+        seqs = {}
+        for i, p in enumerate(prompts):
+            rid = f"offline-{i}"
+            if isinstance(p, str):
+                seqs[rid] = self.add_request(rid, prompt=p, sampling=sampling)
+            else:
+                seqs[rid] = self.add_request(rid, prompt_token_ids=p, sampling=sampling)
+        for _ in range(max_steps):
+            if not self.has_unfinished():
+                break
+            self.step()
+        return {rid: s.output_token_ids for rid, s in seqs.items()}

@@ -1,0 +1,221 @@
+"""Jitted device steps: chunked prefill + batched decode over the paged cache.
+
+Static-shape discipline (XLA traces once per shape):
+
+- decode is ONE compiled program: fixed (max_num_seqs, 1) batch; empty slots
+  carry context_len 0 and padding slot -1, costing only masked lanes.
+- prefill compiles once per token-length *bucket* (powers of two); chunks are
+  padded up. Block tables are always (B, max_blocks_per_seq).
+- KV cache buffers are donated through every step, so XLA updates them in
+  place in HBM — the pool is allocated once at startup and never copied.
+
+Attention backend selection: Pallas decode kernel on TPU (wrapped in
+shard_map over the tensor axis when tp > 1 — heads are independent, so the
+kernel needs no cross-chip traffic); XLA gather path on CPU/tests and as
+fallback when head counts don't divide the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from production_stack_tpu.engine.config import EngineConfig, ModelConfig
+from production_stack_tpu.engine import kv_cache as kvmod
+from production_stack_tpu.engine.sampling import sample_tokens
+from production_stack_tpu.engine.weights import init_or_load
+from production_stack_tpu.models.registry import get_model
+from production_stack_tpu.ops.paged_attention import paged_attention, write_kv_to_cache
+from production_stack_tpu.parallel.mesh import AXIS_TENSOR
+from production_stack_tpu.parallel.shardings import rules_for_model
+
+
+def _pallas_ok(cfg: ModelConfig, mesh: Mesh) -> bool:
+    if jax.default_backend() in ("cpu",):
+        return False
+    tp = mesh.shape[AXIS_TENSOR]
+    return cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
+
+
+class ModelRunner:
+    """Owns params, the KV block pool and the compiled step functions."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        mesh: Mesh,
+        params: Optional[dict] = None,
+        num_blocks: Optional[int] = None,
+    ):
+        self.config = config
+        self.cfg = config.model
+        self.mesh = mesh
+        self.rules = rules_for_model(self.cfg, mesh)
+        self.model = get_model(self.cfg)
+        with jax.set_mesh(mesh):
+            self.params = (
+                params
+                if params is not None
+                else init_or_load(self.cfg, mesh, self.rules, config.seed)
+            )
+        self.num_blocks = self._resolve_num_blocks(num_blocks)
+        self.kv = kvmod.init_kv_cache(
+            self.cfg, config.cache, mesh, self.rules, self.num_blocks
+        )
+        self.max_blocks_per_seq = -(-self.cfg.max_model_len // config.cache.block_size)
+        self.use_pallas = _pallas_ok(self.cfg, mesh)
+
+        self._prefill = jax.jit(
+            functools.partial(_prefill_step, self.cfg, self._attend_prefill),
+            donate_argnums=(1,),
+        )
+        self._decode = jax.jit(
+            functools.partial(_decode_step, self.cfg, self._attend_decode),
+            donate_argnums=(1,),
+        )
+        self._sample = jax.jit(sample_tokens)
+
+    # -- sizing ------------------------------------------------------------
+    def _resolve_num_blocks(self, explicit: Optional[int]) -> int:
+        if explicit is not None:
+            return explicit
+        if self.config.cache.num_blocks > 0:
+            return self.config.cache.num_blocks
+        per_block = kvmod.kv_cache_bytes_per_block(self.cfg, self.config.cache)
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            free = stats["bytes_limit"] - stats["bytes_in_use"]
+        except Exception:
+            # no memory stats (CPU / tunneled backend): assume v5e 16 GiB HBM
+            # minus what the params occupy
+            param_bytes = sum(
+                x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
+            )
+            free = 16 * 1024**3 - param_bytes
+        n_dev = max(self.mesh.devices.size, 1)
+        total_free = free * n_dev  # cache is sharded over the mesh
+        return max(int(total_free * self.config.cache.hbm_utilization) // per_block, 16)
+
+    # -- attention backends -------------------------------------------------
+    def _attend_prefill(self, q, k, v, layer_cache, block_tables, context_lens,
+                        q_positions, slot_mapping):
+        kc, vc = write_kv_to_cache(
+            layer_cache["k"], layer_cache["v"], k[0], v[0], slot_mapping
+        )
+        out = paged_attention(q, kc, vc, block_tables, context_lens, q_positions)
+        return out, {"k": kc, "v": vc}
+
+    def _attend_decode(self, q, k, v, layer_cache, block_tables, context_lens,
+                       q_positions, slot_mapping):
+        kc, vc = write_kv_to_cache(
+            layer_cache["k"], layer_cache["v"], k[:, 0], v[:, 0], slot_mapping
+        )
+        if self.use_pallas:
+            from production_stack_tpu.ops.paged_attention_pallas import (
+                paged_decode_attention_pallas,
+            )
+
+            fn = functools.partial(paged_decode_attention_pallas, interpret=False)
+            tp = self.mesh.shape[AXIS_TENSOR]
+            if tp > 1:
+                fn = jax.shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(None, AXIS_TENSOR, None),
+                        P(AXIS_TENSOR),
+                        P(AXIS_TENSOR),
+                        P(None, None),
+                        P(None),
+                    ),
+                    out_specs=P(None, AXIS_TENSOR, None),
+                    check_vma=False,
+                )
+            out = fn(q[:, 0], kc, vc, block_tables, context_lens)[:, None]
+        else:
+            out = paged_attention(q, kc, vc, block_tables, context_lens, q_positions)
+        return out, {"k": kc, "v": vc}
+
+    # -- public step API (host numpy in, device out) -------------------------
+    def prefill(self, tokens: np.ndarray, positions: np.ndarray,
+                block_table: np.ndarray, context_len: int, slot_mapping: np.ndarray,
+                last_idx: int):
+        """One sequence's prefill chunk (shapes already padded to a bucket).
+        Returns logits (V,) for last_idx."""
+        with jax.set_mesh(self.mesh):
+            self.kv, logits = self._prefill(
+                self.params, self.kv,
+                jnp.asarray(tokens[None]), jnp.asarray(positions[None]),
+                jnp.asarray(block_table[None]),
+                jnp.asarray([context_len], jnp.int32),
+                jnp.asarray(slot_mapping),
+                jnp.asarray(last_idx, jnp.int32),
+            )
+        return logits
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               block_tables: np.ndarray, context_lens: np.ndarray,
+               slot_mapping: np.ndarray):
+        """One decode step over all slots. Returns logits (B, V)."""
+        with jax.set_mesh(self.mesh):
+            self.kv, logits = self._decode(
+                self.params, self.kv,
+                jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+                jnp.asarray(block_tables), jnp.asarray(context_lens),
+                jnp.asarray(slot_mapping),
+            )
+        return logits
+
+    def sample(self, logits, temps, top_ps, top_ks, seeds, steps) -> np.ndarray:
+        with jax.set_mesh(self.mesh):
+            toks = self._sample(
+                logits, jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(steps),
+            )
+        return np.asarray(jax.device_get(toks))
+
+
+# ---------------------------------------------------------------------------
+# pure device functions (cfg static, attend closed over)
+# ---------------------------------------------------------------------------
+
+def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
+                  block_tables, context_lens, slot_mapping, last_idx):
+    from production_stack_tpu.models.registry import get_model
+
+    model = get_model(cfg)
+
+    def attend(q, k, v, layer_cache, layer_idx):
+        return attend_impl(
+            q, k, v, layer_cache, block_tables, context_lens, positions, slot_mapping
+        )
+
+    hidden, new_kv = model.forward_tokens(
+        cfg, params, tokens, positions, attend, kv
+    )
+    last_hidden = jax.lax.dynamic_index_in_dim(hidden[0], last_idx, axis=0)
+    logits = model.logits_from_hidden(cfg, params, last_hidden[None])[0, 0]
+    return new_kv, logits
+
+
+def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
+                 block_tables, context_lens, slot_mapping):
+    from production_stack_tpu.models.registry import get_model
+
+    model = get_model(cfg)
+
+    def attend(q, k, v, layer_cache, layer_idx):
+        return attend_impl(
+            q, k, v, layer_cache, block_tables, context_lens, positions, slot_mapping
+        )
+
+    hidden, new_kv = model.forward_tokens(
+        cfg, params, tokens, positions, attend, kv
+    )
+    logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]  # (B, V)
+    return new_kv, logits

@@ -1,0 +1,96 @@
+"""Sampling: request-level params + the batched on-device sampler.
+
+The sampler is one jitted function over the whole decode batch; per-slot
+temperature/top-k/top-p/seed live in device arrays so a mixed batch (greedy
+next to creative) needs no recompilation and no per-request dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Mirrors the OpenAI/vLLM request knobs the reference forwards to the
+    engine (reference: request bodies proxied verbatim,
+    src/vllm_router/services/request_service/request.py:384)."""
+
+    max_tokens: int = 16
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1  # -1 = disabled
+    seed: Optional[int] = None
+    stop: Sequence[str] = ()
+    stop_token_ids: Sequence[int] = ()
+    ignore_eos: bool = False
+    n: int = 1
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    logprobs: Optional[int] = None
+
+    def clamped(self, max_model_len: int, prompt_len: int) -> "SamplingParams":
+        limit = max(max_model_len - prompt_len, 1)
+        return dataclasses.replace(self, max_tokens=min(self.max_tokens, limit))
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # (B, V) float32
+    temperatures: jnp.ndarray,  # (B,)
+    top_ps: jnp.ndarray,  # (B,)
+    top_ks: jnp.ndarray,  # (B,) int32, <=0 disables
+    seeds: jnp.ndarray,  # (B,) uint32
+    steps: jnp.ndarray,  # (B,) int32 — fold-in counter for reproducibility
+) -> jnp.ndarray:
+    """Batched temperature / top-k / top-p sampling; temperature 0 = greedy."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    scaled = logits / jnp.maximum(temperatures, 1e-6)[:, None]
+
+    # Sort once (descending); both truncations are rank/cdf thresholds on it.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+
+    k = jnp.where(top_ks <= 0, V, top_ks).astype(jnp.int32)
+    kth_value = jnp.take_along_axis(
+        sorted_logits, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
+    )
+    keep_topk = scaled >= kth_value
+
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cumsum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep the smallest prefix whose mass >= top_p (always keep rank 0)
+    cutoff_rank = jnp.sum((cumsum - probs_sorted) < top_ps[:, None], axis=-1)
+    pth_value = jnp.take_along_axis(
+        sorted_logits, jnp.clip(cutoff_rank - 1, 0, V - 1)[:, None], axis=-1
+    )
+    keep_topp = scaled >= pth_value
+
+    masked = jnp.where(keep_topk & keep_topp, scaled, NEG_INF)
+
+    def _one(row, seed, step):
+        key = jax.random.fold_in(jax.random.key(seed), step)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(_one)(masked, seeds, steps)
+    return jnp.where(temperatures <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def penalize_logits(
+    logits: jnp.ndarray,  # (B, V)
+    output_counts: jnp.ndarray,  # (B, V) int32 — token counts in output so far
+    presence: jnp.ndarray,  # (B,)
+    frequency: jnp.ndarray,  # (B,)
+) -> jnp.ndarray:
+    return (
+        logits
+        - presence[:, None] * (output_counts > 0)
+        - frequency[:, None] * output_counts
+    )

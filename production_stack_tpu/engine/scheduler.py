@@ -1,0 +1,189 @@
+"""Continuous-batching scheduler (vLLM-semantics, TPU-shaped).
+
+Policy per step, in order:
+
+1. **Admit**: move waiting sequences into decode slots while slots and KV
+   blocks last, reusing prefix-cached blocks on admission.
+2. **Prefill priority**: if any admitted sequence still has uncomputed prompt
+   tokens, schedule one prefill chunk (bounded by
+   ``max_num_batched_tokens``); prefill-first keeps TTFT low (the north-star
+   p50 < 200 ms, BASELINE.md).
+3. Otherwise **decode** every running sequence one token, growing block
+   tables; if the pool is exhausted, preempt the youngest sequence
+   (free blocks, recompute later) — vLLM-style recompute preemption.
+
+The scheduler is pure host-side control plane: it never touches device
+arrays, it only decides. Counters here feed ``vllm:num_requests_running/
+waiting`` (reference contract: src/vllm_router/stats/engine_stats.py:63-76).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Optional
+
+from production_stack_tpu.engine.config import CacheConfig, SchedulerConfig
+from production_stack_tpu.engine.kv_cache import PrefixCachingBlockAllocator
+from production_stack_tpu.engine.sequence import Sequence, SequenceStatus
+
+
+@dataclasses.dataclass
+class ScheduledPrefill:
+    seq: Sequence
+    chunk_start: int  # == seq.num_computed_tokens
+    chunk_len: int
+
+
+@dataclasses.dataclass
+class SchedulerOutput:
+    prefill: Optional[ScheduledPrefill] = None
+    decodes: list[Sequence] = dataclasses.field(default_factory=list)
+    preempted: list[Sequence] = dataclasses.field(default_factory=list)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.prefill is None and not self.decodes
+
+
+class Scheduler:
+    def __init__(self, sched: SchedulerConfig, cache: CacheConfig, num_blocks: int):
+        self.config = sched
+        self.cache_config = cache
+        self.allocator = PrefixCachingBlockAllocator(
+            num_blocks, cache.block_size, cache.enable_prefix_caching
+        )
+        self.waiting: collections.deque[Sequence] = collections.deque()
+        self.seqs: dict[str, Sequence] = {}  # admitted, not finished
+        self.free_slots = list(range(sched.max_num_seqs - 1, -1, -1))
+
+    # -- queue management ---------------------------------------------------
+    def add(self, seq: Sequence) -> None:
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> Optional[Sequence]:
+        for q in (list(self.waiting),):
+            for s in q:
+                if s.request_id == request_id:
+                    self.waiting.remove(s)
+                    s.status = SequenceStatus.FINISHED_ABORTED
+                    return s
+        s = self.seqs.get(request_id)
+        if s is not None:
+            self._release(s)
+            s.status = SequenceStatus.FINISHED_ABORTED
+            return s
+        return None
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.seqs)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.seqs)
+
+    # -- internals ------------------------------------------------------------
+    def _release(self, seq: Sequence) -> None:
+        """Return a sequence's blocks and slot to the pools."""
+        if seq.block_ids:
+            self.allocator.free_blocks(seq.block_ids)
+            seq.block_ids = []
+        if seq.slot >= 0:
+            self.free_slots.append(seq.slot)
+            seq.slot = -1
+        self.seqs.pop(seq.request_id, None)
+
+    def finish(self, seq: Sequence, status: SequenceStatus) -> None:
+        """Mark finished; full blocks stay content-addressed in the allocator
+        so the next conversation round prefix-hits this context (the
+        multi-round-QA KV-reuse win the reference gets from LMCache)."""
+        self.allocator.commit_full_blocks(seq.token_ids, seq.block_ids)
+        self._release(seq)
+        seq.status = status
+
+    def _preempt(self, victim: Sequence) -> None:
+        self._release(victim)
+        victim.status = SequenceStatus.PREEMPTED
+        victim.num_computed_tokens = 0
+        victim.num_cached_tokens = 0
+        self.waiting.appendleft(victim)
+
+    def _try_admit(self) -> None:
+        while self.waiting and self.free_slots:
+            seq = self.waiting[0]
+            got = self.allocator.allocate_sequence(seq.token_ids)
+            if got is None:
+                break
+            self.waiting.popleft()
+            seq.block_ids, cached = got
+            seq.num_cached_tokens = cached
+            seq.num_computed_tokens = cached
+            seq.slot = self.free_slots.pop()
+            seq.status = SequenceStatus.PREFILLING
+            self.seqs[seq.request_id] = seq
+
+    # -- the per-step decision ----------------------------------------------
+    def schedule(self) -> SchedulerOutput:
+        out = SchedulerOutput()
+        self._try_admit()
+
+        # prefill priority (one chunk per step; chunks are bucketed)
+        for seq in sorted(self.seqs.values(), key=lambda s: s.arrival_time):
+            if seq.status is not SequenceStatus.PREFILLING:
+                continue
+            if seq.prefill_done:
+                # possible when a preempted sequence's context fully
+                # prefix-matched on re-admission: nothing to compute
+                seq.status = SequenceStatus.RUNNING
+                continue
+            remaining = seq.prefill_target - seq.num_computed_tokens
+            chunk = min(remaining, self.config.max_num_batched_tokens)
+            out.prefill = ScheduledPrefill(seq, seq.num_computed_tokens, chunk)
+            return out
+
+        # decode all running sequences; grow block tables first
+        decodes = sorted(
+            (s for s in self.seqs.values() if s.status is SequenceStatus.RUNNING),
+            key=lambda s: s.slot,
+        )
+        survivors = []
+        for seq in decodes:
+            if seq.status is not SequenceStatus.RUNNING:
+                continue  # preempted earlier in this same pass
+            bs = self.cache_config.block_size
+            # slot for the *incoming* token at index num_computed_tokens
+            if seq.num_computed_tokens >= len(seq.block_ids) * bs:
+                bid = self.allocator.append_block()
+                while bid is None:
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        # no one else to evict: preempt this sequence itself
+                        self._preempt(seq)
+                        out.preempted.append(seq)
+                        seq = None
+                        break
+                    self._preempt(victim)
+                    out.preempted.append(victim)
+                    if victim in survivors:
+                        survivors.remove(victim)
+                    bid = self.allocator.append_block()
+                if seq is None:
+                    continue
+                seq.block_ids.append(bid)
+            survivors.append(seq)
+        out.decodes = survivors
+        return out
+
+    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        candidates = [
+            s
+            for s in self.seqs.values()
+            if s is not exclude and s.status is SequenceStatus.RUNNING
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.arrival_time)

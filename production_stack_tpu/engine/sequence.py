@@ -1,0 +1,90 @@
+"""Per-request sequence state (host side, control plane)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Optional
+
+from production_stack_tpu.engine.sampling import SamplingParams
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"
+    PREFILLING = "prefilling"
+    RUNNING = "running"  # decoding
+    PREEMPTED = "preempted"
+    FINISHED_STOPPED = "stop"
+    FINISHED_LENGTH = "length"
+    FINISHED_ABORTED = "abort"
+
+    @property
+    def is_finished(self) -> bool:
+        return self in (
+            SequenceStatus.FINISHED_STOPPED,
+            SequenceStatus.FINISHED_LENGTH,
+            SequenceStatus.FINISHED_ABORTED,
+        )
+
+
+@dataclasses.dataclass
+class Sequence:
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling: SamplingParams
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+
+    output_token_ids: list[int] = dataclasses.field(default_factory=list)
+    status: SequenceStatus = SequenceStatus.WAITING
+    block_ids: list[int] = dataclasses.field(default_factory=list)
+    num_computed_tokens: int = 0  # tokens whose KV sits in the cache
+    num_cached_tokens: int = 0  # prefix-cache hits at admission (for metrics)
+    slot: int = -1  # decode slot index, -1 = none
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def token_ids(self) -> list[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def prefill_target(self) -> int:
+        """Tokens that must be in-cache before decoding can resume.
+
+        Fresh request: the whole prompt (the first output token is sampled
+        from the prefill's last logit). Preemption-recompute: everything but
+        the newest output token, which becomes the pending decode input."""
+        if self.output_token_ids:
+            return self.num_tokens - 1
+        return self.num_prompt_tokens
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.num_computed_tokens >= self.prefill_target
+
+    def finish_reason(self) -> Optional[str]:
+        if not self.status.is_finished:
+            return None
+        return self.status.value
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """One step's increment for a request (engine → server layer)."""
+
+    request_id: str
+    new_token_ids: list[int]
+    finished: bool
+    finish_reason: Optional[str]
+    num_prompt_tokens: int
+    num_output_tokens: int
+    num_cached_tokens: int = 0

@@ -1,0 +1,72 @@
+"""Tokenizers: HF wrapper (local files) + a dependency-free byte tokenizer.
+
+The byte tokenizer exists so every test, CI run and synthetic benchmark works
+in a zero-egress environment (no HF hub): ids 0..255 are raw bytes, then
+bos/eos/pad. Any model config with vocab_size >= 259 can serve under it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    bos_id: Optional[int]
+    eos_id: Optional[int]
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    @property
+    def vocab_size(self) -> int: ...
+
+
+class ByteTokenizer:
+    def __init__(self):
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] if add_bos else []) + ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """transformers AutoTokenizer over a *local* path (PVC-mounted weights
+    dir, as the reference mounts model PVCs — SURVEY.md §5.4)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self.tk = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self.tk.bos_token_id
+        self.eos_id = self.tk.eos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tk)
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self.tk.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tk.decode(ids, skip_special_tokens=True)
+
+
+def get_tokenizer(path: Optional[str]) -> Tokenizer:
+    if path:
+        try:
+            return HFTokenizer(path)
+        except Exception:
+            pass
+    return ByteTokenizer()

@@ -82,7 +82,13 @@ def build_mesh(
     ICI-adjacent chips.
     """
     devices = list(devices if devices is not None else jax.devices())
-    config = (config or MeshConfig()).resolved(len(devices))
+    config = config or MeshConfig()
+    fixed = [s for s in config.shape if s != -1]
+    if -1 not in config.shape and math.prod(fixed) < len(devices):
+        # fully specified mesh smaller than the host's device count: use a
+        # prefix of the devices (tests pin small meshes on 8-dev CPU hosts)
+        devices = devices[: math.prod(fixed)]
+    config = config.resolved(len(devices))
     try:
         from jax.experimental import mesh_utils
 
