@@ -1,0 +1,129 @@
+"""Async facade over the synchronous LLMEngine.
+
+One dedicated thread owns the device (JAX dispatch is blocking); asyncio land
+talks to it through an intake queue and per-request output queues. This is
+the same thread↔event-loop shape the reference router uses for its
+background workers (run_coroutine_threadsafe bridges,
+reference: src/vllm_router/service_discovery.py:757-765).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+import uuid
+from typing import AsyncIterator, Optional, Sequence as Seq
+
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.sequence import RequestOutput
+
+
+class AsyncEngine:
+    def __init__(self, engine: LLMEngine):
+        self.engine = engine
+        self.intake: queue.Queue = queue.Queue()
+        self.streams: dict[str, asyncio.Queue] = {}
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.running = False
+        self.paused = False  # sleep mode
+        self.step_count = 0
+        self.thread: Optional[threading.Thread] = None
+
+    async def start(self) -> None:
+        self.loop = asyncio.get_running_loop()
+        if self.thread is not None and self.thread.is_alive():
+            return
+        self.running = True
+        self.thread = threading.Thread(target=self._worker, daemon=True)
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.running = False
+        if self.thread is not None:
+            self.thread.join(timeout=2.0)
+            self.thread = None
+
+    # -- worker thread -------------------------------------------------------
+    def _worker(self) -> None:
+        while self.running:
+            self._drain_intake(block=not self.engine.has_unfinished())
+            if self.paused or not self.engine.has_unfinished():
+                continue
+            outputs = self.engine.step()
+            self.step_count += 1
+            if outputs and self.loop is not None:
+                self.loop.call_soon_threadsafe(self._deliver, outputs)
+
+    def _drain_intake(self, block: bool) -> None:
+        try:
+            item = self.intake.get(timeout=0.05 if block else 0)
+        except queue.Empty:
+            return
+        while True:
+            kind, payload = item
+            if kind == "add":
+                rid, prompt_ids, sampling = payload
+                try:
+                    self.engine.add_request(
+                        rid, prompt_token_ids=prompt_ids, sampling=sampling
+                    )
+                except Exception as e:  # surfaced on the request's stream
+                    if self.loop is not None:
+                        self.loop.call_soon_threadsafe(self._deliver_error, rid, e)
+            elif kind == "abort":
+                self.engine.abort_request(payload)
+            try:
+                item = self.intake.get_nowait()
+            except queue.Empty:
+                return
+
+    def _deliver(self, outputs: list[RequestOutput]) -> None:
+        for out in outputs:
+            q = self.streams.get(out.request_id)
+            if q is not None:
+                q.put_nowait(out)
+
+    def _deliver_error(self, rid: str, err: Exception) -> None:
+        q = self.streams.get(rid)
+        if q is not None:
+            q.put_nowait(err)
+
+    # -- async API ------------------------------------------------------------
+    async def generate(
+        self,
+        prompt_token_ids: Seq[int],
+        sampling: SamplingParams,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[RequestOutput]:
+        rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
+        q: asyncio.Queue = asyncio.Queue()
+        self.streams[rid] = q
+        self.intake.put(("add", (rid, list(prompt_token_ids), sampling)))
+        try:
+            while True:
+                item = await q.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.finished:
+                    return
+        finally:
+            self.streams.pop(rid, None)
+
+    def abort(self, request_id: str) -> None:
+        self.intake.put(("abort", request_id))
+
+    # -- sleep mode (reference: /sleep /wake_up /is_sleeping proxying,
+    #    src/vllm_router/services/request_service/request.py:1027-1114) ------
+    def sleep(self, level: int = 1) -> None:
+        self.paused = True
+
+    def wake_up(self) -> None:
+        self.paused = False
+
+    @property
+    def is_sleeping(self) -> bool:
+        return self.paused

@@ -1,0 +1,138 @@
+"""Engine Prometheus metrics — the exact exposition contract the reference
+router scrapes and re-derives (reference names parsed in
+src/vllm_router/stats/engine_stats.py:63-76; dashboard KPIs README.md:93-101).
+
+Gauges/counters that mirror engine state are emitted by a custom collector
+reading ``LLMEngine.stats()`` at scrape time (no update thread to drift);
+latency histograms are observed inline by the server.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING
+
+from prometheus_client import Histogram, REGISTRY
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+if TYPE_CHECKING:
+    from production_stack_tpu.engine.engine import LLMEngine
+
+
+class EngineStatsCollector:
+    def __init__(self, engine: "LLMEngine", model_name: str):
+        self.engine = engine
+        self.model_name = model_name
+
+    def collect(self):
+        s = self.engine.stats()
+        labels = ["model_name"]
+        lv = [self.model_name]
+
+        def gauge(name, doc, value):
+            g = GaugeMetricFamily(name, doc, labels=labels)
+            g.add_metric(lv, value)
+            return g
+
+        def counter(name, doc, value):
+            c = CounterMetricFamily(name, doc, labels=labels)
+            c.add_metric(lv, value)
+            return c
+
+        hits = s["gpu_prefix_cache_hits_total"]
+        queries = s["gpu_prefix_cache_queries_total"]
+        yield gauge(
+            "vllm:num_requests_running",
+            "Number of requests currently running on TPU",
+            s["num_requests_running"],
+        )
+        yield gauge(
+            "vllm:num_requests_waiting",
+            "Number of requests waiting to be processed",
+            s["num_requests_waiting"],
+        )
+        yield gauge(
+            "vllm:gpu_cache_usage_perc",
+            "KV-cache usage (1 = 100%); TPU HBM block pool",
+            s["gpu_cache_usage_perc"],
+        )
+        yield gauge(
+            "vllm:gpu_prefix_cache_hit_rate",
+            "Prefix cache block hit rate",
+            hits / queries if queries else 0.0,
+        )
+        yield counter(
+            "vllm:gpu_prefix_cache_hits", "Prefix cache block hits", hits
+        )
+        yield counter(
+            "vllm:gpu_prefix_cache_queries", "Prefix cache block queries", queries
+        )
+        yield counter(
+            "vllm:prompt_tokens", "Cumulative prompt tokens", s["prompt_tokens_total"]
+        )
+        yield counter(
+            "vllm:generation_tokens",
+            "Cumulative generated tokens",
+            s["generation_tokens_total"],
+        )
+
+
+_BUCKETS_TTFT = (
+    0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5, 0.75,
+    1.0, 2.5, 5.0, 7.5, 10.0,
+)
+_BUCKETS_E2E = (0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0,
+                40.0, 50.0, 60.0)
+
+
+_HISTOGRAMS: dict[str, Histogram] = {}
+
+
+def _histogram(name: str, doc: str, buckets) -> Histogram:
+    """Process-wide histogram cache: server restarts within one process
+    (tests, embedded use) must not re-register collectors."""
+    if name not in _HISTOGRAMS:
+        _HISTOGRAMS[name] = Histogram(name, doc, ["model_name"], buckets=buckets)
+    return _HISTOGRAMS[name]
+
+
+class ServerMetrics:
+    def __init__(self, engine: "LLMEngine", model_name: str):
+        self.collector = EngineStatsCollector(engine, model_name)
+        REGISTRY.register(self.collector)
+        self.model_name = model_name
+        self.ttft = _histogram(
+            "vllm:time_to_first_token_seconds", "Time to first token", _BUCKETS_TTFT
+        )
+        self.tpot = _histogram(
+            "vllm:time_per_output_token_seconds",
+            "Time per output token",
+            (0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3, 0.4, 0.5, 0.75, 1.0, 2.5),
+        )
+        self.e2e = _histogram(
+            "vllm:e2e_request_latency_seconds",
+            "End-to-end request latency",
+            _BUCKETS_E2E,
+        )
+
+    def ensure_registered(self) -> None:
+        try:
+            REGISTRY.register(self.collector)
+        except ValueError:
+            pass  # already registered
+
+    def unregister(self) -> None:
+        try:
+            REGISTRY.unregister(self.collector)
+        except Exception:
+            pass
+
+    def observe_request(self, start: float, first_token: float | None,
+                        end: float, n_output: int) -> None:
+        if first_token is not None:
+            self.ttft.labels(self.model_name).observe(first_token - start)
+            if n_output > 1:
+                self.tpot.labels(self.model_name).observe(
+                    (end - first_token) / (n_output - 1)
+                )
+        self.e2e.labels(self.model_name).observe(end - start)

@@ -1,0 +1,403 @@
+"""OpenAI-compatible engine server (aiohttp).
+
+The TPU-native stand-in for a vLLM engine pod: serves the OpenAI surface the
+reference router proxies to (reference endpoint list:
+src/vllm_router/routers/main_router.py:51-301) and the ``/metrics`` +
+``/v1/models`` + ``/health`` + sleep-family contract the router's service
+discovery and stats scraper depend on
+(src/vllm_router/service_discovery.py:504-623).
+
+Endpoints: /v1/completions, /v1/chat/completions (SSE streaming), /v1/models,
+/health, /version, /tokenize, /detokenize, /metrics, /sleep, /wake_up,
+/is_sleeping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
+
+from production_stack_tpu import __version__
+from production_stack_tpu.engine.async_engine import AsyncEngine
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.metrics import ServerMetrics
+from production_stack_tpu.engine.sampling import SamplingParams
+
+
+def _sampling_from_body(body: dict) -> SamplingParams:
+    stop = body.get("stop") or ()
+    if isinstance(stop, str):
+        stop = (stop,)
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens") or 16),
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", -1)),
+        seed=body.get("seed"),
+        stop=tuple(stop),
+        stop_token_ids=tuple(body.get("stop_token_ids") or ()),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+    )
+
+
+class EngineServer:
+    def __init__(self, config: EngineConfig, engine: Optional[LLMEngine] = None):
+        self.config = config
+        self.model_name = config.model.name
+        self.engine = engine or LLMEngine(config)
+        self.async_engine = AsyncEngine(self.engine)
+        self.metrics = ServerMetrics(self.engine, self.model_name)
+        self.start_time = time.time()
+
+    # -- app assembly --------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/version", self.version)
+        app.router.add_post("/tokenize", self.tokenize)
+        app.router.add_post("/detokenize", self.detokenize)
+        app.router.add_get("/metrics", self.prometheus)
+        app.router.add_post("/sleep", self.sleep)
+        app.router.add_post("/wake_up", self.wake_up)
+        app.router.add_get("/is_sleeping", self.is_sleeping)
+        app.on_startup.append(self._on_start)
+        app.on_cleanup.append(self._on_stop)
+        return app
+
+    async def _on_start(self, app) -> None:
+        self.metrics.ensure_registered()
+        await self.async_engine.start()
+
+    async def _on_stop(self, app) -> None:
+        self.async_engine.stop()
+        self.metrics.unregister()
+
+    # -- infra endpoints ------------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+    async def version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": self.model_name,
+                        "object": "model",
+                        "created": int(self.start_time),
+                        "owned_by": "production-stack-tpu",
+                        "root": self.model_name,
+                        "parent": None,
+                        "max_model_len": self.config.model.max_model_len,
+                    }
+                ],
+            }
+        )
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0]
+        )
+
+    async def tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        text = body.get("prompt") or body.get("text") or ""
+        ids = self.engine.tokenizer.encode(text, add_bos=bool(body.get("add_special_tokens", True)))
+        return web.json_response({"tokens": ids, "count": len(ids),
+                                  "max_model_len": self.config.model.max_model_len})
+
+    async def detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response({"prompt": self.engine.tokenizer.decode(body.get("tokens") or [])})
+
+    # -- sleep family ---------------------------------------------------------
+    async def sleep(self, request: web.Request) -> web.Response:
+        level = int(request.query.get("level", 1))
+        self.async_engine.sleep(level)
+        return web.json_response({"status": "sleeping", "level": level})
+
+    async def wake_up(self, request: web.Request) -> web.Response:
+        self.async_engine.wake_up()
+        return web.json_response({"status": "awake"})
+
+    async def is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.async_engine.is_sleeping})
+
+    # -- completions -----------------------------------------------------------
+    def _render_chat(self, messages: list[dict]) -> str:
+        tk = self.engine.tokenizer
+        if hasattr(tk, "tk") and getattr(tk.tk, "chat_template", None):
+            return tk.tk.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}" for m in messages]
+        return "\n".join(parts) + "\n<|assistant|>\n"
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON body"}}, status=400)
+        if "messages" not in body:
+            return web.json_response(
+                {"error": {"message": "'messages' is required"}}, status=400
+            )
+        prompt = self._render_chat(body["messages"])
+        return await self._run(request, body, prompt, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON body"}}, status=400)
+        prompt = body.get("prompt")
+        if prompt is None:
+            return web.json_response(
+                {"error": {"message": "'prompt' is required"}}, status=400
+            )
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
+            prompt = prompt[0]  # single-prompt batch only (parity: router sends one)
+        return await self._run(request, body, prompt, chat=False)
+
+    async def _run(self, request: web.Request, body: dict, prompt,
+                   chat: bool) -> web.StreamResponse:
+        sampling = _sampling_from_body(body)
+        tk = self.engine.tokenizer
+        if isinstance(prompt, str):
+            prompt_ids = tk.encode(prompt)
+        else:
+            prompt_ids = list(prompt)
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex}"
+        created = int(time.time())
+        model = body.get("model", self.model_name)
+        stream = bool(body.get("stream", False))
+        t_start = time.monotonic()
+
+        if len(prompt_ids) > self.config.model.max_model_len - 1:
+            return web.json_response(
+                {"error": {"message": "prompt too long", "type": "invalid_request_error"}},
+                status=400,
+            )
+
+        gen = self.async_engine.generate(prompt_ids, sampling, rid)
+        if stream:
+            return await self._stream_response(
+                request, gen, rid, created, model, chat, t_start, sampling
+            )
+        return await self._full_response(
+            gen, rid, created, model, chat, t_start, len(prompt_ids), sampling
+        )
+
+    def _check_stop_str(self, text: str, sampling: SamplingParams):
+        for s in sampling.stop:
+            idx = text.find(s)
+            if idx >= 0:
+                return text[:idx]
+        return None
+
+    async def _full_response(self, gen, rid, created, model, chat, t_start,
+                             n_prompt, sampling) -> web.Response:
+        tk = self.engine.tokenizer
+        token_ids: list[int] = []
+        finish_reason = None
+        first_token_t = None
+        cached = 0
+        try:
+            async for out in gen:
+                if first_token_t is None:
+                    first_token_t = time.monotonic()
+                token_ids.extend(out.new_token_ids)
+                cached = out.num_cached_tokens
+                finish_reason = out.finish_reason or finish_reason
+                text = tk.decode(token_ids)
+                stopped = self._check_stop_str(text, sampling)
+                if stopped is not None:
+                    self.async_engine.abort(rid)
+                    text = stopped
+                    finish_reason = "stop"
+                    break
+            else:
+                text = tk.decode(token_ids)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "invalid_request_error"}},
+                status=400,
+            )
+        end = time.monotonic()
+        self.metrics.observe_request(t_start, first_token_t, end, len(token_ids))
+        usage = {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": len(token_ids),
+            "total_tokens": n_prompt + len(token_ids),
+            "prompt_tokens_details": {"cached_tokens": cached},
+        }
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason or "stop",
+            }
+            obj = "chat.completion"
+        else:
+            choice = {
+                "index": 0,
+                "text": text,
+                "finish_reason": finish_reason or "stop",
+                "logprobs": None,
+            }
+            obj = "text_completion"
+        return web.json_response(
+            {
+                "id": rid,
+                "object": obj,
+                "created": created,
+                "model": model,
+                "choices": [choice],
+                "usage": usage,
+            }
+        )
+
+    async def _stream_response(self, request, gen, rid, created, model, chat,
+                               t_start, sampling) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": rid,
+            },
+        )
+        await resp.prepare(request)
+        tk = self.engine.tokenizer
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        async def send(payload: dict) -> None:
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+
+        if chat:
+            await send(
+                {
+                    "id": rid, "object": obj, "created": created, "model": model,
+                    "choices": [
+                        {"index": 0, "delta": {"role": "assistant"},
+                         "finish_reason": None}
+                    ],
+                }
+            )
+
+        token_ids: list[int] = []
+        sent_len = 0
+        first_token_t = None
+        finish_reason = None
+        n_out = 0
+        try:
+            async for out in gen:
+                if first_token_t is None:
+                    first_token_t = time.monotonic()
+                token_ids.extend(out.new_token_ids)
+                n_out = out.num_output_tokens
+                text = tk.decode(token_ids)
+                stopped = self._check_stop_str(text, sampling)
+                if stopped is not None:
+                    self.async_engine.abort(rid)
+                    text = stopped
+                    finish_reason = "stop"
+                delta = text[sent_len:]
+                sent_len = len(text)
+                if delta or out.finished or finish_reason:
+                    fr = finish_reason or out.finish_reason
+                    done = out.finished or finish_reason is not None
+                    if chat:
+                        choice = {"index": 0, "delta": {"content": delta} if delta else {},
+                                  "finish_reason": fr if done else None}
+                    else:
+                        choice = {"index": 0, "text": delta, "logprobs": None,
+                                  "finish_reason": fr if done else None}
+                    await send(
+                        {"id": rid, "object": obj, "created": created,
+                         "model": model, "choices": [choice]}
+                    )
+                if finish_reason is not None:
+                    break
+        except ValueError as e:
+            await send({"error": {"message": str(e)}})
+        except (ConnectionResetError, asyncio.CancelledError):
+            self.async_engine.abort(rid)
+            raise
+        end = time.monotonic()
+        self.metrics.observe_request(t_start, first_token_t, end, n_out)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("production-stack-tpu engine server")
+    p.add_argument("--model", default="tiny-llama",
+                   help="preset name or local HF model directory")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--max-num-seqs", type=int, default=None)
+    p.add_argument("--block-size", type=int, default=None)
+    p.add_argument("--num-blocks", type=int, default=None)
+    p.add_argument("--tensor-parallel-size", type=int, default=-1)
+    p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument("--dtype", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--served-model-name", default=None)
+    return p
+
+
+def config_from_args(args) -> EngineConfig:
+    import dataclasses
+
+    from production_stack_tpu.parallel.mesh import MeshConfig
+
+    overrides = {}
+    if args.max_model_len:
+        overrides["max_model_len"] = args.max_model_len
+    if args.dtype:
+        overrides["dtype"] = args.dtype
+    cfg = EngineConfig.for_model(args.model, **overrides)
+    if args.served_model_name:
+        cfg.model = dataclasses.replace(cfg.model, name=args.served_model_name)
+    if args.max_num_seqs:
+        cfg.scheduler.max_num_seqs = args.max_num_seqs
+    if args.block_size:
+        cfg.cache.block_size = args.block_size
+    if args.num_blocks:
+        cfg.cache.num_blocks = args.num_blocks
+    cfg.mesh = MeshConfig(
+        data=args.data_parallel_size, tensor=args.tensor_parallel_size
+    )
+    cfg.seed = args.seed
+    return cfg
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    config = config_from_args(args)
+    server = EngineServer(config)
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                access_log=None)
+
+
+if __name__ == "__main__":
+    main()

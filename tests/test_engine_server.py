@@ -1,0 +1,185 @@
+"""Engine server tests: OpenAI surface + metrics/discovery contract, over a
+real (tiny) engine on CPU."""
+
+import asyncio
+import json
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.server import EngineServer
+from production_stack_tpu.parallel.mesh import MeshConfig
+
+
+def make_server() -> EngineServer:
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=512),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64,
+            prefill_buckets=(32, 64, 128),
+        ),
+        mesh=MeshConfig(data=1, tensor=1),
+    )
+    return EngineServer(cfg)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(scope="module")
+def server():
+    return make_server()
+
+
+async def with_client(server, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async with TestClient(TestServer(server.build_app())) as client:
+        return await fn(client)
+
+
+def test_infra_endpoints(server):
+    async def fn(client):
+        r = await client.get("/health")
+        assert r.status == 200 and (await r.json())["status"] == "healthy"
+        r = await client.get("/version")
+        assert r.status == 200
+        r = await client.get("/v1/models")
+        data = await r.json()
+        assert data["data"][0]["id"] == "tiny-llama"
+        r = await client.post("/tokenize", json={"prompt": "hi"})
+        toks = (await r.json())["tokens"]
+        assert toks[0] == 256  # bos
+        r = await client.post("/detokenize", json={"tokens": toks})
+        assert (await r.json())["prompt"] == "hi"
+
+    run(with_client(server, fn))
+
+
+def test_completion_non_streaming(server):
+    async def fn(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": "hello world",
+                  "max_tokens": 6, "temperature": 0, "ignore_eos": True},
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "text_completion"
+        assert data["usage"]["completion_tokens"] == 6
+        assert data["choices"][0]["finish_reason"] == "length"
+
+    run(with_client(server, fn))
+
+
+def test_chat_completion_streaming(server):
+    async def fn(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5, "temperature": 0, "stream": True,
+                "ignore_eos": True,
+            },
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        chunks = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                chunks.append(line[6:])
+        assert chunks[-1] == "[DONE]"
+        parsed = [json.loads(c) for c in chunks[:-1]]
+        assert parsed[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert parsed[-1]["choices"][0]["finish_reason"] == "length"
+
+    run(with_client(server, fn))
+
+
+def test_metrics_exposition_contract(server):
+    """The exact sample names the reference router parses
+    (engine_stats.py:63-76) must be present."""
+
+    async def fn(client):
+        await client.post(
+            "/v1/completions",
+            json={"prompt": "abc", "max_tokens": 3, "temperature": 0,
+                  "ignore_eos": True},
+        )
+        r = await client.get("/metrics")
+        text = await r.text()
+        for name in (
+            "vllm:num_requests_running",
+            "vllm:num_requests_waiting",
+            "vllm:gpu_cache_usage_perc",
+            "vllm:gpu_prefix_cache_hit_rate",
+            "vllm:gpu_prefix_cache_hits_total",
+            "vllm:gpu_prefix_cache_queries_total",
+            "vllm:time_to_first_token_seconds",
+            "vllm:e2e_request_latency_seconds",
+        ):
+            assert name in text, f"missing metric {name}"
+        # parseable by the same parser the reference uses
+        from prometheus_client.parser import text_string_to_metric_families
+
+        names = {
+            s.name
+            for fam in text_string_to_metric_families(text)
+            for s in fam.samples
+        }
+        assert "vllm:num_requests_running" in names
+        assert "vllm:gpu_prefix_cache_hits_total" in names
+
+    run(with_client(server, fn))
+
+
+def test_sleep_wake(server):
+    async def fn(client):
+        r = await client.get("/is_sleeping")
+        assert (await r.json())["is_sleeping"] is False
+        await client.post("/sleep")
+        r = await client.get("/is_sleeping")
+        assert (await r.json())["is_sleeping"] is True
+        await client.post("/wake_up")
+        r = await client.get("/is_sleeping")
+        assert (await r.json())["is_sleeping"] is False
+
+    run(with_client(server, fn))
+
+
+def test_errors(server):
+    async def fn(client):
+        r = await client.post("/v1/completions", json={"max_tokens": 3})
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={"prompt": "x"})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "x" * 2000, "max_tokens": 1},
+        )
+        assert r.status == 400  # longer than tiny max_model_len
+
+    run(with_client(server, fn))
+
+
+def test_stop_string(server):
+    async def fn(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "hello", "max_tokens": 8, "temperature": 0,
+                  "ignore_eos": True, "stop": ["\x00"]},
+        )
+        data = await r.json()
+        assert r.status == 200
+        assert "\x00" not in data["choices"][0]["text"]
+
+    run(with_client(server, fn))
