@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import TYPE_CHECKING
 
-from prometheus_client import Histogram, REGISTRY
+from prometheus_client import CollectorRegistry, Histogram
 from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 
 if TYPE_CHECKING:
@@ -85,47 +85,45 @@ _BUCKETS_E2E = (0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0,
                 40.0, 50.0, 60.0)
 
 
-_HISTOGRAMS: dict[str, Histogram] = {}
-
-
-def _histogram(name: str, doc: str, buckets) -> Histogram:
-    """Process-wide histogram cache: server restarts within one process
-    (tests, embedded use) must not re-register collectors."""
-    if name not in _HISTOGRAMS:
-        _HISTOGRAMS[name] = Histogram(name, doc, ["model_name"], buckets=buckets)
-    return _HISTOGRAMS[name]
-
-
 class ServerMetrics:
+    """Engine-local metrics on a private CollectorRegistry: an engine pod is
+    its own process in production, and a private registry keeps in-process
+    test topologies (router + engines in one interpreter) collision-free."""
+
     def __init__(self, engine: "LLMEngine", model_name: str):
+        self.registry = CollectorRegistry()
         self.collector = EngineStatsCollector(engine, model_name)
-        REGISTRY.register(self.collector)
+        self.registry.register(self.collector)
         self.model_name = model_name
-        self.ttft = _histogram(
+
+        def hist(name, doc, buckets):
+            return Histogram(name, doc, ["model_name"], buckets=buckets,
+                             registry=self.registry)
+
+        self.ttft = hist(
             "vllm:time_to_first_token_seconds", "Time to first token", _BUCKETS_TTFT
         )
-        self.tpot = _histogram(
+        self.tpot = hist(
             "vllm:time_per_output_token_seconds",
             "Time per output token",
             (0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3, 0.4, 0.5, 0.75, 1.0, 2.5),
         )
-        self.e2e = _histogram(
+        self.e2e = hist(
             "vllm:e2e_request_latency_seconds",
             "End-to-end request latency",
             _BUCKETS_E2E,
         )
 
+    def generate(self) -> bytes:
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)
+
     def ensure_registered(self) -> None:
-        try:
-            REGISTRY.register(self.collector)
-        except ValueError:
-            pass  # already registered
+        pass  # private registry — nothing global to re-register
 
     def unregister(self) -> None:
-        try:
-            REGISTRY.unregister(self.collector)
-        except Exception:
-            pass
+        pass
 
     def observe_request(self, start: float, first_token: float | None,
                         end: float, n_output: int) -> None:

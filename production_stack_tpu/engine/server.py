@@ -70,6 +70,7 @@ class EngineServer:
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/detokenize", self.detokenize)
         app.router.add_get("/metrics", self.prometheus)
+        app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/sleep", self.sleep)
         app.router.add_post("/wake_up", self.wake_up)
         app.router.add_get("/is_sleeping", self.is_sleeping)
@@ -112,7 +113,8 @@ class EngineServer:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(
-            body=generate_latest(), content_type=CONTENT_TYPE_LATEST.split(";")[0]
+            body=self.metrics.generate(),
+            content_type=CONTENT_TYPE_LATEST.split(";")[0],
         )
 
     async def tokenize(self, request: web.Request) -> web.Response:
@@ -121,6 +123,22 @@ class EngineServer:
         ids = self.engine.tokenizer.encode(text, add_bos=bool(body.get("add_special_tokens", True)))
         return web.json_response({"tokens": ids, "count": len(ids),
                                   "max_model_len": self.config.model.max_model_len})
+
+    async def kv_lookup(self, request: web.Request) -> web.Response:
+        """KV-aware routing contract: how many tokens of this prompt would
+        prefix-hit the paged HBM cache right now. Answered from the
+        allocator's content-hash table — the TPU-native replacement for the
+        reference's LMCache controller LookupMsg channel
+        (src/vllm_router/routers/routing_logic.py:377-405)."""
+        body = await request.json()
+        if "tokens" in body:
+            ids = list(body["tokens"])
+        else:
+            ids = self.engine.tokenizer.encode(body.get("prompt") or "")
+        _, matched = self.engine.scheduler.allocator.match_prefix(ids)
+        return web.json_response(
+            {"matched_tokens": matched, "total_tokens": len(ids)}
+        )
 
     async def detokenize(self, request: web.Request) -> web.Response:
         body = await request.json()

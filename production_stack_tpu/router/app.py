@@ -1,0 +1,373 @@
+"""Router app assembly + CLI.
+
+Reference: src/vllm_router/app.py (initialize_all, lifespan, main) and
+parsers/parser.py (flag surface). The API surface proxied to engines mirrors
+routers/main_router.py:51-301: every OpenAI-style POST endpoint goes through
+the same general request path; infra endpoints are served locally.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+from prometheus_client import generate_latest
+
+from production_stack_tpu import __version__
+from production_stack_tpu.router import metrics as m
+from production_stack_tpu.router.log import init_logger, set_log_level
+from production_stack_tpu.router.protocols import model_card
+from production_stack_tpu.router.request_service import RequestService
+from production_stack_tpu.router.routing import (
+    ROUTING_LOGICS,
+    get_routing_logic,
+    initialize_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    ExternalOnlyServiceDiscovery,
+    K8sPodIPServiceDiscovery,
+    StaticServiceDiscovery,
+    get_service_discovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.stats import (
+    get_engine_stats_scraper,
+    get_request_stats_monitor,
+    initialize_engine_stats_scraper,
+    initialize_request_stats_monitor,
+)
+
+logger = init_logger(__name__)
+
+# every data-plane path is proxied through the same general request service
+# (reference endpoint list: routers/main_router.py:51-301)
+PROXY_POST_PATHS = (
+    "/v1/chat/completions",
+    "/v1/completions",
+    "/v1/embeddings",
+    "/v1/rerank",
+    "/rerank",
+    "/v1/score",
+    "/score",
+    "/v1/responses",
+    "/v1/messages",
+    "/v1/audio/transcriptions",
+    "/v1/audio/translations",
+    "/v1/audio/speech",
+    "/v1/images/generations",
+    "/v1/images/edits",
+    "/pooling",
+    "/classify",
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-router")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8001)
+    # service discovery
+    p.add_argument("--service-discovery", default="static",
+                   choices=["static", "k8s_pod_ip", "external_only"])
+    p.add_argument("--static-backends", default="",
+                   help="comma-separated engine base URLs")
+    p.add_argument("--static-models", default="",
+                   help="comma-separated model name per backend")
+    p.add_argument("--static-model-labels", default="",
+                   help="comma-separated label per backend (prefill/decode/...)")
+    p.add_argument("--static-backend-health-checks", action="store_true")
+    p.add_argument("--health-check-interval", type=float, default=10.0)
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-label-selector", default="")
+    p.add_argument("--k8s-port", type=int, default=8000)
+    p.add_argument("--k8s-api-server", default=None)
+    # routing
+    p.add_argument("--routing-logic", default="roundrobin", choices=ROUTING_LOGICS)
+    p.add_argument("--session-key", default="x-user-id")
+    p.add_argument("--prefix-min-match-length", type=int, default=0)
+    p.add_argument("--kv-aware-threshold", type=int, default=2000)
+    p.add_argument("--prefill-model-label", default="prefill")
+    p.add_argument("--decode-model-label", default="decode")
+    p.add_argument("--max-instance-failover-reroute-attempts", type=int, default=0)
+    # stats
+    p.add_argument("--engine-stats-interval", type=float, default=10.0)
+    p.add_argument("--request-stats-window", type=float, default=60.0)
+    p.add_argument("--log-stats", action="store_true")
+    p.add_argument("--log-stats-interval", type=float, default=30.0)
+    # misc
+    p.add_argument("--model-aliases", default=None,
+                   help='JSON object, e.g. {"gpt-4": "llama-3-8b"}')
+    p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("--log-level", default="info")
+    p.add_argument("--dynamic-config-file", default=None)
+    p.add_argument("--feature-gates", default="",
+                   help="Feature=bool[,Feature=bool...]")
+    p.add_argument("--callbacks", default=None,
+                   help="module.attribute of a custom callback handler")
+    p.add_argument("--semantic-cache-threshold", type=float, default=0.92)
+    p.add_argument("--external-providers-config", default=None,
+                   help="YAML file mapping model ids to external providers")
+    p.add_argument("--api-key-file", default=None)
+    return p
+
+
+class RouterApp:
+    def __init__(self, args):
+        self.args = args
+        self.start_time = time.time()
+        self.request_service: Optional[RequestService] = None
+        self.semantic_cache = None
+        self.pii_middleware = None
+        self._log_stats_task: Optional[asyncio.Task] = None
+
+    # -- initialization (reference: app.py initialize_all) -------------------
+    def initialize(self) -> None:
+        args = self.args
+        set_log_level(args.log_level)
+
+        if args.service_discovery == "static":
+            urls = [u for u in args.static_backends.split(",") if u]
+            models = [x for x in args.static_models.split(",") if x]
+            labels = [x for x in args.static_model_labels.split(",") if x] or None
+            if len(models) == 1 and len(urls) > 1:
+                models = models * len(urls)
+            initialize_service_discovery(
+                StaticServiceDiscovery(
+                    urls, models, labels,
+                    health_check=args.static_backend_health_checks,
+                    health_check_interval=args.health_check_interval,
+                )
+            )
+        elif args.service_discovery == "k8s_pod_ip":
+            initialize_service_discovery(
+                K8sPodIPServiceDiscovery(
+                    namespace=args.k8s_namespace,
+                    label_selector=args.k8s_label_selector,
+                    port=args.k8s_port,
+                    api_server=args.k8s_api_server,
+                )
+            )
+        else:
+            initialize_service_discovery(ExternalOnlyServiceDiscovery())
+
+        initialize_engine_stats_scraper(args.engine_stats_interval)
+        initialize_request_stats_monitor(args.request_stats_window)
+
+        routing_kwargs = {
+            "session_key": args.session_key,
+            "prefix_min_match_length": args.prefix_min_match_length,
+            "kv_aware_threshold": args.kv_aware_threshold,
+            "prefill_label": args.prefill_model_label,
+            "decode_label": args.decode_model_label,
+        }
+        initialize_routing_logic(args.routing_logic, **routing_kwargs)
+
+        aliases = json.loads(args.model_aliases) if args.model_aliases else {}
+        callbacks = None
+        if args.callbacks:
+            from production_stack_tpu.router.services.callbacks import (
+                load_callbacks,
+            )
+
+            callbacks = load_callbacks(args.callbacks)
+        external = None
+        if args.external_providers_config:
+            from production_stack_tpu.router.services.external_providers import (
+                ExternalProviderRegistry,
+            )
+
+            external = ExternalProviderRegistry.from_yaml(
+                args.external_providers_config
+            )
+        from production_stack_tpu.router.services.rewriter import get_rewriter
+
+        self.request_service = RequestService(
+            max_failover_attempts=args.max_instance_failover_reroute_attempts,
+            request_timeout=args.request_timeout,
+            model_aliases=aliases,
+            rewriter=get_rewriter(),
+            callbacks=callbacks,
+            external_providers=external,
+        )
+
+        from production_stack_tpu.router.experimental.feature_gates import (
+            initialize_feature_gates,
+            get_feature_gates,
+        )
+
+        initialize_feature_gates(args.feature_gates)
+        gates = get_feature_gates()
+        if gates.enabled("SemanticCache"):
+            from production_stack_tpu.router.experimental.semantic_cache import (
+                SemanticCache,
+            )
+
+            self.semantic_cache = SemanticCache(
+                threshold=args.semantic_cache_threshold
+            )
+            self.request_service.post_response = self.semantic_cache.store
+        if gates.enabled("PIIDetection"):
+            from production_stack_tpu.router.experimental.pii import PIIMiddleware
+
+            self.pii_middleware = PIIMiddleware()
+
+    # -- app --------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        self.initialize()
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        for path in PROXY_POST_PATHS:
+            app.router.add_post(path, self._make_proxy(path))
+        app.router.add_post("/tokenize", self._make_proxy("/tokenize"))
+        app.router.add_post("/detokenize", self._make_proxy("/detokenize"))
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/version", self.version)
+        app.router.add_get("/engines", self.engines)
+        app.router.add_get("/metrics", self.prometheus)
+        async def _sleep(r):
+            return await self.request_service.sleep_wake(r, "sleep")
+
+        async def _wake(r):
+            return await self.request_service.sleep_wake(r, "wake_up")
+
+        async def _is_sleeping(r):
+            return await self.request_service.sleep_wake(r, "is_sleeping")
+
+        app.router.add_post("/sleep", _sleep)
+        app.router.add_post("/wake_up", _wake)
+        app.router.add_get("/is_sleeping", _is_sleeping)
+        app.on_startup.append(self._on_start)
+        app.on_cleanup.append(self._on_stop)
+        return app
+
+    def _make_proxy(self, path: str):
+        async def handler(request: web.Request) -> web.StreamResponse:
+            if self.pii_middleware is not None:
+                blocked = await self.pii_middleware.check(request)
+                if blocked is not None:
+                    return blocked
+            if self.semantic_cache is not None and path == "/v1/chat/completions":
+                hit = await self.semantic_cache.lookup(request)
+                if hit is not None:
+                    return hit
+            resp = await self.request_service.route_general_request(request, path)
+            return resp
+
+        return handler
+
+    async def _on_start(self, app) -> None:
+        await get_service_discovery().start()
+        await get_engine_stats_scraper().start()
+        await self.request_service.start()
+        if self.args.dynamic_config_file:
+            from production_stack_tpu.router.dynamic_config import (
+                DynamicConfigWatcher,
+            )
+
+            self._dyn = DynamicConfigWatcher(self.args.dynamic_config_file)
+            await self._dyn.start()
+        if self.args.log_stats:
+            self._log_stats_task = asyncio.create_task(self._log_stats_worker())
+
+    async def _on_stop(self, app) -> None:
+        await get_service_discovery().stop()
+        await get_engine_stats_scraper().stop()
+        await self.request_service.stop()
+        await get_routing_logic().close()
+        if self._log_stats_task:
+            self._log_stats_task.cancel()
+
+    async def _log_stats_worker(self) -> None:
+        while True:
+            await asyncio.sleep(self.args.log_stats_interval)
+            es = get_engine_stats_scraper().get_engine_stats()
+            rs = get_request_stats_monitor().get_request_stats()
+            for url in {*es, *rs}:
+                e, r = es.get(url), rs.get(url)
+                logger.info(
+                    "stats %s: running=%s waiting=%s kv=%.1f%% qps=%.2f ttft=%.3f",
+                    url,
+                    e.num_running_requests if e else "-",
+                    e.num_queuing_requests if e else "-",
+                    (e.gpu_cache_usage_perc * 100) if e else 0.0,
+                    r.qps if r else -1,
+                    r.ttft if r else -1,
+                )
+
+    # -- infra endpoints ------------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        discovery_ok = get_service_discovery().get_health()
+        scraper_ok = get_engine_stats_scraper().get_health()
+        if discovery_ok and scraper_ok:
+            return web.json_response({"status": "healthy"})
+        return web.json_response(
+            {"status": "unhealthy", "discovery": discovery_ok, "scraper": scraper_ok},
+            status=503,
+        )
+
+    async def version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    async def models(self, request: web.Request) -> web.Response:
+        cards, seen = [], set()
+        for ep in get_service_discovery().get_endpoint_info():
+            for name in ep.model_names:
+                if name not in seen:
+                    seen.add(name)
+                    info = ep.model_info.get(name)
+                    cards.append(
+                        model_card(
+                            name,
+                            created=int(ep.added_timestamp),
+                            parent=info.parent if info else None,
+                        )
+                    )
+        if self.request_service:
+            for alias, target in self.request_service.model_aliases.items():
+                if alias not in seen and target in seen:
+                    cards.append(model_card(alias))
+        return web.json_response({"object": "list", "data": cards})
+
+    async def engines(self, request: web.Request) -> web.Response:
+        es = get_engine_stats_scraper().get_engine_stats()
+        rs = get_request_stats_monitor().get_request_stats()
+        out = []
+        for ep in get_service_discovery().get_endpoint_info():
+            e, r = es.get(ep.url), rs.get(ep.url)
+            out.append(
+                {
+                    "url": ep.url,
+                    "models": ep.model_names,
+                    "model_label": ep.model_label,
+                    "sleep": ep.sleep,
+                    "engine_stats": e.__dict__ if e else None,
+                    "request_stats": r.__dict__ if r else None,
+                }
+            )
+        return web.json_response({"engines": out})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        m.refresh_label_gauges(
+            get_engine_stats_scraper().get_engine_stats(),
+            get_request_stats_monitor().get_request_stats(),
+        )
+        m.healthy_pods_total.labels(server="router").set(
+            len(get_service_discovery().get_endpoint_info())
+        )
+        m.refresh_self_metrics()
+        return web.Response(body=generate_latest(), content_type="text/plain")
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    router = RouterApp(args)
+    logger.info("tpu-router %s starting on %s:%d", __version__, args.host, args.port)
+    web.run_app(router.build_app(), host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

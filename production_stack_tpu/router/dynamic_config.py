@@ -1,0 +1,99 @@
+"""Hot-reload of router configuration from a JSON/YAML file.
+
+Polls the file (default every 10 s, reference interval dynamic_config.py:263),
+diffs, and live-reconfigures service discovery, routing logic and model
+aliases without restarting (reference: src/vllm_router/dynamic_config.py:
+43-296).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Optional
+
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.routing import reconfigure_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    StaticServiceDiscovery,
+    get_service_discovery,
+    initialize_service_discovery,
+)
+
+logger = init_logger(__name__)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    return json.loads(text)
+
+
+class DynamicConfigWatcher:
+    def __init__(self, path: str, interval: float = 10.0,
+                 request_service=None):
+        self.path = path
+        self.interval = interval
+        self.request_service = request_service
+        self.current: dict = {}
+        self._mtime = 0.0
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._apply_if_changed()  # initial load
+        self._task = asyncio.create_task(self._worker())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def _worker(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                self._apply_if_changed()
+            except Exception as e:
+                logger.error("dynamic config reload failed: %s", e)
+
+    def _apply_if_changed(self) -> None:
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            return
+        if mtime == self._mtime:
+            return
+        self._mtime = mtime
+        new = _load(self.path)
+        if new == self.current:
+            return
+        logger.info("dynamic config changed; reconfiguring")
+        self.apply(new)
+        self.current = new
+
+    def apply(self, cfg: dict) -> None:
+        if "static_backends" in cfg:
+            urls = [u for u in cfg["static_backends"].split(",") if u]
+            models = [x for x in cfg.get("static_models", "").split(",") if x]
+            if len(models) == 1 and len(urls) > 1:
+                models = models * len(urls)
+            labels = [x for x in cfg.get("static_model_labels", "").split(",") if x] or None
+            old = get_service_discovery()
+            known = set(old.known_models)
+            sd = StaticServiceDiscovery(urls, models, labels)
+            sd.known_models |= known
+            initialize_service_discovery(sd)
+            logger.info("service discovery reconfigured: %s", urls)
+        if "routing_logic" in cfg:
+            reconfigure_routing_logic(
+                cfg["routing_logic"],
+                session_key=cfg.get("session_key", "x-user-id"),
+                prefix_min_match_length=cfg.get("prefix_min_match_length", 0),
+                kv_aware_threshold=cfg.get("kv_aware_threshold", 2000),
+            )
+        if "model_aliases" in cfg and self.request_service is not None:
+            self.request_service.model_aliases = dict(cfg["model_aliases"])

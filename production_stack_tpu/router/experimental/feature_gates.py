@@ -1,0 +1,81 @@
+"""Feature gates: ``--feature-gates SemanticCache=true,PIIDetection=false``
+with maturity stages (reference: src/vllm_router/experimental/
+feature_gates.py:16-109)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    ALPHA = "alpha"
+    BETA = "beta"
+    GA = "ga"
+
+
+@dataclasses.dataclass(frozen=True)
+class Feature:
+    name: str
+    stage: Stage
+    default: bool = False
+
+
+KNOWN_FEATURES = {
+    f.name: f
+    for f in (
+        Feature("SemanticCache", Stage.ALPHA),
+        Feature("PIIDetection", Stage.ALPHA),
+        Feature("Tracing", Stage.ALPHA),
+        Feature("KVOffload", Stage.BETA),
+    )
+}
+
+
+class FeatureGates:
+    def __init__(self, spec: str = ""):
+        self.values: dict[str, bool] = {
+            name: f.default for name, f in KNOWN_FEATURES.items()
+        }
+        for item in (spec or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(f"feature gate {item!r} must be Name=bool")
+            name, _, raw = item.partition("=")
+            if name not in KNOWN_FEATURES:
+                raise ValueError(
+                    f"unknown feature gate {name!r}; known: {sorted(KNOWN_FEATURES)}"
+                )
+            if raw.lower() not in ("true", "false"):
+                raise ValueError(f"feature gate {name}: value must be true/false")
+            self.values[name] = raw.lower() == "true"
+            logger.info(
+                "feature gate %s=%s (stage=%s)", name, self.values[name],
+                KNOWN_FEATURES[name].stage.value,
+            )
+
+    def enabled(self, name: str) -> bool:
+        return self.values.get(name, False)
+
+
+_gates: Optional[FeatureGates] = None
+
+
+def initialize_feature_gates(spec: str = "") -> FeatureGates:
+    global _gates
+    _gates = FeatureGates(spec)
+    return _gates
+
+
+def get_feature_gates() -> FeatureGates:
+    global _gates
+    if _gates is None:
+        _gates = FeatureGates("")
+    return _gates

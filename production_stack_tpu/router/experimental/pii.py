@@ -1,0 +1,89 @@
+"""PII detection middleware: regex analyzer over prompt/message content with
+block or redact actions (reference: src/vllm_router/experimental/pii/
+middleware.py:43-101 + analyzers/regex.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+PATTERNS = {
+    "EMAIL": re.compile(r"\b[\w.+-]+@[\w-]+\.[\w.-]+\b"),
+    "PHONE": re.compile(r"\b(?:\+?1[-. ]?)?\(?\d{3}\)?[-. ]?\d{3}[-. ]?\d{4}\b"),
+    "SSN": re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    "CREDIT_CARD": re.compile(r"\b(?:\d[ -]?){13,16}\b"),
+    "IP_ADDRESS": re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    "API_KEY": re.compile(r"\b(?:sk|pk|rk)[-_][A-Za-z0-9]{16,}\b"),
+}
+
+
+@dataclasses.dataclass
+class PIIMatch:
+    kind: str
+    value: str
+
+
+class RegexAnalyzer:
+    def __init__(self, kinds: Optional[set[str]] = None):
+        self.kinds = kinds or set(PATTERNS)
+
+    def analyze(self, text: str) -> list[PIIMatch]:
+        out = []
+        for kind in self.kinds:
+            for match in PATTERNS[kind].finditer(text):
+                out.append(PIIMatch(kind, match.group()))
+        return out
+
+    def redact(self, text: str) -> str:
+        for kind in self.kinds:
+            text = PATTERNS[kind].sub(f"[{kind}]", text)
+        return text
+
+
+class PIIMiddleware:
+    def __init__(self, action: str = "block", analyzer: Optional[RegexAnalyzer] = None):
+        assert action in ("block", "redact")
+        self.action = action
+        self.analyzer = analyzer or RegexAnalyzer()
+
+    @staticmethod
+    def _texts(body: dict):
+        if "messages" in body:
+            for msg in body.get("messages") or []:
+                if isinstance(msg.get("content"), str):
+                    yield msg, "content"
+        elif isinstance(body.get("prompt"), str):
+            yield body, "prompt"
+
+    async def check(self, request: web.Request) -> Optional[web.Response]:
+        """Returns a blocking response, or None to let the request through
+        (after in-place redaction when action == redact)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return None
+        found: list[PIIMatch] = []
+        for holder, key in self._texts(body):
+            matches = self.analyzer.analyze(holder[key])
+            found.extend(matches)
+            if matches and self.action == "redact":
+                holder[key] = self.analyzer.redact(holder[key])
+        if not found:
+            return None
+        if self.action == "block":
+            kinds = sorted({f.kind for f in found})
+            logger.warning("request blocked: PII detected (%s)", ",".join(kinds))
+            return web.json_response(
+                {"error": {"message": f"request contains PII ({', '.join(kinds)})",
+                           "type": "pii_detected"}},
+                status=400,
+            )
+        request["rewritten_body"] = body
+        return None

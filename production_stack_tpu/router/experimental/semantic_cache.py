@@ -1,0 +1,96 @@
+"""Semantic response cache (dependency-free).
+
+The reference uses sentence-transformers + FAISS
+(src/vllm_router/experimental/semantic_cache/semantic_cache.py:16-346); in a
+zero-egress TPU image we embed with hashed character n-grams (TF-IDF-ish,
+L2-normalised, no model download) and brute-force cosine over numpy — exact
+for the cache sizes a router holds, and trivially swappable for a real
+encoder when one is mounted.
+
+Checked pre-route for /v1/chat/completions; non-streaming responses are
+stored post-response via the request service's post_response hook.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+import numpy as np
+import xxhash
+from aiohttp import web
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+_DIM = 1024
+
+
+def embed(text: str, n: int = 3) -> np.ndarray:
+    vec = np.zeros(_DIM, np.float32)
+    t = text.lower()
+    for i in range(max(len(t) - n + 1, 1)):
+        h = xxhash.xxh64(t[i : i + n]).intdigest()
+        vec[h % _DIM] += 1.0
+    norm = np.linalg.norm(vec)
+    return vec / norm if norm > 0 else vec
+
+
+class SemanticCache:
+    def __init__(self, threshold: float = 0.92, max_entries: int = 4096):
+        self.threshold = threshold
+        self.max_entries = max_entries
+        self.vectors = np.zeros((0, _DIM), np.float32)
+        self.entries: list[dict] = []
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _prompt_of(body: dict) -> str:
+        msgs = body.get("messages") or []
+        return "\n".join(str(m.get("content", "")) for m in msgs)
+
+    async def lookup(self, request: web.Request) -> Optional[web.Response]:
+        try:
+            body = await request.json()
+        except Exception:
+            return None
+        if body.get("stream"):
+            return None
+        prompt = self._prompt_of(body)
+        if not prompt or not self.entries:
+            self.misses += 1
+            return None
+        q = embed(prompt)
+        sims = self.vectors @ q
+        best = int(np.argmax(sims))
+        if sims[best] >= self.threshold and self.entries[best]["model"] == body.get("model"):
+            self.hits += 1
+            cached = dict(self.entries[best]["response"])
+            cached["cached"] = True
+            return web.json_response(cached)
+        self.misses += 1
+        return None
+
+    def store(self, body: dict, response_tail: bytes) -> None:
+        if body.get("stream"):
+            return
+        prompt = self._prompt_of(body)
+        if not prompt:
+            return
+        try:
+            response = json.loads(response_tail)
+        except Exception:
+            return
+        if "choices" not in response:
+            return
+        vec = embed(prompt)
+        self.entries.append(
+            {"model": body.get("model"), "response": response, "ts": time.time()}
+        )
+        self.vectors = np.vstack([self.vectors, vec[None]])
+        if len(self.entries) > self.max_entries:
+            self.entries.pop(0)
+            self.vectors = self.vectors[1:]

@@ -1,0 +1,54 @@
+"""Consistent hash ring (dependency-free stand-in for uhashring, which the
+reference's SessionRouter uses — routing_logic.py:198-249).
+
+Virtual nodes smooth the distribution; xxhash for speed. Adding/removing a
+node only remaps the keys adjacent to its virtual points — the property
+session affinity needs when replicas scale up/down.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import xxhash
+
+
+class ConsistentHashRing:
+    def __init__(self, vnodes: int = 100):
+        self.vnodes = vnodes
+        self._ring: list[tuple[int, str]] = []  # sorted (hash, node)
+        self._nodes: set[str] = set()
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return xxhash.xxh64(key.encode()).intdigest()
+
+    def get_nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def add_node(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            h = self._hash(f"{node}#{i}")
+            bisect.insort(self._ring, (h, node))
+
+    def remove_node(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._ring = [(h, n) for h, n in self._ring if n != node]
+
+    def sync(self, nodes: set[str]) -> None:
+        for node in self._nodes - nodes:
+            self.remove_node(node)
+        for node in nodes - self._nodes:
+            self.add_node(node)
+
+    def get_node(self, key: str) -> str | None:
+        if not self._ring:
+            return None
+        h = self._hash(key)
+        idx = bisect.bisect_right(self._ring, (h, "")) % len(self._ring)
+        return self._ring[idx][1]

@@ -1,0 +1,78 @@
+"""Chunk-hash prefix trie for prefix-aware routing.
+
+Same observable semantics as the reference trie
+(src/vllm_router/prefix/hashtrie.py:36-104): prompts are chunked (128 chars),
+each chunk xxhash64-ed, the hash chain forms a trie path and every node
+remembers which endpoints have served a prompt through it. Implementation
+differs: no per-node asyncio locks — all mutation happens on the event loop
+between awaits (single-threaded), so plain dicts are race-free and the hot
+path allocates nothing. A native C++ trie (native/hashtrie) can be slotted
+in behind the same interface for gateway-scale fan-out.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set, Tuple
+
+import xxhash
+
+
+class _Node:
+    __slots__ = ("children", "endpoints")
+
+    def __init__(self):
+        self.children: dict[int, _Node] = {}
+        self.endpoints: set[str] = set()
+
+
+class HashTrie:
+    def __init__(self, chunk_size: int = 128, max_depth: int = 1024):
+        self.chunk_size = chunk_size
+        self.max_depth = max_depth  # bound memory for adversarial prompts
+        self.root = _Node()
+
+    def _chunks(self, text: str) -> Iterable[int]:
+        for i in range(0, min(len(text), self.chunk_size * self.max_depth),
+                       self.chunk_size):
+            yield xxhash.xxh64(text[i : i + self.chunk_size]).intdigest()
+
+    def insert(self, text: str, endpoint: str) -> None:
+        node = self.root
+        node.endpoints.add(endpoint)
+        for h in self._chunks(text):
+            nxt = node.children.get(h)
+            if nxt is None:
+                nxt = node.children[h] = _Node()
+            nxt.endpoints.add(endpoint)
+            node = nxt
+
+    def longest_prefix_match(
+        self, text: str, available: Optional[Set[str]] = None
+    ) -> Tuple[int, Set[str]]:
+        """Longest chunk-prefix whose serving endpoints intersect
+        ``available``; returns (match chars, matching endpoints)."""
+        node = self.root
+        match_len = 0
+        selected: Set[str] = set(available) if available is not None else set()
+        for h in self._chunks(text):
+            node = node.children.get(h)
+            if node is None:
+                break
+            candidates = node.endpoints if available is None else (
+                node.endpoints & selected
+            )
+            if not candidates:
+                break
+            match_len += self.chunk_size
+            selected = set(candidates)
+        return match_len, selected
+
+    def remove_endpoint(self, endpoint: str) -> None:
+        """Drop a dead endpoint everywhere (stale-route prevention)."""
+
+        def _walk(node: _Node) -> None:
+            node.endpoints.discard(endpoint)
+            for child in node.children.values():
+                _walk(child)
+
+        _walk(self.root)

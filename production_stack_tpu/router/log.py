@@ -1,0 +1,88 @@
+"""Router logging: colored console / JSON formats, TRACE level, secret
+redaction (reference behaviours: src/vllm_router/log.py:80-194)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import sys
+
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+_COLORS = {
+    "TRACE": "\033[37m",
+    "DEBUG": "\033[36m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+_SECRET_RE = re.compile(
+    r"(api[-_]?key|authorization|token|secret)(['\"]?\s*[:=]\s*['\"]?)([^\s'\",}]+)",
+    re.IGNORECASE,
+)
+
+
+class SecretRedactionFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        msg = record.getMessage()
+        redacted = _SECRET_RE.sub(r"\1\2[REDACTED]", msg)
+        if redacted != msg:
+            record.msg = redacted
+            record.args = ()
+        return True
+
+
+class ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        color = _COLORS.get(record.levelname, "")
+        base = super().format(record)
+        return f"{color}{base}{_RESET}" if sys.stderr.isatty() else base
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "time": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+_configured = False
+
+
+def init_logger(name: str) -> logging.Logger:
+    global _configured
+    logger = logging.getLogger(name)
+    if not _configured:
+        handler = logging.StreamHandler()
+        fmt = os.environ.get("ROUTER_LOG_FORMAT", "console")
+        if fmt == "json":
+            handler.setFormatter(JsonFormatter())
+        else:
+            handler.setFormatter(
+                ColorFormatter("[%(asctime)s] %(levelname)s %(name)s: %(message)s")
+            )
+        handler.addFilter(SecretRedactionFilter())
+        root = logging.getLogger("production_stack_tpu")
+        root.addHandler(handler)
+        root.setLevel(os.environ.get("ROUTER_LOG_LEVEL", "INFO").upper())
+        root.propagate = False
+        _configured = True
+    return logger
+
+
+def set_log_level(level: str) -> None:
+    logging.getLogger("production_stack_tpu").setLevel(
+        TRACE if level.upper() == "TRACE" else level.upper()
+    )

@@ -1,0 +1,135 @@
+"""Router Prometheus metrics — name/label parity with the reference's metric
+objects (src/vllm_router/services/metrics_service/__init__.py:1-71) so the
+shipped Grafana dashboards and the prometheus-adapter HPA rules work
+unchanged against this router.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import Counter, Gauge, Histogram
+
+num_requests_running = Gauge(
+    "vllm:num_requests_running", "Number of running requests", ["server"]
+)
+num_requests_waiting = Gauge(
+    "vllm:num_requests_waiting", "Number of waiting requests", ["server"]
+)
+gpu_prefix_cache_hit_rate = Gauge(
+    "vllm:gpu_prefix_cache_hit_rate", "GPU Prefix Cache Hit Rate", ["server"]
+)
+gpu_prefix_cache_hits_total = Gauge(
+    "vllm:gpu_prefix_cache_hits_total", "Total GPU Prefix Cache Hits", ["server"]
+)
+gpu_prefix_cache_queries_total = Gauge(
+    "vllm:gpu_prefix_cache_queries_total", "Total GPU Prefix Cache Queries",
+    ["server"],
+)
+gpu_cache_usage_perc = Gauge(
+    "vllm:gpu_cache_usage_perc", "KV cache usage percentage", ["server"]
+)
+current_qps = Gauge("vllm:current_qps", "Current Queries Per Second", ["server"])
+avg_decoding_length = Gauge(
+    "vllm:avg_decoding_length", "Average Decoding Length", ["server"]
+)
+num_prefill_requests = Gauge(
+    "vllm:num_prefill_requests", "Number of Prefill Requests", ["server"]
+)
+num_decoding_requests = Gauge(
+    "vllm:num_decoding_requests", "Number of Decoding Requests", ["server"]
+)
+num_incoming_requests_total = Counter(
+    "vllm:num_incoming_requests", "Total valid incoming requests to router",
+    ["model"],
+)
+healthy_pods_total = Gauge(
+    "vllm:healthy_pods_total", "Number of healthy engine pods", ["server"]
+)
+avg_latency = Gauge(
+    "vllm:avg_latency", "Average end-to-end request latency", ["server"]
+)
+avg_itl = Gauge("vllm:avg_itl", "Average Inter-Token Latency", ["server"])
+num_requests_swapped = Gauge(
+    "vllm:num_requests_swapped", "Number of swapped requests", ["server"]
+)
+input_tokens_total = Counter(
+    "vllm:input_tokens_total", "Total input tokens processed", ["server", "model"]
+)
+output_tokens_total = Counter(
+    "vllm:output_tokens_total", "Total output tokens generated", ["server", "model"]
+)
+request_errors_total = Counter(
+    "vllm:request_errors_total", "Total request errors",
+    ["server", "model", "error_type"],
+)
+request_latency_seconds = Histogram(
+    "vllm:request_latency_seconds",
+    "End-to-end request latency observed at the router",
+    ["server", "model", "status"],
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+)
+# router self-metrics (reference: routers/metrics_router.py:43-57)
+router_cpu_percent = Gauge("router:cpu_usage_perc", "Router CPU usage percent")
+router_mem_percent = Gauge("router:memory_usage_perc", "Router memory usage percent")
+router_disk_percent = Gauge("router:disk_usage_perc", "Router disk usage percent")
+
+_STALE_AFTER = 300.0
+_label_touch: dict[tuple[str, str], float] = {}
+
+
+def refresh_label_gauges(engine_stats: dict, request_stats: dict) -> None:
+    """Push current scraped/derived stats into the labeled gauges and drop
+    labels for engines gone > 5 min (reference stale-metric cleanup,
+    src/tests/test_stale_metrics.py)."""
+    now = time.time()
+    for url, es in engine_stats.items():
+        _label_touch[("engine", url)] = now
+        num_requests_running.labels(server=url).set(es.num_running_requests)
+        num_requests_waiting.labels(server=url).set(es.num_queuing_requests)
+        gpu_prefix_cache_hit_rate.labels(server=url).set(es.gpu_prefix_cache_hit_rate)
+        gpu_prefix_cache_hits_total.labels(server=url).set(
+            es.gpu_prefix_cache_hits_total
+        )
+        gpu_prefix_cache_queries_total.labels(server=url).set(
+            es.gpu_prefix_cache_queries_total
+        )
+        gpu_cache_usage_perc.labels(server=url).set(es.gpu_cache_usage_perc)
+    for url, rs in request_stats.items():
+        _label_touch[("request", url)] = now
+        current_qps.labels(server=url).set(rs.qps)
+        avg_decoding_length.labels(server=url).set(rs.avg_decoding_length)
+        num_prefill_requests.labels(server=url).set(rs.in_prefill_requests)
+        num_decoding_requests.labels(server=url).set(rs.in_decoding_requests)
+        avg_latency.labels(server=url).set(rs.avg_latency)
+        avg_itl.labels(server=url).set(rs.avg_itl)
+        num_requests_swapped.labels(server=url).set(rs.num_swapped_requests)
+    for (kind, url), ts in list(_label_touch.items()):
+        live = url in (engine_stats if kind == "engine" else request_stats)
+        if not live and now - ts > _STALE_AFTER:
+            del _label_touch[(kind, url)]
+            gauges = (
+                (num_requests_running, num_requests_waiting,
+                 gpu_prefix_cache_hit_rate, gpu_prefix_cache_hits_total,
+                 gpu_prefix_cache_queries_total, gpu_cache_usage_perc)
+                if kind == "engine"
+                else (current_qps, avg_decoding_length, num_prefill_requests,
+                      num_decoding_requests, avg_latency, avg_itl,
+                      num_requests_swapped)
+            )
+            for g in gauges:
+                try:
+                    g.remove(url)
+                except KeyError:
+                    pass
+
+
+def refresh_self_metrics() -> None:
+    try:
+        import psutil
+
+        router_cpu_percent.set(psutil.cpu_percent(interval=None))
+        router_mem_percent.set(psutil.virtual_memory().percent)
+        router_disk_percent.set(psutil.disk_usage("/").percent)
+    except Exception:
+        pass

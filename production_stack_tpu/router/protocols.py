@@ -1,0 +1,95 @@
+"""Router-side data types: endpoint records and per-engine stats snapshots.
+
+Mirrors the semantic content of the reference's EndpointInfo/ModelInfo
+(src/vllm_router/service_discovery.py:53-174), EngineStats
+(stats/engine_stats.py:29-86) and RequestStats (stats/request_stats.py:30-56)
+as plain dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ModelInfo:
+    id: str
+    parent: Optional[str] = None  # LoRA adapters point at their base model
+    is_adapter: bool = False
+
+
+@dataclasses.dataclass
+class EndpointInfo:
+    url: str
+    model_names: list[str] = dataclasses.field(default_factory=list)
+    model_info: dict[str, ModelInfo] = dataclasses.field(default_factory=dict)
+    model_label: Optional[str] = None  # pod label, e.g. "prefill"/"decode"
+    pod_name: Optional[str] = None
+    namespace: Optional[str] = None
+    added_timestamp: float = dataclasses.field(default_factory=time.time)
+    sleep: bool = False
+
+    def serves(self, model: str) -> bool:
+        return model in self.model_names
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """Snapshot parsed from an engine's /metrics scrape."""
+
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    gpu_prefix_cache_hits_total: int = 0
+    gpu_prefix_cache_queries_total: int = 0
+    gpu_cache_usage_perc: float = 0.0
+
+    _PARSE_MAP = {
+        "vllm:num_requests_running": "num_running_requests",
+        "vllm:num_requests_waiting": "num_queuing_requests",
+        "vllm:gpu_prefix_cache_hit_rate": "gpu_prefix_cache_hit_rate",
+        "vllm:gpu_prefix_cache_hits_total": "gpu_prefix_cache_hits_total",
+        "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
+        "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
+    }
+
+    @classmethod
+    def from_scrape(cls, text: str) -> "EngineStats":
+        from prometheus_client.parser import text_string_to_metric_families
+
+        stats = cls()
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                attr = cls._PARSE_MAP.get(sample.name)
+                if attr is not None:
+                    setattr(stats, attr, sample.value)
+        return stats
+
+
+@dataclasses.dataclass
+class RequestStats:
+    """Router-observed per-engine request statistics (sliding windows)."""
+
+    qps: float = -1.0
+    ttft: float = -1.0
+    in_prefill_requests: int = 0
+    in_decoding_requests: int = 0
+    finished_requests: int = 0
+    uptime: float = 0.0
+    avg_decoding_length: float = -1.0
+    avg_latency: float = -1.0
+    avg_itl: float = -1.0
+    num_swapped_requests: int = 0
+
+
+def model_card(model_id: str, created: Optional[int] = None, parent=None) -> dict:
+    return {
+        "id": model_id,
+        "object": "model",
+        "created": created or int(time.time()),
+        "owned_by": "production-stack-tpu",
+        "root": model_id,
+        "parent": parent,
+    }

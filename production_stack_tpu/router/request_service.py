@@ -1,0 +1,354 @@
+"""The proxy hot path: parse → resolve model → filter endpoints → route →
+failover loop → relay stream, with request-stats hooks and usage accounting.
+
+Reference flow: route_general_request + process_request
+(src/vllm_router/services/request_service/request.py:225-677); failover loop
+request.py:597-660; hop-by-hop sanitization request.py:82-100; orchestrated
+disaggregated prefill request.py:719-921; scale-to-zero 404-vs-503
+request.py:533-552.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import AsyncIterator, Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router import metrics as m
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.protocols import EndpointInfo
+from production_stack_tpu.router.routing import (
+    DisaggregatedPrefillOrchestratedRouter,
+    get_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import get_service_discovery
+from production_stack_tpu.router.stats import (
+    get_engine_stats_scraper,
+    get_request_stats_monitor,
+)
+
+logger = init_logger(__name__)
+
+HOP_BY_HOP = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host", "content-length",
+}
+
+
+def sanitize_headers(headers) -> dict[str, str]:
+    return {k: v for k, v in headers.items() if k.lower() not in HOP_BY_HOP}
+
+
+class RequestService:
+    """Bound to the router app; owns the shared backend client session."""
+
+    def __init__(
+        self,
+        max_failover_attempts: int = 0,
+        request_timeout: float = 600.0,
+        model_aliases: Optional[dict[str, str]] = None,
+        rewriter=None,
+        callbacks=None,
+        external_providers=None,
+    ):
+        self.max_failover_attempts = max_failover_attempts
+        self.request_timeout = request_timeout
+        self.model_aliases = model_aliases or {}
+        self.rewriter = rewriter
+        self.callbacks = callbacks
+        self.external_providers = external_providers
+        self.post_response = None  # optional (body, response_tail) hook
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.request_timeout, sock_read=None)
+        )
+
+    async def stop(self) -> None:
+        if self._session:
+            await self._session.close()
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        assert self._session is not None, "request service not started"
+        return self._session
+
+    # -- endpoint selection ---------------------------------------------------
+    def _filter_endpoints(self, model: str) -> list[EndpointInfo]:
+        eps = get_service_discovery().get_endpoint_info()
+        return [e for e in eps if e.serves(model) and not e.sleep]
+
+    def resolve_model(self, model: str) -> str:
+        return self.model_aliases.get(model, model)
+
+    # -- the main proxy -------------------------------------------------------
+    async def route_general_request(
+        self, request: web.Request, endpoint_path: str
+    ) -> web.StreamResponse:
+        t_start = time.time()
+        request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+
+        if self.callbacks is not None:
+            short = self.callbacks.pre_request(request, body)
+            if short is not None:
+                return web.json_response(short)
+        if self.rewriter is not None:
+            body = self.rewriter.rewrite(endpoint_path, body)
+
+        model = body.get("model", "")
+        resolved = self.resolve_model(model)
+        body["model"] = resolved
+        m.num_incoming_requests_total.labels(model=resolved or "unknown").inc()
+
+        if self.external_providers is not None and self.external_providers.handles(
+            resolved
+        ):
+            return await self.external_providers.proxy(
+                request, endpoint_path, body, resolved
+            )
+
+        endpoints = self._filter_endpoints(resolved)
+        if not endpoints:
+            discovery = get_service_discovery()
+            if resolved in discovery.known_models:
+                return web.json_response(
+                    {"error": {"message": f"model {resolved!r} is scaled to zero "
+                               "or sleeping; retry later"}},
+                    status=503,
+                )
+            return web.json_response(
+                {"error": {"message": f"model {resolved!r} not found",
+                           "type": "NotFoundError"}},
+                status=404,
+            )
+
+        router = get_routing_logic()
+        if isinstance(router, DisaggregatedPrefillOrchestratedRouter):
+            return await self._orchestrated_disagg(
+                request, endpoint_path, body, endpoints, router, request_id, t_start
+            )
+
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        request_stats = get_request_stats_monitor().get_request_stats()
+
+        attempts = 1 + max(self.max_failover_attempts, 0)
+        failed: set[str] = set()
+        last_error: Optional[str] = None
+        for attempt in range(attempts):
+            candidates = [e for e in endpoints if e.url not in failed] or endpoints
+            url = await router.route_request(
+                candidates, engine_stats, request_stats,
+                dict(request.headers), body,
+            )
+            logger.info("Routing request %s to %s (attempt %d)", request_id,
+                        url, attempt + 1)
+            try:
+                return await self._proxy_and_stream(
+                    request, endpoint_path, body, url, resolved, request_id, t_start
+                )
+            except BackendError as e:
+                last_error = str(e)
+                failed.add(url)
+                m.request_errors_total.labels(
+                    server=url, model=resolved, error_type=e.kind
+                ).inc()
+                logger.warning(
+                    "backend %s failed for request %s (%s); rerouting", url,
+                    request_id, e,
+                )
+        return web.json_response(
+            {"error": {"message": f"all backends failed: {last_error}"}}, status=503
+        )
+
+    async def _proxy_and_stream(
+        self, request, endpoint_path, body, url, model, request_id, t_start
+    ) -> web.StreamResponse:
+        """One backend attempt. Raises BackendError before any byte has been
+        relayed (so failover is safe); after first byte, errors terminate the
+        stream."""
+        monitor = get_request_stats_monitor()
+        stream = bool(body.get("stream", False))
+        monitor.on_new_request(url, request_id, time.time())
+        headers = sanitize_headers(request.headers)
+        headers["x-request-id"] = request_id
+        try:
+            backend = await self.session.post(
+                f"{url}{endpoint_path}", json=body, headers=headers
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            monitor.on_request_complete(url, request_id, time.time())
+            raise BackendError("connect", f"{type(e).__name__}: {e}") from e
+
+        if backend.status >= 500:
+            text = await backend.text()
+            backend.release()
+            monitor.on_request_complete(url, request_id, time.time())
+            raise BackendError("http_5xx", f"HTTP {backend.status}: {text[:200]}")
+
+        resp = web.StreamResponse(
+            status=backend.status,
+            headers={
+                **sanitize_headers(backend.headers),
+                "x-request-id": request_id,
+            },
+        )
+        first = True
+        n_output_tokens = 0
+        buffer = b""
+        status_label = str(backend.status)
+        try:
+            await resp.prepare(request)
+            async for chunk in backend.content.iter_any():
+                if first:
+                    monitor.on_request_response(url, request_id, time.time())
+                    first = False
+                buffer = (buffer + chunk)[-65536:]  # tail only, usage lives there
+                await resp.write(chunk)
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            status_label = "client_disconnect"
+            raise
+        finally:
+            usage = _extract_usage(buffer, stream)
+            if usage:
+                n_output_tokens = usage.get("completion_tokens", 0) or 0
+                m.input_tokens_total.labels(server=url, model=model).inc(
+                    usage.get("prompt_tokens", 0) or 0
+                )
+                m.output_tokens_total.labels(server=url, model=model).inc(
+                    n_output_tokens
+                )
+            now = time.time()
+            monitor.on_request_complete(url, request_id, now, n_output_tokens)
+            m.request_latency_seconds.labels(
+                server=url, model=model, status=status_label
+            ).observe(now - t_start)
+            backend.release()
+            if status_label == "200":
+                if self.post_response is not None and not stream:
+                    try:
+                        self.post_response(body, buffer)
+                    except Exception as e:
+                        logger.warning("post_response hook failed: %s", e)
+                if self.callbacks is not None:
+                    self.callbacks.post_request(request, body, buffer)
+        return resp
+
+    # -- orchestrated disaggregated prefill -----------------------------------
+    async def _orchestrated_disagg(
+        self, request, endpoint_path, body, endpoints, router, request_id, t_start
+    ) -> web.StreamResponse:
+        """Single client call; router drives prefill then decode. KV moves
+        prefill→decode out-of-band, keyed by kv_transfer_params (our engines
+        implement the transfer in engine/kv_transfer.py; the reference
+        delegates to NIXL/LMCache)."""
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        request_stats = get_request_stats_monitor().get_request_stats()
+        prefill_url, decode_url = await router.select_pair(
+            endpoints, engine_stats, request_stats, dict(request.headers), body
+        )
+        if prefill_url is None:
+            return await self._proxy_and_stream(
+                request, endpoint_path, body, decode_url, body.get("model", ""),
+                request_id, t_start,
+            )
+
+        monitor = get_request_stats_monitor()
+        prefill_body = dict(body)
+        prefill_body.update(
+            {
+                "max_tokens": 1, "max_completion_tokens": 1, "stream": False,
+                "kv_transfer_params": {
+                    "do_remote_decode": True,
+                    "do_remote_prefill": False,
+                    "remote_engine_id": None,
+                    "remote_block_ids": None,
+                    "remote_host": None,
+                    "remote_port": None,
+                },
+            }
+        )
+        headers = sanitize_headers(request.headers)
+        headers["x-request-id"] = request_id
+        monitor.on_new_request(prefill_url, request_id, time.time())
+        try:
+            async with self.session.post(
+                f"{prefill_url}{endpoint_path}", json=prefill_body, headers=headers
+            ) as pre:
+                pre_data = await pre.json()
+                if pre.status != 200:
+                    raise BackendError("prefill", f"HTTP {pre.status}: {pre_data}")
+        finally:
+            monitor.on_request_complete(prefill_url, request_id, time.time())
+
+        kv_params = pre_data.get("kv_transfer_params") or {}
+        kv_params.setdefault("remote_host", prefill_url)
+        decode_body = dict(body)
+        decode_body["kv_transfer_params"] = kv_params
+        logger.info(
+            "Routing request %s: prefill=%s decode=%s", request_id, prefill_url,
+            decode_url,
+        )
+        return await self._proxy_and_stream(
+            request, endpoint_path, decode_body, decode_url,
+            body.get("model", ""), request_id, t_start,
+        )
+
+    # -- sleep / wake proxying (reference: request.py:1027-1114) -------------
+    async def sleep_wake(self, request: web.Request, action: str) -> web.Response:
+        url = request.query.get("url") or request.rel_url.query.get("endpoint")
+        eps = get_service_discovery().get_endpoint_info()
+        targets = [e.url for e in eps if url is None or e.url == url]
+        if not targets:
+            return web.json_response({"error": {"message": "no endpoints"}}, status=404)
+        results = {}
+        for t in targets:
+            try:
+                if action == "is_sleeping":
+                    async with self.session.get(f"{t}/is_sleeping") as r:
+                        results[t] = await r.json()
+                else:
+                    async with self.session.post(
+                        f"{t}/{action}", params=dict(request.query)
+                    ) as r:
+                        results[t] = await r.json()
+                discovery = get_service_discovery()
+                if action in ("sleep", "wake_up") and hasattr(discovery, "set_sleep"):
+                    discovery.set_sleep(t, action == "sleep")
+            except Exception as e:
+                results[t] = {"error": str(e)}
+        return web.json_response(results)
+
+
+class BackendError(Exception):
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+
+
+def _extract_usage(tail: bytes, stream: bool) -> Optional[dict]:
+    """Pull the usage object from a JSON body or the last SSE data chunks."""
+    try:
+        if not stream:
+            return json.loads(tail).get("usage")
+        for line in reversed(tail.split(b"\n")):
+            line = line.strip()
+            if line.startswith(b"data: ") and line != b"data: [DONE]":
+                data = json.loads(line[6:])
+                if data.get("usage"):
+                    return data["usage"]
+        return None
+    except Exception:
+        return None

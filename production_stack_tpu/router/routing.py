@@ -1,0 +1,299 @@
+"""Routing algorithms — the heart of the L7 data plane.
+
+Parity set (reference: src/vllm_router/routers/routing_logic.py):
+
+- roundrobin   per-endpoint-set counters
+- session      consistent hash ring on a session header/body key, QPS
+               fallback for session-less requests
+- prefixaware  chunk-hash trie longest-prefix match (KV locality by content)
+- kvaware      *TPU-native redesign*: instead of embedding an LMCache
+               controller with ZMQ channels (reference routing_logic.py:
+               252-428), engines expose ``POST /kv/lookup`` answering "how
+               many prompt tokens would prefix-hit your HBM block pool?"
+               straight from the paged allocator's content-hash table; the
+               router fans the lookup out and routes to the deepest match
+               over a threshold. Same capability, one fewer moving part.
+- disaggregated_prefill (2-call) and _orchestrated (single-call): label-based
+  prefill/decode pool selection; the P→D chaining lives in request_service.
+
+Every router honours an ``exclude`` set so the request service can re-route
+around failed instances (reference failover: request.py:597-660).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import itertools
+import random
+from typing import Optional
+
+import aiohttp
+
+from production_stack_tpu.router.hashring import ConsistentHashRing
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.protocols import EndpointInfo, EngineStats, RequestStats
+
+logger = init_logger(__name__)
+
+ROUTING_LOGICS = (
+    "roundrobin",
+    "session",
+    "prefixaware",
+    "kvaware",
+    "disaggregated_prefill",
+    "disaggregated_prefill_orchestrated",
+)
+
+
+def extract_prompt(request_json: dict) -> str:
+    """Prompt text for locality routing: completions 'prompt' or concatenated
+    chat message contents (multimodal parts flattened to their text)."""
+    if "messages" in request_json:
+        parts = []
+        for message in request_json.get("messages") or []:
+            content = message.get("content", "")
+            if isinstance(content, list):
+                parts.append(
+                    " ".join(
+                        p.get("text", "") for p in content if p.get("type") == "text"
+                    )
+                )
+            elif content:
+                parts.append(str(content))
+        return "\n".join(parts)
+    prompt = request_json.get("prompt", "")
+    if isinstance(prompt, list):
+        prompt = prompt[0] if prompt and isinstance(prompt[0], str) else ""
+    return prompt or ""
+
+
+class Router(abc.ABC):
+    def _qps_fallback(
+        self,
+        endpoints: list[EndpointInfo],
+        request_stats: dict[str, RequestStats],
+    ) -> str:
+        """Lowest-QPS endpoint; an engine with no stats wins immediately."""
+        best, best_qps = None, float("inf")
+        for ep in endpoints:
+            stat = request_stats.get(ep.url)
+            if stat is None:
+                return ep.url
+            if stat.qps < best_qps:
+                best_qps, best = stat.qps, ep.url
+        return best or endpoints[0].url
+
+    @abc.abstractmethod
+    async def route_request(
+        self,
+        endpoints: list[EndpointInfo],
+        engine_stats: dict[str, EngineStats],
+        request_stats: dict[str, RequestStats],
+        headers: dict,
+        request_json: dict,
+    ) -> str: ...
+
+    async def close(self) -> None:
+        pass
+
+
+class RoundRobinRouter(Router):
+    def __init__(self, **_):
+        self._counters: dict[tuple, itertools.count] = {}
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            headers, request_json) -> str:
+        urls = tuple(sorted(e.url for e in endpoints))
+        counter = self._counters.setdefault(urls, itertools.count())
+        if len(self._counters) > 1024:  # bounded, endpoint sets churn
+            self._counters = {urls: counter}
+        return urls[next(counter) % len(urls)]
+
+
+class SessionRouter(Router):
+    def __init__(self, session_key: str = "x-user-id", **_):
+        self.session_key = session_key
+        self.ring = ConsistentHashRing()
+
+    def _session_id(self, headers: dict, request_json: dict) -> Optional[str]:
+        lower = {k.lower(): v for k, v in headers.items()}
+        return lower.get(self.session_key.lower()) or request_json.get(self.session_key)
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            headers, request_json) -> str:
+        session_id = self._session_id(headers, request_json)
+        if not session_id:
+            return self._qps_fallback(endpoints, request_stats)
+        self.ring.sync({e.url for e in endpoints})
+        url = self.ring.get_node(str(session_id))
+        return url if url else self._qps_fallback(endpoints, request_stats)
+
+
+class PrefixAwareRouter(Router):
+    def __init__(self, prefix_min_match_length: int = 0, chunk_size: int = 128, **_):
+        self.trie = HashTrie(chunk_size=chunk_size)
+        self.min_match = prefix_min_match_length
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            headers, request_json) -> str:
+        prompt = extract_prompt(request_json)
+        available = {e.url for e in endpoints}
+        match_len, matched = self.trie.longest_prefix_match(prompt, available)
+        if match_len < self.min_match or not matched:
+            # fallback still inserts, otherwise affinity never bootstraps
+            url = self._qps_fallback(endpoints, request_stats)
+        else:
+            url = random.choice(sorted(matched))
+        self.trie.insert(prompt, url)
+        return url
+
+
+class KvAwareRouter(Router):
+    """Route by actual KV residency: ask each candidate engine how many
+    prompt tokens would prefix-hit its paged cache."""
+
+    def __init__(self, kv_aware_threshold: int = 2000,
+                 lookup_timeout: float = 0.25, **_):
+        self.threshold = kv_aware_threshold
+        self.lookup_timeout = lookup_timeout
+        self.session_fallback = SessionRouter()
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def _lookup(self, url: str, prompt: str) -> tuple[str, int, int]:
+        try:
+            s = await self._sess()
+            async with s.post(
+                f"{url}/kv/lookup",
+                json={"prompt": prompt},
+                timeout=aiohttp.ClientTimeout(total=self.lookup_timeout),
+            ) as resp:
+                if resp.status == 200:
+                    data = await resp.json()
+                    return url, int(data.get("matched_tokens", 0)), int(
+                        data.get("total_tokens", 0)
+                    )
+        except Exception:
+            pass
+        return url, 0, 0
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            headers, request_json) -> str:
+        prompt = extract_prompt(request_json)
+        results = await asyncio.gather(
+            *(self._lookup(e.url, prompt) for e in endpoints)
+        )
+        url, matched, total = max(results, key=lambda r: r[1])
+        # route to the deepest match when the *unmatched* remainder is small
+        # enough to be worth the locality (threshold semantics mirror the
+        # reference's matched >= len - threshold gate, routing_logic.py:393)
+        if matched > 0 and total > 0 and total - matched <= self.threshold:
+            return url
+        return await self.session_fallback.route_request(
+            endpoints, engine_stats, request_stats, headers, request_json
+        )
+
+
+class DisaggregatedPrefillRouter(Router):
+    """2-call client protocol: max_tokens==1 requests (the client-driven
+    prefill pass) go to prefill-labeled pods, everything else to decode pods
+    (reference: routing_logic.py:525-565)."""
+
+    def __init__(self, prefill_label: str = "prefill", decode_label: str = "decode", **_):
+        self.prefill_label = prefill_label
+        self.decode_label = decode_label
+        self.rr = RoundRobinRouter()
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            headers, request_json) -> str:
+        is_prefill = request_json.get("max_tokens") == 1
+        label = self.prefill_label if is_prefill else self.decode_label
+        pool = [e for e in endpoints if e.model_label == label]
+        if not pool:
+            pool = endpoints  # degrade to colocated serving
+        return await self.rr.route_request(
+            pool, engine_stats, request_stats, headers, request_json
+        )
+
+
+class DisaggregatedPrefillOrchestratedRouter(Router):
+    """Single-call orchestration: the request service calls
+    ``select_pair()`` and chains prefill → decode itself with KV handoff
+    (reference flow: request.py:719-921)."""
+
+    def __init__(self, prefill_label: str = "prefill", decode_label: str = "decode", **_):
+        self.prefill_label = prefill_label
+        self.decode_label = decode_label
+        self._rr_p = RoundRobinRouter()
+        self._rr_d = RoundRobinRouter()
+
+    def find_pools(self, endpoints) -> tuple[list[EndpointInfo], list[EndpointInfo]]:
+        prefill = [e for e in endpoints if e.model_label == self.prefill_label]
+        decode = [e for e in endpoints if e.model_label == self.decode_label]
+        return prefill, decode
+
+    async def select_pair(self, endpoints, engine_stats, request_stats,
+                          headers, request_json) -> tuple[Optional[str], str]:
+        prefill, decode = self.find_pools(endpoints)
+        if not prefill or not decode:
+            # not actually disaggregated: treat all endpoints as one pool
+            url = await self._rr_d.route_request(
+                endpoints, engine_stats, request_stats, headers, request_json
+            )
+            return None, url
+        p = await self._rr_p.route_request(
+            prefill, engine_stats, request_stats, headers, request_json
+        )
+        d = await self._rr_d.route_request(
+            decode, engine_stats, request_stats, headers, request_json
+        )
+        return p, d
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            headers, request_json) -> str:
+        _, d = await self.select_pair(
+            endpoints, engine_stats, request_stats, headers, request_json
+        )
+        return d
+
+
+_ROUTERS = {
+    "roundrobin": RoundRobinRouter,
+    "session": SessionRouter,
+    "prefixaware": PrefixAwareRouter,
+    "kvaware": KvAwareRouter,
+    "disaggregated_prefill": DisaggregatedPrefillRouter,
+    "disaggregated_prefill_orchestrated": DisaggregatedPrefillOrchestratedRouter,
+}
+
+_router: Optional[Router] = None
+
+
+def initialize_routing_logic(name: str, **kwargs) -> Router:
+    global _router
+    try:
+        cls = _ROUTERS[name]
+    except KeyError:
+        raise ValueError(f"unknown routing logic {name!r}; known: {ROUTING_LOGICS}")
+    _router = cls(**kwargs)
+    logger.info("routing logic: %s", name)
+    return _router
+
+
+def get_routing_logic() -> Router:
+    assert _router is not None, "routing logic not initialized"
+    return _router
+
+
+def reconfigure_routing_logic(name: str, **kwargs) -> Router:
+    return initialize_routing_logic(name, **kwargs)

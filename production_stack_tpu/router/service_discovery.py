@@ -1,0 +1,312 @@
+"""Service discovery: which engine endpoints exist and what they serve.
+
+Reference semantics (src/vllm_router/service_discovery.py): static URL lists
+with optional health probing, or Kubernetes pod-IP watching with /v1/models
+querying, sleep-state tracking and a "known models" memory for
+scale-to-zero 503-vs-404 decisions. This implementation is asyncio-native
+(tasks, not threads) and talks to the Kubernetes API over plain HTTP
+(in-cluster service-account token), so it has no kubernetes-client
+dependency and is testable against a fake apiserver.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import os
+import ssl
+import time
+from typing import Optional
+
+import aiohttp
+
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.protocols import EndpointInfo, ModelInfo
+
+logger = init_logger(__name__)
+
+
+class ServiceDiscovery(abc.ABC):
+    def __init__(self):
+        self.known_models: set[str] = set()  # every model ever seen (scale-to-zero)
+
+    @abc.abstractmethod
+    def get_endpoint_info(self) -> list[EndpointInfo]: ...
+
+    async def start(self) -> None:  # spawn background tasks
+        pass
+
+    async def stop(self) -> None:
+        pass
+
+    def get_health(self) -> bool:
+        return True
+
+    def get_model_labels(self) -> set[str]:
+        return {
+            e.model_label for e in self.get_endpoint_info() if e.model_label
+        }
+
+
+class ExternalOnlyServiceDiscovery(ServiceDiscovery):
+    """No engine pods at all — every model proxied to an external provider
+    (reference: service_discovery.py:205-218)."""
+
+    def get_endpoint_info(self) -> list[EndpointInfo]:
+        return []
+
+
+class StaticServiceDiscovery(ServiceDiscovery):
+    def __init__(
+        self,
+        urls: list[str],
+        models: list[str],
+        model_labels: Optional[list[str]] = None,
+        health_check: bool = False,
+        health_check_interval: float = 10.0,
+        query_models: bool = False,
+        aliases: Optional[dict[str, str]] = None,
+    ):
+        super().__init__()
+        self.urls = urls
+        self.models = models
+        self.model_labels = model_labels or [None] * len(urls)
+        self.health_check = health_check
+        self.health_check_interval = health_check_interval
+        self.query_models = query_models
+        self.unhealthy: set[str] = set()
+        self.sleeping: set[str] = set()
+        self._task: Optional[asyncio.Task] = None
+        self._queried_models: dict[str, list[str]] = {}
+        self.known_models.update(models)
+
+    def get_endpoint_info(self) -> list[EndpointInfo]:
+        out = []
+        for i, url in enumerate(self.urls):
+            if url in self.unhealthy:
+                continue
+            models = self._queried_models.get(url) or [self.models[i]]
+            out.append(
+                EndpointInfo(
+                    url=url,
+                    model_names=list(models),
+                    model_info={m: ModelInfo(m) for m in models},
+                    model_label=self.model_labels[i],
+                    sleep=url in self.sleeping,
+                )
+            )
+        return out
+
+    async def start(self) -> None:
+        if self.health_check or self.query_models:
+            self._task = asyncio.create_task(self._health_worker())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    def set_sleep(self, url: str, sleeping: bool) -> None:
+        (self.sleeping.add if sleeping else self.sleeping.discard)(url)
+
+    async def _probe(self, session: aiohttp.ClientSession, url: str) -> None:
+        try:
+            async with session.get(
+                f"{url}/v1/models", timeout=aiohttp.ClientTimeout(total=5)
+            ) as resp:
+                ok = resp.status == 200
+                if ok and self.query_models:
+                    data = await resp.json()
+                    models = [m["id"] for m in data.get("data", [])]
+                    if models:
+                        self._queried_models[url] = models
+                        self.known_models.update(models)
+        except Exception:
+            ok = False
+        if ok:
+            self.unhealthy.discard(url)
+        else:
+            if url not in self.unhealthy:
+                logger.warning("endpoint %s failed health check, removing", url)
+            self.unhealthy.add(url)
+
+    async def _health_worker(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while True:
+                await asyncio.gather(
+                    *(self._probe(session, u) for u in self.urls),
+                    return_exceptions=True,
+                )
+                await asyncio.sleep(self.health_check_interval)
+
+
+class K8sPodIPServiceDiscovery(ServiceDiscovery):
+    """Watches pods matching a label selector via the raw Kubernetes watch
+    API; a ready pod is queried for /v1/models and /is_sleeping before being
+    added (reference flow: service_discovery.py:671-819)."""
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        label_selector: str = "",
+        port: int = 8000,
+        api_server: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        insecure_tls: bool = False,
+    ):
+        super().__init__()
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.port = port
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        scheme = "https" if k8s_port in ("443", "6443") else "http"
+        self.api_server = api_server or (host and f"{scheme}://{host}:{k8s_port}")
+        token_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+        self.token = token or (
+            open(token_path).read().strip() if os.path.exists(token_path) else None
+        )
+        ca_path = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+        self.ca_cert = ca_cert or (ca_path if os.path.exists(ca_path) else None)
+        self.insecure_tls = insecure_tls
+        self.endpoints: dict[str, EndpointInfo] = {}  # pod name -> info
+        self._task: Optional[asyncio.Task] = None
+        self._healthy = False
+
+    def get_endpoint_info(self) -> list[EndpointInfo]:
+        return list(self.endpoints.values())
+
+    def get_health(self) -> bool:
+        return self._healthy
+
+    async def start(self) -> None:
+        if not self.api_server:
+            raise RuntimeError(
+                "K8s service discovery needs an API server (in-cluster env or "
+                "--k8s-api-server)"
+            )
+        self._task = asyncio.create_task(self._watch_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    def _ssl(self):
+        if not self.api_server.startswith("https"):
+            return None
+        if self.insecure_tls:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        if self.ca_cert:
+            return ssl.create_default_context(cafile=self.ca_cert)
+        return None
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.token}"} if self.token else {}
+
+    async def _watch_loop(self) -> None:
+        url = f"{self.api_server}/api/v1/namespaces/{self.namespace}/pods"
+        params = {"watch": "true"}
+        if self.label_selector:
+            params["labelSelector"] = self.label_selector
+        while True:
+            try:
+                async with aiohttp.ClientSession(headers=self._headers()) as s:
+                    async with s.get(
+                        url, params=params, ssl=self._ssl(),
+                        timeout=aiohttp.ClientTimeout(total=None, sock_read=None),
+                    ) as resp:
+                        resp.raise_for_status()
+                        self._healthy = True
+                        async for line in resp.content:
+                            if line.strip():
+                                await self._on_event(s, json.loads(line))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._healthy = False
+                logger.warning("k8s watch error (%s); retrying in 2s", e)
+                await asyncio.sleep(2)
+
+    @staticmethod
+    def _is_ready(pod: dict) -> bool:
+        if pod.get("metadata", {}).get("deletionTimestamp"):
+            return False
+        statuses = pod.get("status", {}).get("containerStatuses") or []
+        return bool(statuses) and all(c.get("ready") for c in statuses)
+
+    async def _on_event(self, session: aiohttp.ClientSession, event: dict) -> None:
+        etype = event.get("type")
+        pod = event.get("object", {})
+        meta = pod.get("metadata", {})
+        name = meta.get("name")
+        if not name:
+            return
+        pod_ip = pod.get("status", {}).get("podIP")
+        if etype == "DELETED" or not self._is_ready(pod) or not pod_ip:
+            if name in self.endpoints:
+                logger.info("engine pod %s removed", name)
+                del self.endpoints[name]
+            return
+        url = f"http://{pod_ip}:{self.port}"
+        labels = meta.get("labels", {})
+        model_label = labels.get("model")
+        try:
+            models, model_info = await self._query_models(session, url)
+            sleeping = await self._query_sleep(session, url)
+        except Exception as e:
+            logger.warning("pod %s ready but /v1/models failed: %s", name, e)
+            return
+        self.known_models.update(models)
+        self.endpoints[name] = EndpointInfo(
+            url=url,
+            model_names=models,
+            model_info=model_info,
+            model_label=model_label,
+            pod_name=name,
+            namespace=self.namespace,
+            sleep=sleeping,
+        )
+        logger.info("engine pod %s added at %s serving %s", name, url, models)
+
+    async def _query_models(self, session, url):
+        async with session.get(
+            f"{url}/v1/models", timeout=aiohttp.ClientTimeout(total=10)
+        ) as resp:
+            resp.raise_for_status()
+            data = await resp.json()
+        models, info = [], {}
+        for m in data.get("data", []):
+            models.append(m["id"])
+            info[m["id"]] = ModelInfo(
+                m["id"], parent=m.get("parent"), is_adapter=bool(m.get("parent"))
+            )
+        return models, info
+
+    async def _query_sleep(self, session, url) -> bool:
+        try:
+            async with session.get(
+                f"{url}/is_sleeping", timeout=aiohttp.ClientTimeout(total=5)
+            ) as resp:
+                if resp.status == 200:
+                    return bool((await resp.json()).get("is_sleeping"))
+        except Exception:
+            pass
+        return False
+
+
+_discovery: Optional[ServiceDiscovery] = None
+
+
+def initialize_service_discovery(instance: ServiceDiscovery) -> ServiceDiscovery:
+    global _discovery
+    _discovery = instance
+    return instance
+
+
+def get_service_discovery() -> ServiceDiscovery:
+    assert _discovery is not None, "service discovery not initialized"
+    return _discovery

@@ -1,0 +1,55 @@
+"""User-supplied callback handlers, loaded as ``module.attribute`` at init
+(reference: src/vllm_router/services/callbacks_service/custom_callbacks.py:19-46).
+
+A handler may define:
+- ``pre_request(request, body) -> dict | None``: return a dict to
+  short-circuit the request with that JSON response;
+- ``post_request(request, body, response_tail: bytes) -> None``: fire-and-
+  forget after the response finished streaming.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class CallbackHandler:
+    def __init__(self, obj):
+        self.obj = obj
+
+    def pre_request(self, request, body):
+        fn = getattr(self.obj, "pre_request", None)
+        if fn is None:
+            return None
+        try:
+            return fn(request, body)
+        except Exception as e:
+            logger.error("pre_request callback failed: %s", e)
+            return None
+
+    def post_request(self, request, body, response_tail: bytes) -> None:
+        fn = getattr(self.obj, "post_request", None)
+        if fn is None:
+            return
+        try:
+            fn(request, body, response_tail)
+        except Exception as e:
+            logger.error("post_request callback failed: %s", e)
+
+
+def load_callbacks(spec: str) -> CallbackHandler:
+    """``package.module.attr`` → CallbackHandler around the named object."""
+    module_name, _, attr = spec.rpartition(".")
+    if not module_name:
+        raise ValueError(f"--callbacks must be module.attribute, got {spec!r}")
+    sys.path.insert(0, ".")
+    try:
+        module = importlib.import_module(module_name)
+    finally:
+        sys.path.pop(0)
+    return CallbackHandler(getattr(module, attr))

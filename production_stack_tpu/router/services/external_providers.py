@@ -1,0 +1,104 @@
+"""External model providers: proxy selected model ids to OpenAI-style HTTP
+APIs instead of local engines (reference: src/vllm_router/external_providers/
+registry.py:31-271 + openai_provider.py).
+
+YAML config::
+
+    providers:
+      - name: openai
+        base_url: https://api.openai.com/v1
+        api_key_env: OPENAI_API_KEY
+        models:
+          - id: gpt-4o
+            alias: my-gpt        # optional client-facing alias
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.request_service import sanitize_headers
+
+logger = init_logger(__name__)
+
+
+class ExternalProvider:
+    def __init__(self, name: str, base_url: str, api_key: Optional[str] = None):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+
+    def headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.api_key}"} if self.api_key else {}
+
+
+class ExternalProviderRegistry:
+    def __init__(self):
+        self.model_to_provider: dict[str, ExternalProvider] = {}
+        self.alias_to_model: dict[str, str] = {}
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ExternalProviderRegistry":
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        reg = cls()
+        for p in cfg.get("providers", []):
+            provider = ExternalProvider(
+                p["name"], p["base_url"],
+                api_key=os.environ.get(p.get("api_key_env", "")) or p.get("api_key"),
+            )
+            for model in p.get("models", []):
+                mid = model["id"]
+                reg.model_to_provider[mid] = provider
+                if model.get("alias"):
+                    reg.alias_to_model[model["alias"]] = mid
+        logger.info(
+            "external providers: %d models via %d providers",
+            len(reg.model_to_provider),
+            len({p.name for p in reg.model_to_provider.values()}),
+        )
+        return reg
+
+    def handles(self, model: str) -> bool:
+        return model in self.model_to_provider or model in self.alias_to_model
+
+    def model_ids(self) -> list[str]:
+        return sorted(set(self.model_to_provider) | set(self.alias_to_model))
+
+    async def proxy(self, request: web.Request, endpoint_path: str, body: dict,
+                    model: str) -> web.StreamResponse:
+        real_model = self.alias_to_model.get(model, model)
+        provider = self.model_to_provider[real_model]
+        body = dict(body, model=real_model)
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        # strip the /v1 prefix if the provider base_url already carries one
+        path = endpoint_path
+        if provider.base_url.endswith("/v1") and path.startswith("/v1"):
+            path = path[3:]
+        headers = {**sanitize_headers(request.headers), **provider.headers()}
+        headers.pop("Authorization", None) if not provider.api_key else None
+        backend = await self._session.post(
+            f"{provider.base_url}{path}", json=body, headers=headers
+        )
+        resp = web.StreamResponse(
+            status=backend.status, headers=sanitize_headers(backend.headers)
+        )
+        await resp.prepare(request)
+        async for chunk in backend.content.iter_any():
+            await resp.write(chunk)
+        await resp.write_eof()
+        backend.release()
+        return resp
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()

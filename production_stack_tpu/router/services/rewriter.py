@@ -1,0 +1,28 @@
+"""Pluggable request-body rewriting hook (reference:
+src/vllm_router/services/request_service/rewriter.py:29-53)."""
+
+from __future__ import annotations
+
+import abc
+
+
+class RequestRewriter(abc.ABC):
+    @abc.abstractmethod
+    def rewrite(self, endpoint_path: str, body: dict) -> dict: ...
+
+
+class NoopRequestRewriter(RequestRewriter):
+    def rewrite(self, endpoint_path: str, body: dict) -> dict:
+        return body
+
+
+_rewriter: RequestRewriter = NoopRequestRewriter()
+
+
+def set_rewriter(rewriter: RequestRewriter) -> None:
+    global _rewriter
+    _rewriter = rewriter
+
+
+def get_rewriter() -> RequestRewriter:
+    return _rewriter

@@ -1,0 +1,223 @@
+"""Router-side statistics.
+
+- ``EngineStatsScraper``: periodic async scrape of every discovered engine's
+  /metrics, parsed into EngineStats (reference: stats/engine_stats.py:88-218;
+  thread there, asyncio task here).
+- ``RequestStatsMonitor``: sliding-window QPS / TTFT / latency / ITL per
+  engine URL from request lifecycle hooks (reference:
+  stats/request_stats.py:58-306).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Optional
+
+import aiohttp
+
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.protocols import EngineStats, RequestStats
+
+logger = init_logger(__name__)
+
+
+class MovingAverageMonitor:
+    def __init__(self, window: float):
+        self.window = window
+        self.timestamps: deque[float] = deque()
+        self.values: deque[float] = deque()
+
+    def update(self, ts: float, value: float) -> None:
+        self.timestamps.append(ts)
+        self.values.append(value)
+        self._trim(ts)
+
+    def trim(self, now: Optional[float] = None) -> None:
+        self._trim(now if now is not None else time.time())
+
+    def _trim(self, now: float) -> None:
+        while self.timestamps and self.timestamps[0] < now - self.window:
+            self.timestamps.popleft()
+            self.values.popleft()
+
+    @property
+    def average(self) -> float:
+        return sum(self.values) / len(self.values) if self.values else -1.0
+
+    @property
+    def count(self) -> int:
+        return len(self.values)
+
+
+class EngineStatsScraper:
+    def __init__(self, interval: float = 10.0):
+        self.interval = interval
+        self.engine_stats: dict[str, EngineStats] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def get_engine_stats(self) -> dict[str, EngineStats]:
+        return dict(self.engine_stats)
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._worker())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    def get_health(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def scrape_once(self) -> None:
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+
+        urls = [e.url for e in get_service_discovery().get_endpoint_info()]
+        async with aiohttp.ClientSession() as session:
+            results = await asyncio.gather(
+                *(self._scrape(session, u) for u in urls), return_exceptions=True
+            )
+        fresh = {}
+        for url, res in zip(urls, results):
+            if isinstance(res, EngineStats):
+                fresh[url] = res
+        # drop engines that disappeared; keep last-known for transient errors
+        self.engine_stats = {
+            u: fresh.get(u, self.engine_stats.get(u, EngineStats()))
+            for u in urls
+        }
+
+    async def _scrape(self, session, url: str) -> EngineStats:
+        async with session.get(
+            f"{url}/metrics", timeout=aiohttp.ClientTimeout(total=5)
+        ) as resp:
+            resp.raise_for_status()
+            return EngineStats.from_scrape(await resp.text())
+
+    async def _worker(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except Exception as e:
+                logger.warning("engine stats scrape failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+
+class RequestStatsMonitor:
+    def __init__(self, sliding_window: float = 60.0):
+        self.window = sliding_window
+        self.qps: dict[str, MovingAverageMonitor] = {}
+        self.ttft: dict[str, MovingAverageMonitor] = {}
+        self.latency: dict[str, MovingAverageMonitor] = {}
+        self.itl: dict[str, MovingAverageMonitor] = {}
+        self.decoding_length: dict[str, MovingAverageMonitor] = {}
+        self.in_prefill: dict[str, int] = {}
+        self.in_decoding: dict[str, int] = {}
+        self.finished: dict[str, int] = {}
+        self.swapped: dict[str, int] = {}
+        self.request_start: dict[tuple[str, str], float] = {}
+        self.first_token: dict[tuple[str, str], float] = {}
+        self.first_query_time: Optional[float] = None
+
+    def _mon(self, table: dict, url: str) -> MovingAverageMonitor:
+        if url not in table:
+            table[url] = MovingAverageMonitor(self.window)
+        return table[url]
+
+    # -- lifecycle hooks (called by the request service) ---------------------
+    def on_new_request(self, url: str, request_id: str, ts: float) -> None:
+        if self.first_query_time is None:
+            self.first_query_time = ts
+        self.request_start[(url, request_id)] = ts
+        self.in_prefill[url] = self.in_prefill.get(url, 0) + 1
+        self._mon(self.qps, url).update(ts, 1.0)
+
+    def on_request_response(self, url: str, request_id: str, ts: float) -> None:
+        start = self.request_start.get((url, request_id))
+        if start is None:
+            return
+        self.first_token[(url, request_id)] = ts
+        self._mon(self.ttft, url).update(ts, ts - start)
+        self.in_prefill[url] = max(self.in_prefill.get(url, 1) - 1, 0)
+        self.in_decoding[url] = self.in_decoding.get(url, 0) + 1
+
+    def on_request_complete(self, url: str, request_id: str, ts: float,
+                            num_output_tokens: int = 0) -> None:
+        key = (url, request_id)
+        start = self.request_start.pop(key, None)
+        first = self.first_token.pop(key, None)
+        if start is not None:
+            self._mon(self.latency, url).update(ts, ts - start)
+        if first is not None and num_output_tokens > 1:
+            self._mon(self.itl, url).update(
+                ts, (ts - first) / (num_output_tokens - 1)
+            )
+        if num_output_tokens:
+            self._mon(self.decoding_length, url).update(ts, num_output_tokens)
+        if first is not None:
+            self.in_decoding[url] = max(self.in_decoding.get(url, 1) - 1, 0)
+        else:
+            self.in_prefill[url] = max(self.in_prefill.get(url, 1) - 1, 0)
+        self.finished[url] = self.finished.get(url, 0) + 1
+
+    def on_request_swapped(self, url: str, request_id: str, ts: float) -> None:
+        self.swapped[url] = self.swapped.get(url, 0) + 1
+
+    # -- snapshot -------------------------------------------------------------
+    def get_request_stats(self, now: Optional[float] = None) -> dict[str, RequestStats]:
+        now = now if now is not None else time.time()
+        out: dict[str, RequestStats] = {}
+        urls = (
+            set(self.qps) | set(self.in_prefill) | set(self.in_decoding)
+            | set(self.finished)
+        )
+        for url in urls:
+            qps_mon = self.qps.get(url)
+            if qps_mon is not None:
+                qps_mon.trim(now)
+            qps = (qps_mon.count / self.window) if qps_mon else 0.0
+            out[url] = RequestStats(
+                qps=qps,
+                ttft=self.ttft[url].average if url in self.ttft else -1.0,
+                in_prefill_requests=self.in_prefill.get(url, 0),
+                in_decoding_requests=self.in_decoding.get(url, 0),
+                finished_requests=self.finished.get(url, 0),
+                uptime=(now - self.first_query_time) if self.first_query_time else 0,
+                avg_decoding_length=(
+                    self.decoding_length[url].average
+                    if url in self.decoding_length else -1.0
+                ),
+                avg_latency=self.latency[url].average if url in self.latency else -1.0,
+                avg_itl=self.itl[url].average if url in self.itl else -1.0,
+                num_swapped_requests=self.swapped.get(url, 0),
+            )
+        return out
+
+
+_scraper: Optional[EngineStatsScraper] = None
+_monitor: Optional[RequestStatsMonitor] = None
+
+
+def initialize_engine_stats_scraper(interval: float = 10.0) -> EngineStatsScraper:
+    global _scraper
+    _scraper = EngineStatsScraper(interval)
+    return _scraper
+
+
+def get_engine_stats_scraper() -> EngineStatsScraper:
+    assert _scraper is not None
+    return _scraper
+
+
+def initialize_request_stats_monitor(window: float = 60.0) -> RequestStatsMonitor:
+    global _monitor
+    _monitor = RequestStatsMonitor(window)
+    return _monitor
+
+
+def get_request_stats_monitor() -> RequestStatsMonitor:
+    assert _monitor is not None
+    return _monitor

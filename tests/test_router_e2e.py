@@ -1,0 +1,212 @@
+"""End-to-end slice: router ↔ two real (tiny) TPU-stack engines on CPU.
+
+This is the reference's routing e2e tier (tests/e2e/test-routing.py) shrunk
+to process-local aiohttp test servers — full data path: OpenAI request →
+router (discovery, routing, stats, failover) → engine (scheduler, paged
+attention) → SSE stream back.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.server import EngineServer
+from production_stack_tpu.parallel.mesh import MeshConfig
+from production_stack_tpu.router.app import RouterApp, build_parser
+
+
+def engine_server() -> EngineServer:
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=512),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                                  prefill_buckets=(32, 64)),
+        mesh=MeshConfig(data=1, tensor=1),
+    )
+    return EngineServer(cfg)
+
+
+async def spawn_engines(n):
+    from aiohttp.test_utils import TestServer
+
+    servers, urls = [], []
+    for _ in range(n):
+        es = engine_server()
+        ts = TestServer(es.build_app())
+        await ts.start_server()
+        servers.append((es, ts))
+        urls.append(f"http://127.0.0.1:{ts.port}")
+    return servers, urls
+
+
+async def router_client(urls, extra_args=()):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    args = build_parser().parse_args(
+        [
+            "--service-discovery", "static",
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["tiny-llama"] * len(urls)),
+            *extra_args,
+        ]
+    )
+    router = RouterApp(args)
+    client = TestClient(TestServer(router.build_app()))
+    await client.start_server()
+    return router, client
+
+
+async def teardown(servers, client):
+    await client.close()
+    for _, ts in servers:
+        await ts.close()
+
+
+def test_models_and_completion_through_router():
+    async def main():
+        servers, urls = await spawn_engines(2)
+        router, client = await router_client(urls)
+        try:
+            r = await client.get("/v1/models")
+            data = await r.json()
+            assert [m["id"] for m in data["data"]] == ["tiny-llama"]
+
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny-llama", "prompt": "hello", "max_tokens": 4,
+                      "temperature": 0, "ignore_eos": True},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["usage"]["completion_tokens"] == 4
+            assert "x-request-id" in r.headers
+
+            r = await client.get("/health")
+            assert r.status == 200
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
+def test_roundrobin_spreads_load():
+    async def main():
+        servers, urls = await spawn_engines(2)
+        router, client = await router_client(urls)
+        try:
+            for i in range(4):
+                r = await client.post(
+                    "/v1/completions",
+                    json={"model": "tiny-llama", "prompt": f"req {i}",
+                          "max_tokens": 2, "temperature": 0, "ignore_eos": True},
+                )
+                assert r.status == 200
+            counts = [s.engine.total_output_tokens for s, _ in servers]
+            assert all(c > 0 for c in counts), f"uneven: {counts}"
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
+def test_session_stickiness_e2e():
+    async def main():
+        servers, urls = await spawn_engines(2)
+        router, client = await router_client(
+            urls, ("--routing-logic", "session", "--session-key", "x-user-id")
+        )
+        try:
+            for _ in range(4):
+                r = await client.post(
+                    "/v1/completions",
+                    json={"model": "tiny-llama", "prompt": "hi", "max_tokens": 2,
+                          "temperature": 0, "ignore_eos": True},
+                    headers={"x-user-id": "alice"},
+                )
+                assert r.status == 200
+            counts = [s.engine.total_output_tokens for s, _ in servers]
+            assert sorted(counts) == [0, 8], f"not sticky: {counts}"
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
+def test_failover_reroutes_around_dead_backend():
+    async def main():
+        servers, urls = await spawn_engines(1)
+        dead = "http://127.0.0.1:1"  # nothing listens here
+        router, client = await router_client(
+            [dead, urls[0]],
+            ("--max-instance-failover-reroute-attempts", "2"),
+        )
+        try:
+            ok = 0
+            for i in range(4):
+                r = await client.post(
+                    "/v1/completions",
+                    json={"model": "tiny-llama", "prompt": f"r{i}", "max_tokens": 2,
+                          "temperature": 0, "ignore_eos": True},
+                )
+                ok += r.status == 200
+            assert ok == 4
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
+def test_streaming_and_metrics_through_router():
+    async def main():
+        servers, urls = await spawn_engines(1)
+        router, client = await router_client(urls)
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny-llama",
+                      "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3, "temperature": 0, "stream": True,
+                      "ignore_eos": True},
+            )
+            assert r.status == 200
+            lines = [l async for l in r.content]
+            text = b"".join(lines).decode()
+            assert "data: [DONE]" in text
+
+            # scrape engines once, then router /metrics must expose the
+            # dashboard gauge set
+            from production_stack_tpu.router.stats import get_engine_stats_scraper
+
+            await get_engine_stats_scraper().scrape_once()
+            r = await client.get("/metrics")
+            body = await r.text()
+            for name in ("vllm:num_requests_running", "vllm:current_qps",
+                         "vllm:healthy_pods_total", "vllm:request_latency_seconds",
+                         "vllm:gpu_cache_usage_perc"):
+                assert name in body, f"missing {name}"
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
+def test_unknown_model_404_vs_503():
+    async def main():
+        servers, urls = await spawn_engines(1)
+        router, client = await router_client(urls)
+        try:
+            r = await client.post(
+                "/v1/completions", json={"model": "nope", "prompt": "x"}
+            )
+            assert r.status == 404
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())

@@ -1,0 +1,190 @@
+"""Router unit tests: hash ring, trie, routing logics, stats monitors,
+feature gates, PII, semantic cache (mirrors the reference's src/tests
+coverage with stub endpoint objects)."""
+
+import asyncio
+import time
+
+import pytest
+
+from production_stack_tpu.router.experimental.feature_gates import FeatureGates
+from production_stack_tpu.router.experimental.pii import PIIMiddleware, RegexAnalyzer
+from production_stack_tpu.router.experimental.semantic_cache import SemanticCache, embed
+from production_stack_tpu.router.hashring import ConsistentHashRing
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.protocols import EndpointInfo, EngineStats, RequestStats
+from production_stack_tpu.router.routing import (
+    DisaggregatedPrefillOrchestratedRouter,
+    DisaggregatedPrefillRouter,
+    PrefixAwareRouter,
+    RoundRobinRouter,
+    SessionRouter,
+    extract_prompt,
+)
+from production_stack_tpu.router.stats import RequestStatsMonitor
+
+
+def ep(url, models=("m",), label=None):
+    return EndpointInfo(url=url, model_names=list(models), model_label=label)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- hash ring ---------------------------------------------------------------
+
+def test_hashring_stability_and_coverage():
+    ring = ConsistentHashRing()
+    nodes = {f"http://e{i}" for i in range(4)}
+    ring.sync(nodes)
+    assignments = {f"key-{i}": ring.get_node(f"key-{i}") for i in range(200)}
+    assert set(assignments.values()) == nodes  # every node gets traffic
+    # removing one node must not move keys between surviving nodes
+    ring.remove_node("http://e0")
+    for k, old in assignments.items():
+        new = ring.get_node(k)
+        if old != "http://e0":
+            assert new == old
+
+
+# -- trie --------------------------------------------------------------------
+
+def test_hashtrie_prefix_match():
+    trie = HashTrie(chunk_size=4)
+    trie.insert("aaaabbbbcccc", "e1")
+    trie.insert("aaaabbbbdddd", "e2")
+    n, eps = trie.longest_prefix_match("aaaabbbbcccc", {"e1", "e2"})
+    assert n == 12 and eps == {"e1"}
+    n, eps = trie.longest_prefix_match("aaaabbbbzzzz", {"e1", "e2"})
+    assert n == 8 and eps == {"e1", "e2"}
+    n, eps = trie.longest_prefix_match("zzzz", {"e1", "e2"})
+    assert n == 0
+    trie.remove_endpoint("e1")
+    n, eps = trie.longest_prefix_match("aaaabbbbcccc", {"e1", "e2"})
+    assert "e1" not in eps
+
+
+# -- routing logics ----------------------------------------------------------
+
+def test_round_robin_cycles():
+    r = RoundRobinRouter()
+    eps = [ep("http://e1"), ep("http://e2"), ep("http://e3")]
+    got = [run(r.route_request(eps, {}, {}, {}, {})) for _ in range(6)]
+    assert got[:3] == sorted(got[:3]) and got[:3] == got[3:]
+    assert set(got) == {"http://e1", "http://e2", "http://e3"}
+
+
+def test_session_router_sticky_and_fallback():
+    r = SessionRouter(session_key="x-user-id")
+    eps = [ep("http://e1"), ep("http://e2")]
+    a = run(r.route_request(eps, {}, {}, {"x-user-id": "alice"}, {}))
+    for _ in range(5):
+        assert run(r.route_request(eps, {}, {}, {"x-user-id": "alice"}, {})) == a
+    # no session: lowest QPS wins
+    stats = {"http://e1": RequestStats(qps=5.0), "http://e2": RequestStats(qps=1.0)}
+    assert run(r.route_request(eps, {}, stats, {}, {})) == "http://e2"
+
+
+def test_prefix_aware_affinity():
+    r = PrefixAwareRouter()
+    eps = [ep("http://e1"), ep("http://e2")]
+    prompt = "x" * 400
+    first = run(r.route_request(eps, {}, {}, {}, {"prompt": prompt}))
+    for _ in range(3):
+        assert run(r.route_request(eps, {}, {}, {}, {"prompt": prompt})) == first
+    # long shared-prefix variant stays on the same endpoint
+    assert run(r.route_request(eps, {}, {}, {}, {"prompt": prompt + "tail"})) == first
+
+
+def test_disaggregated_prefill_label_routing():
+    r = DisaggregatedPrefillRouter()
+    eps = [ep("http://p", label="prefill"), ep("http://d", label="decode")]
+    assert run(r.route_request(eps, {}, {}, {}, {"max_tokens": 1})) == "http://p"
+    assert run(r.route_request(eps, {}, {}, {}, {"max_tokens": 100})) == "http://d"
+
+
+def test_orchestrated_pair_selection():
+    r = DisaggregatedPrefillOrchestratedRouter()
+    eps = [ep("http://p1", label="prefill"), ep("http://p2", label="prefill"),
+           ep("http://d1", label="decode")]
+    p, d = run(r.select_pair(eps, {}, {}, {}, {}))
+    assert p.startswith("http://p") and d == "http://d1"
+    # degraded: no labels → single pool
+    p, d = run(r.select_pair([ep("http://x")], {}, {}, {}, {}))
+    assert p is None and d == "http://x"
+
+
+def test_extract_prompt_chat_and_multimodal():
+    assert extract_prompt({"prompt": "abc"}) == "abc"
+    body = {"messages": [
+        {"role": "user", "content": "hello"},
+        {"role": "user", "content": [{"type": "text", "text": "world"},
+                                     {"type": "image_url", "image_url": {}}]},
+    ]}
+    assert extract_prompt(body) == "hello\nworld"
+
+
+# -- request stats -----------------------------------------------------------
+
+def test_request_stats_lifecycle():
+    mon = RequestStatsMonitor(sliding_window=60)
+    t0 = time.time()
+    mon.on_new_request("u", "r1", t0)
+    stats = mon.get_request_stats(t0 + 1)
+    assert stats["u"].in_prefill_requests == 1
+    mon.on_request_response("u", "r1", t0 + 0.5)
+    stats = mon.get_request_stats(t0 + 1)
+    assert stats["u"].in_decoding_requests == 1
+    assert abs(stats["u"].ttft - 0.5) < 1e-6
+    mon.on_request_complete("u", "r1", t0 + 2.0, num_output_tokens=16)
+    stats = mon.get_request_stats(t0 + 2)
+    assert stats["u"].finished_requests == 1
+    assert stats["u"].in_decoding_requests == 0
+    assert abs(stats["u"].avg_latency - 2.0) < 1e-6
+    assert stats["u"].avg_itl > 0
+
+
+def test_engine_stats_parse():
+    scrape = """# HELP vllm:num_requests_running x
+# TYPE vllm:num_requests_running gauge
+vllm:num_requests_running{model_name="m"} 3.0
+# TYPE vllm:gpu_cache_usage_perc gauge
+vllm:gpu_cache_usage_perc{model_name="m"} 0.25
+"""
+    es = EngineStats.from_scrape(scrape)
+    assert es.num_running_requests == 3
+    assert es.gpu_cache_usage_perc == 0.25
+
+
+# -- experimental ------------------------------------------------------------
+
+def test_feature_gates():
+    g = FeatureGates("SemanticCache=true,PIIDetection=false")
+    assert g.enabled("SemanticCache") and not g.enabled("PIIDetection")
+    with pytest.raises(ValueError):
+        FeatureGates("NotAFeature=true")
+    with pytest.raises(ValueError):
+        FeatureGates("SemanticCache=maybe")
+
+
+def test_pii_regex_analyzer():
+    a = RegexAnalyzer()
+    found = a.analyze("mail me at bob@example.com or call 555-123-4567")
+    kinds = {f.kind for f in found}
+    assert "EMAIL" in kinds and "PHONE" in kinds
+    red = a.redact("bob@example.com")
+    assert "bob@example.com" not in red
+
+
+def test_semantic_cache_hit_and_threshold():
+    cache = SemanticCache(threshold=0.95)
+    body = {"model": "m", "messages": [{"role": "user", "content":
+            "what is the capital of france?"}]}
+    cache.store(body, b'{"choices": [{"message": {"content": "Paris"}}]}')
+    sim = float(embed("what is the capital of france?") @
+                embed("what is the capital of france?"))
+    assert sim > 0.99
+    far = float(embed("what is the capital of france?") @
+                embed("completely different text about tpus"))
+    assert far < 0.95
