@@ -1,0 +1,92 @@
+"""Single-chip engine benchmark.
+
+Measures sustained output throughput (tok/s/chip) of the continuous-batching
+engine on the largest bf16 Llama that fits one v5e chip (llama-3b-class,
+Llama-3.2-3B geometry, random-init weights — throughput is weight-value
+independent). Workload: 64 concurrent requests, 128-token prompts,
+128 output tokens each, greedy.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "tok/s/chip", "vs_baseline": ...}
+
+vs_baseline normalises against the driver's north-star target of
+2,000 output tok/s/chip (BASELINE.json; defined there for Llama-3-8B on
+v5e-16 — this single-chip 3B number is the per-chip proxy the rounds track).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ModelConfig,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sampling import SamplingParams
+    from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    model = "llama-3b-class" if on_tpu else "tiny-llama"
+    num_seqs = 64 if on_tpu else 8
+    prompt_len = 128
+    out_len = 128 if on_tpu else 16
+
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained(model),
+        cache=CacheConfig(block_size=16),
+        scheduler=SchedulerConfig(
+            max_num_seqs=num_seqs,
+            max_num_batched_tokens=512,
+            prefill_buckets=(128, 256, 512),
+            multi_step=16 if on_tpu else 2,
+        ),
+        mesh=MeshConfig(data=1, tensor=1),
+    )
+    mesh = build_mesh(cfg.mesh, devices=jax.devices()[:1])
+    num_blocks = None if on_tpu else 2048
+    engine = LLMEngine(cfg, mesh=mesh, num_blocks=num_blocks)
+
+    rng = np.random.default_rng(0)
+    sp = SamplingParams(temperature=0.0, max_tokens=out_len, ignore_eos=True)
+
+    def run_batch(tag: str, n: int) -> tuple[float, int]:
+        for i in range(n):
+            toks = rng.integers(10, cfg.model.vocab_size - 10, prompt_len).tolist()
+            engine.add_request(f"{tag}-{i}", prompt_token_ids=toks, sampling=sp)
+        t0 = time.perf_counter()
+        produced = 0
+        while engine.has_unfinished():
+            for out in engine.step():
+                produced += len(out.new_token_ids)
+        return time.perf_counter() - t0, produced
+
+    run_batch("warmup", 2)  # compile prefill + decode programs
+    elapsed, produced = run_batch("bench", num_seqs)
+
+    tok_per_s = produced / elapsed
+    target = 2000.0
+    print(
+        json.dumps(
+            {
+                "metric": f"output throughput ({model}, bf16, {num_seqs} concurrent, "
+                          f"{prompt_len}p/{out_len}o, 1 chip)",
+                "value": round(tok_per_s, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(tok_per_s / target, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,14 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
         rope_theta=500000.0, max_model_len=8192,
     ),
+    "llama-3b-class": ModelConfig(
+        # Llama-3.2-3B geometry: the largest bf16 Llama that fits a single
+        # v5e chip (16 GiB HBM) with a useful KV pool — the single-chip
+        # benchmark model (bench.py).
+        name="llama-3b-class", vocab_size=128256, hidden_size=3072,
+        intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+        head_dim=128, rope_theta=500000.0, max_model_len=8192,
+    ),
     "llama-3-70b": ModelConfig(
         name="llama-3-70b", vocab_size=128256, hidden_size=8192, intermediate_size=28672,
         num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
@@ -155,6 +163,10 @@ class SchedulerConfig:
     prefill_chunk_size: int = 1024
     # shape buckets: prefill token-lengths are padded up to one of these
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192)
+    # decode iterations fused into one device dispatch (vLLM's
+    # num-scheduler-steps): amortises host→device dispatch latency; stop
+    # conditions are checked every multi_step tokens, surplus is discarded
+    multi_step: int = 1
 
 
 @dataclasses.dataclass

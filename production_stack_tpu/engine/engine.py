@@ -131,8 +131,9 @@ class LLMEngine:
         table[: len(seq.block_ids)] = seq.block_ids
 
         context_len = sp.chunk_start + sp.chunk_len
-        logits = self.runner.prefill(
-            tokens, positions, table, context_len, slot_mapping, sp.chunk_len - 1
+        token = self.runner.prefill(
+            tokens, positions, table, context_len, slot_mapping,
+            sp.chunk_len - 1, seq.sampling,
         )
         seq.num_computed_tokens = context_len
 
@@ -146,22 +147,11 @@ class LLMEngine:
             # pending decode input — nothing to sample from this prefill
             return []
 
-        # prompt complete → sample the first token
-        s = seq.sampling
-        token = int(
-            self.runner.sample(
-                logits[None],
-                np.asarray([s.temperature], np.float32),
-                np.asarray([s.top_p], np.float32),
-                np.asarray([s.top_k], np.int32),
-                np.asarray([s.seed or 0], np.uint32),
-                np.asarray([0], np.int32),
-            )[0]
-        )
+        # prompt complete → the fused prefill step sampled the first token
         seq.first_token_time = time.monotonic()
         seq.output_token_ids.append(token)
         self.total_output_tokens += 1
-        return self._postprocess([seq], [token])
+        return self._postprocess([seq], [[token]])
 
     def _run_decode(self, decodes: list[Sequence]) -> list[RequestOutput]:
         bs = self.config.cache.block_size
@@ -183,26 +173,35 @@ class LLMEngine:
             self._seeds[i] = s.seed or 0
             self._steps[i] = len(seq.output_token_ids)
 
-        logits = self.runner.decode(
+        # multi_step fused decode+sample iterations in one dispatch; sampled
+        # tokens come back (K, B) and are appended until a stop fires
+        greedy_only = all(s.sampling.temperature <= 0.0 for s in decodes)
+        sampled = self.runner.decode_multi(
             self._tokens, self._positions, self._block_tables,
             self._context_lens, self._slot_mapping,
+            self._temps, self._top_ps, self._top_ks, self._seeds, self._steps,
+            greedy_only=greedy_only,
         )
-        tokens = self.runner.sample(
-            logits, self._temps, self._top_ps, self._top_ks, self._seeds, self._steps
-        )
-        new_tokens = []
+        token_lists = []
         for seq in decodes:
-            t = int(tokens[seq.slot])
-            seq.num_computed_tokens += 1
-            seq.output_token_ids.append(t)
-            new_tokens.append(t)
-            self.total_output_tokens += 1
-        return self._postprocess(decodes, new_tokens)
+            new_toks = []
+            for k in range(sampled.shape[0]):
+                t = int(sampled[k, seq.slot])
+                seq.num_computed_tokens += 1
+                seq.output_token_ids.append(t)
+                new_toks.append(t)
+                self.total_output_tokens += 1
+                if self._check_stop(seq, t) is not None:
+                    break
+            token_lists.append(new_toks)
+        return self._postprocess(decodes, token_lists)
 
-    def _postprocess(self, seqs: list[Sequence], tokens: list[int]) -> list[RequestOutput]:
+    def _postprocess(
+        self, seqs: list[Sequence], token_lists: list[list[int]]
+    ) -> list[RequestOutput]:
         outputs = []
-        for seq, tok in zip(seqs, tokens):
-            status = self._check_stop(seq, tok)
+        for seq, toks in zip(seqs, token_lists):
+            status = self._check_stop(seq, toks[-1]) if toks else None
             if status is not None:
                 self.scheduler.finish(seq, status)
                 self._slot_seq.pop(seq.slot, None)
@@ -210,7 +209,7 @@ class LLMEngine:
             outputs.append(
                 RequestOutput(
                     request_id=seq.request_id,
-                    new_token_ids=[tok],
+                    new_token_ids=list(toks),
                     finished=status is not None,
                     finish_reason=seq.finish_reason(),
                     num_prompt_tokens=seq.num_prompt_tokens,

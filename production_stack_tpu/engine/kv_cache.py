@@ -35,10 +35,16 @@ from production_stack_tpu.parallel.shardings import ShardingRules, logical_to_sh
 
 
 def kv_cache_logical_axes():
-    # KV-heads lead so (a) the tensor-parallel shard axis is the leading dim
-    # and (b) Pallas DMA slices [kh, block] touch only untiled leading dims —
-    # Mosaic requires the trailing (sublane, lane) dims stay whole.
-    return (ln.LAYERS, ln.KV_HEADS, ln.KV_BLOCKS, ln.BLOCK, ln.HEAD_DIM)
+    # ONE fused (L, N, block, 2*KH, D) array: a token's K+V for all heads is
+    # one contiguous (2KH, D) slab — the exact bf16 (16,128) tile at KH=8 —
+    # so Pallas writes/reads slice only leading dims and one DMA moves K and
+    # V together. A single buffer with a single scatter per layer is also
+    # what XLA keeps aliased through a donated scan carry (two buffers or two
+    # scatters cost a full pool copy per step; measured v5e). The 2KH dim is
+    # shard-grouped [K_s0, V_s0, K_s1, V_s1, ...] so tensor-parallel sharding
+    # hands each shard its own [K_local, V_local] halves
+    # (see ops/paged_attention.py combine_kv).
+    return (ln.LAYERS, ln.KV_BLOCKS, ln.BLOCK, ln.KV_HEADS, ln.HEAD_DIM)
 
 
 def init_kv_cache(
@@ -47,8 +53,8 @@ def init_kv_cache(
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     num_blocks: Optional[int] = None,
-) -> dict:
-    """Allocate the HBM block pool, sharded over the mesh."""
+) -> jnp.ndarray:
+    """Allocate the fused HBM block pool, sharded over the mesh."""
     from production_stack_tpu.parallel.shardings import rules_for_model
 
     rules = rules or rules_for_model(model, mesh)
@@ -57,16 +63,19 @@ def init_kv_cache(
         raise ValueError("num_blocks must be resolved before init (see sizing)")
     # KV cache never shards the layer axis onto pipeline stages here; when
     # stage > 1 the per-stage engine owns its own slice of layers.
-    axes = (None, ln.KV_HEADS, ln.KV_BLOCKS, ln.BLOCK, ln.HEAD_DIM)
+    axes = (None, None, None, ln.KV_HEADS, ln.HEAD_DIM)
     sharding = logical_to_sharding(axes, mesh, rules)
-    shape = (model.num_layers, model.num_kv_heads, n, cache.block_size, model.head_dim)
+    shape = (
+        model.num_layers, n, cache.block_size, 2 * model.num_kv_heads,
+        model.head_dim,
+    )
     dt = model.jax_dtype
 
     def _zeros():
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        return jnp.zeros(shape, dt)
 
     with jax.set_mesh(mesh):
-        return jax.jit(_zeros, out_shardings={"k": sharding, "v": sharding})()
+        return jax.jit(_zeros, out_shardings=sharding)()
 
 
 def kv_cache_bytes_per_block(model: ModelConfig, cache: CacheConfig) -> int:

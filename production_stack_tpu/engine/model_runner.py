@@ -30,16 +30,27 @@ from production_stack_tpu.engine import kv_cache as kvmod
 from production_stack_tpu.engine.sampling import sample_tokens
 from production_stack_tpu.engine.weights import init_or_load
 from production_stack_tpu.models.registry import get_model
-from production_stack_tpu.ops.paged_attention import paged_attention, write_kv_to_cache
+from production_stack_tpu.ops.paged_attention import (
+    combine_kv,
+    paged_attention,
+    write_kv,
+)
 from production_stack_tpu.parallel.mesh import AXIS_TENSOR
 from production_stack_tpu.parallel.shardings import rules_for_model
 
 
-def _pallas_ok(cfg: ModelConfig, mesh: Mesh) -> bool:
+def _pallas_ok(cfg: ModelConfig, mesh: Mesh, block_size: int) -> bool:
     if jax.default_backend() in ("cpu",):
         return False
     tp = mesh.shape[AXIS_TENSOR]
-    return cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
+    # Mosaic tiling: head_dim must fill the 128-lane dim, block_size the
+    # sublane dim (8 f32 / 16 bf16)
+    return (
+        cfg.num_kv_heads % tp == 0
+        and cfg.num_heads % tp == 0
+        and cfg.head_dim % 128 == 0
+        and block_size % 16 == 0
+    )
 
 
 class ModelRunner:
@@ -68,95 +79,189 @@ class ModelRunner:
             self.cfg, config.cache, mesh, self.rules, self.num_blocks
         )
         self.max_blocks_per_seq = -(-self.cfg.max_model_len // config.cache.block_size)
-        self.use_pallas = _pallas_ok(self.cfg, mesh)
+        self.use_pallas = _pallas_ok(self.cfg, mesh, config.cache.block_size)
 
         self._prefill = jax.jit(
             functools.partial(_prefill_step, self.cfg, self._attend_prefill),
             donate_argnums=(1,),
+            static_argnames=("greedy_only",),
         )
         self._decode = jax.jit(
             functools.partial(_decode_step, self.cfg, self._attend_decode),
             donate_argnums=(1,),
         )
+        self._decode_multi = jax.jit(
+            functools.partial(
+                _decode_multi_step, self.cfg, self._attend_decode,
+                max(config.scheduler.multi_step, 1),
+            ),
+            donate_argnums=(1,),
+            static_argnames=("block_size", "greedy_only"),
+        )
         self._sample = jax.jit(sample_tokens)
 
     # -- sizing ------------------------------------------------------------
+    def _prefill_temp_bytes(self) -> int:
+        """Worst-case transient of the XLA prefill attention: the (KH, G, S,
+        ctx) f32 score/softmax buffers plus the gathered context. Goes away
+        when the Pallas ragged-prefill kernel replaces the gather path."""
+        sched = self.config.scheduler
+        chunk = min(sched.max_num_batched_tokens, self.cfg.max_model_len)
+        s_max = next((b for b in sched.prefill_buckets if b >= chunk),
+                     self.cfg.max_model_len)
+        ctx = self.cfg.max_model_len
+        scores = s_max * ctx * self.cfg.num_kv_heads * self.cfg.q_per_kv * 4
+        gather = 2 * ctx * self.cfg.num_kv_heads * self.cfg.head_dim * 2
+        return int(3.5 * scores + 2 * gather)
+
     def _resolve_num_blocks(self, explicit: Optional[int]) -> int:
         if explicit is not None:
             return explicit
         if self.config.cache.num_blocks > 0:
             return self.config.cache.num_blocks
         per_block = kvmod.kv_cache_bytes_per_block(self.cfg, self.config.cache)
+        param_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
+        )
         try:
             stats = jax.local_devices()[0].memory_stats()
-            free = stats["bytes_limit"] - stats["bytes_in_use"]
+            hbm = stats["bytes_limit"]
+            used = stats["bytes_in_use"]
         except Exception:
-            # no memory stats (CPU / tunneled backend): assume v5e 16 GiB HBM
-            # minus what the params occupy
-            param_bytes = sum(
-                x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
-            )
-            free = 16 * 1024**3 - param_bytes
+            # no memory stats (tunneled backend): assume v5e 15.75 GiB HBM
+            hbm = int(15.75 * 1024**3)
+            used = param_bytes
+        free = hbm - used - self._prefill_temp_bytes() - 2 * 1024**3
         n_dev = max(self.mesh.devices.size, 1)
         total_free = free * n_dev  # cache is sharded over the mesh
         return max(int(total_free * self.config.cache.hbm_utilization) // per_block, 16)
 
     # -- attention backends -------------------------------------------------
-    def _attend_prefill(self, q, k, v, layer_cache, block_tables, context_lens,
-                        q_positions, slot_mapping):
-        kc, vc = write_kv_to_cache(
-            layer_cache["k"], layer_cache["v"], k[0], v[0], slot_mapping
-        )
-        out = paged_attention(q, kc, vc, block_tables, context_lens, q_positions)
-        return out, {"k": kc, "v": vc}
+    # ``caches`` is the fused (L, N, bs, 2KH, D) pool riding the layer-scan
+    # carry; ONE update per layer at layer_idx keeps the donated pool in
+    # place (see kv_cache.py / models/llama.py forward_tokens).
+    @property
+    def tp(self) -> int:
+        """KV shard-grouping factor: the mesh tensor size when KV heads are
+        actually sharded, 1 when the rules fell back to replication (GQA
+        head counts not divisible — e.g. KH=2 under tensor=4)."""
+        from production_stack_tpu.parallel import shardings as ln
 
-    def _attend_decode(self, q, k, v, layer_cache, block_tables, context_lens,
-                       q_positions, slot_mapping):
-        kc, vc = write_kv_to_cache(
-            layer_cache["k"], layer_cache["v"], k[:, 0], v[:, 0], slot_mapping
+        if self.rules.rules.get(ln.KV_HEADS) is None:
+            return 1
+        return self.mesh.shape[AXIS_TENSOR]
+
+    _SHARD_IN = (
+        P(None, AXIS_TENSOR, None),  # q rows (.., H grouped by shard, D)
+        P(None, AXIS_TENSOR, None),  # newkv (T, 2KH, D)
+        P(None, None, None, AXIS_TENSOR, None),  # cache
+        P(None, None),  # block tables
+        P(None),  # context lens
+        P(None),  # slot mapping
+        P(),  # layer idx
+        P(),  # q_start / unused
+    )
+    _SHARD_OUT = (
+        P(None, AXIS_TENSOR, None),
+        P(None, None, None, AXIS_TENSOR, None),
+    )
+
+    def _sharded(self, inner):
+        if self.tp == 1:
+            return inner
+        return jax.shard_map(
+            inner, mesh=self.mesh, in_specs=self._SHARD_IN,
+            out_specs=self._SHARD_OUT, check_vma=False,
         )
-        if self.use_pallas:
-            from production_stack_tpu.ops.paged_attention_pallas import (
-                paged_decode_attention_pallas,
+
+    def _xla_attend(self, q, caches, layer_idx, block_tables, context_lens,
+                    q_positions):
+        layer = jax.lax.dynamic_index_in_dim(caches, layer_idx, 0, keepdims=False)
+        return paged_attention(
+            q, layer, block_tables, context_lens, q_positions, tp=self.tp
+        )
+
+    def _attend_prefill(self, q, k, v, caches, layer_idx, block_tables,
+                        context_lens, q_positions, slot_mapping):
+        if not self.use_pallas:
+            caches = write_kv(caches, layer_idx, k[0], v[0], slot_mapping, self.tp)
+            out = self._xla_attend(q, caches, layer_idx, block_tables,
+                                   context_lens, q_positions)
+            return out, caches
+
+        from production_stack_tpu.ops.paged_attention_pallas import (
+            kv_cache_write_pallas,
+            paged_prefill_attention_pallas,
+        )
+
+        newkv = combine_kv(k[0].astype(caches.dtype), v[0].astype(caches.dtype),
+                           self.tp)
+
+        def inner(q2, nk, fused, bt, cl, sm, li, qstart):
+            fused = kv_cache_write_pallas(fused, nk, sm, li)
+            out = paged_prefill_attention_pallas(
+                q2, fused, bt[0], qstart, cl[0], li
             )
+            return out, fused
 
-            fn = functools.partial(paged_decode_attention_pallas, interpret=False)
-            tp = self.mesh.shape[AXIS_TENSOR]
-            if tp > 1:
-                fn = jax.shard_map(
-                    fn,
-                    mesh=self.mesh,
-                    in_specs=(
-                        P(None, AXIS_TENSOR, None),
-                        P(AXIS_TENSOR),
-                        P(AXIS_TENSOR),
-                        P(None, None),
-                        P(None),
-                    ),
-                    out_specs=P(None, AXIS_TENSOR, None),
-                    check_vma=False,
-                )
-            out = fn(q[:, 0], kc, vc, block_tables, context_lens)[:, None]
-        else:
-            out = paged_attention(q, kc, vc, block_tables, context_lens, q_positions)
-        return out, {"k": kc, "v": vc}
+        out, caches = self._sharded(inner)(
+            q[0], newkv, caches, block_tables, context_lens, slot_mapping,
+            layer_idx, q_positions[0, 0],
+        )
+        return out[None], caches
+
+    def _attend_decode(self, q, k, v, caches, layer_idx, block_tables,
+                       context_lens, q_positions, slot_mapping):
+        if not self.use_pallas:
+            caches = write_kv(caches, layer_idx, k[:, 0], v[:, 0], slot_mapping,
+                              self.tp)
+            out = self._xla_attend(q, caches, layer_idx, block_tables,
+                                   context_lens, q_positions)
+            return out, caches
+
+        from production_stack_tpu.ops.paged_attention_pallas import (
+            kv_cache_write_pallas,
+            paged_decode_attention_pallas,
+        )
+
+        newkv = combine_kv(k[:, 0].astype(caches.dtype),
+                           v[:, 0].astype(caches.dtype), self.tp)
+
+        def inner(q3, nk, fused, bt, cl, sm, li, _unused):
+            fused = kv_cache_write_pallas(fused, nk, sm, li)
+            out = paged_decode_attention_pallas(q3, fused, bt, cl, li)
+            return out, fused
+
+        out, caches = self._sharded(inner)(
+            q[:, 0], newkv, caches, block_tables, context_lens, slot_mapping,
+            layer_idx, jnp.int32(0),
+        )
+        return out[:, None], caches
 
     # -- public step API (host numpy in, device out) -------------------------
     def prefill(self, tokens: np.ndarray, positions: np.ndarray,
                 block_table: np.ndarray, context_len: int, slot_mapping: np.ndarray,
-                last_idx: int):
+                last_idx: int, sampling=None) -> int:
         """One sequence's prefill chunk (shapes already padded to a bucket).
-        Returns logits (V,) for last_idx."""
+        Samples the next token from the last chunk position in the same
+        dispatch and returns it (host int)."""
+        s = sampling
+        greedy = s is None or s.temperature <= 0.0
         with jax.set_mesh(self.mesh):
-            self.kv, logits = self._prefill(
+            self.kv, token = self._prefill(
                 self.params, self.kv,
                 jnp.asarray(tokens[None]), jnp.asarray(positions[None]),
                 jnp.asarray(block_table[None]),
                 jnp.asarray([context_len], jnp.int32),
                 jnp.asarray(slot_mapping),
                 jnp.asarray(last_idx, jnp.int32),
+                jnp.asarray(s.temperature if s else 0.0, jnp.float32),
+                jnp.asarray(s.top_p if s else 1.0, jnp.float32),
+                jnp.asarray(s.top_k if s else -1, jnp.int32),
+                jnp.asarray((s.seed or 0) if s else 0, jnp.uint32),
+                greedy_only=greedy,
             )
-        return logits
+        return int(token)
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray, context_lens: np.ndarray,
@@ -170,6 +275,25 @@ class ModelRunner:
                 jnp.asarray(slot_mapping),
             )
         return logits
+
+    def decode_multi(self, tokens, positions, block_tables, context_lens,
+                     slot_mapping, temps, top_ps, top_ks, seeds, steps,
+                     greedy_only: bool = False) -> np.ndarray:
+        """multi_step fused decode+sample iterations; returns sampled tokens
+        (num_steps, B) on host. ``greedy_only`` selects the argmax-only
+        compiled variant (skips the top-k machinery entirely)."""
+        with jax.set_mesh(self.mesh):
+            self.kv, sampled = self._decode_multi(
+                self.params, self.kv,
+                jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+                jnp.asarray(block_tables), jnp.asarray(context_lens),
+                jnp.asarray(slot_mapping),
+                jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+                jnp.asarray(seeds), jnp.asarray(steps),
+                block_size=self.config.cache.block_size,
+                greedy_only=greedy_only,
+            )
+        return np.asarray(jax.device_get(sampled))
 
     def sample(self, logits, temps, top_ps, top_ks, seeds, steps) -> np.ndarray:
         with jax.set_mesh(self.mesh):
@@ -185,22 +309,33 @@ class ModelRunner:
 # ---------------------------------------------------------------------------
 
 def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
-                  block_tables, context_lens, slot_mapping, last_idx):
+                  block_tables, context_lens, slot_mapping, last_idx,
+                  temp, top_p, top_k, seed, greedy_only: bool = False):
+    """Prefill chunk + fused first-token sampling (one dispatch, scalar out)."""
+    from production_stack_tpu.engine.sampling import sample_tokens
     from production_stack_tpu.models.registry import get_model
 
     model = get_model(cfg)
 
-    def attend(q, k, v, layer_cache, layer_idx):
+    def attend(q, k, v, caches, layer_idx):
         return attend_impl(
-            q, k, v, layer_cache, block_tables, context_lens, positions, slot_mapping
+            q, k, v, caches, layer_idx, block_tables, context_lens, positions,
+            slot_mapping,
         )
 
     hidden, new_kv = model.forward_tokens(
         cfg, params, tokens, positions, attend, kv
     )
     last_hidden = jax.lax.dynamic_index_in_dim(hidden[0], last_idx, axis=0)
-    logits = model.logits_from_hidden(cfg, params, last_hidden[None])[0, 0]
-    return new_kv, logits
+    logits = model.logits_from_hidden(cfg, params, last_hidden[None])[0]  # (1, V)
+    if greedy_only:
+        token = jnp.argmax(logits[0]).astype(jnp.int32)
+    else:
+        token = sample_tokens(
+            logits, temp[None], top_p[None], top_k[None], seed[None],
+            jnp.zeros((1,), jnp.int32),
+        )[0]
+    return new_kv, token
 
 
 def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
@@ -209,9 +344,10 @@ def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
 
     model = get_model(cfg)
 
-    def attend(q, k, v, layer_cache, layer_idx):
+    def attend(q, k, v, caches, layer_idx):
         return attend_impl(
-            q, k, v, layer_cache, block_tables, context_lens, positions, slot_mapping
+            q, k, v, caches, layer_idx, block_tables, context_lens, positions,
+            slot_mapping,
         )
 
     hidden, new_kv = model.forward_tokens(
@@ -219,3 +355,55 @@ def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
     )
     logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]  # (B, V)
     return new_kv, logits
+
+
+def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv,
+                       tokens, positions, block_tables, context_lens,
+                       slot_mapping, temps, top_ps, top_ks, seeds, steps,
+                       block_size: int, greedy_only: bool = False):
+    """``num_steps`` fused decode+sample iterations in ONE dispatch.
+
+    The token sampled at iteration i feeds iteration i+1 entirely on device;
+    positions/context lens/slot mappings advance on device too (the host
+    pre-allocated ``num_steps`` tokens of block capacity per sequence).
+    Amortises host→device dispatch latency — the dominant decode cost on
+    single-chip serving. Returns (new_kv, sampled (num_steps, B))."""
+    from production_stack_tpu.engine.sampling import sample_tokens
+    from production_stack_tpu.models.registry import get_model
+
+    model = get_model(cfg)
+    B = tokens.shape[0]
+    active = context_lens > 0
+
+    def one(kv, tok, pos, ctx, slots, step_ctr):
+        def attend(q, k, v, caches, layer_idx):
+            return attend_impl(
+                q, k, v, caches, layer_idx, block_tables, ctx, pos[:, None],
+                slots,
+            )
+
+        hidden, kv = model.forward_tokens(
+            cfg, params, tok[:, None], pos[:, None], attend, kv
+        )
+        logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]
+        if greedy_only:
+            sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            sampled = sample_tokens(logits, temps, top_ps, top_ks, seeds, step_ctr)
+        return kv, sampled
+
+    def body(carry, _):
+        kv, tok, pos, ctx, slots, step_ctr = carry
+        kv, sampled = one(kv, tok, pos, ctx, slots, step_ctr)
+        new_pos = jnp.where(active, pos + 1, pos)
+        new_ctx = jnp.where(active, ctx + 1, ctx)
+        block = block_tables[jnp.arange(B), jnp.clip(new_pos, 0, None) // block_size]
+        new_slots = jnp.where(
+            active, block * block_size + new_pos % block_size, -1
+        )
+        tok = jnp.where(active, sampled, tok)
+        return (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1), sampled
+
+    init = (kv, tokens[:, 0], positions[:, 0], context_lens, slot_mapping, steps)
+    (kv, *_), sampled = jax.lax.scan(body, init, None, length=num_steps)
+    return kv, sampled  # (num_steps, B)

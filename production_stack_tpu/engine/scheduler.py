@@ -141,22 +141,29 @@ class Scheduler:
                 seq.status = SequenceStatus.RUNNING
                 continue
             remaining = seq.prefill_target - seq.num_computed_tokens
-            chunk = min(remaining, self.config.max_num_batched_tokens)
+            chunk = min(
+                remaining,
+                self.config.max_num_batched_tokens,
+                max(self.config.prefill_buckets),  # never pad past a bucket
+            )
             out.prefill = ScheduledPrefill(seq, seq.num_computed_tokens, chunk)
             return out
 
-        # decode all running sequences; grow block tables first
+        # decode all running sequences; grow block tables first so every
+        # sequence has capacity for the next multi_step tokens (positions
+        # num_computed .. num_computed + multi_step - 1)
         decodes = sorted(
             (s for s in self.seqs.values() if s.status is SequenceStatus.RUNNING),
             key=lambda s: s.slot,
         )
+        bs = self.cache_config.block_size
+        horizon = max(self.config.multi_step, 1)
         survivors = []
         for seq in decodes:
             if seq.status is not SequenceStatus.RUNNING:
                 continue  # preempted earlier in this same pass
-            bs = self.cache_config.block_size
-            # slot for the *incoming* token at index num_computed_tokens
-            if seq.num_computed_tokens >= len(seq.block_ids) * bs:
+            preempted_self = False
+            while len(seq.block_ids) * bs < seq.num_computed_tokens + horizon:
                 bid = self.allocator.append_block()
                 while bid is None:
                     victim = self._pick_victim(exclude=seq)
@@ -164,17 +171,18 @@ class Scheduler:
                         # no one else to evict: preempt this sequence itself
                         self._preempt(seq)
                         out.preempted.append(seq)
-                        seq = None
+                        preempted_self = True
                         break
                     self._preempt(victim)
                     out.preempted.append(victim)
                     if victim in survivors:
                         survivors.remove(victim)
                     bid = self.allocator.append_block()
-                if seq is None:
-                    continue
+                if preempted_self:
+                    break
                 seq.block_ids.append(bid)
-            survivors.append(seq)
+            if not preempted_self:
+                survivors.append(seq)
         out.decodes = survivors
         return out
 

@@ -177,29 +177,32 @@ def forward_tokens(
     """Run the decoder stack.
 
     tokens: (..., T) int32; positions: (..., T) int32.
-    kv_caches: pytree with leading layer axis (or None); scanned alongside the
-    stacked layer params, updated copies returned.
+    kv_caches: the FULL cache pytree (leading layer axis) or None. It rides
+    the scan *carry*, not ys: while-loop carries alias in place under XLA,
+    so a donated multi-GiB HBM pool is updated without ever being copied
+    (scan ys would allocate a fresh stacked output every step — measured as
+    2× cache HLO-temp on v5e). ``attend`` receives the full cache plus the
+    layer index and returns the updated full cache.
     Returns (hidden (..., T, E), new_kv_caches).
     """
     x = params["embed"].astype(cfg.jax_dtype)[tokens]
 
-    def layer_fn(carry, scanned):
-        h, layer_idx = carry
-        lp, layer_cache = scanned
+    def layer_fn(carry, lp):
+        h, layer_idx, caches = carry
         normed = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
         q = jnp.einsum("...te,ehd->...thd", normed, lp["wq"])
         k = jnp.einsum("...te,ehd->...thd", normed, lp["wk"])
         v = jnp.einsum("...te,ehd->...thd", normed, lp["wv"])
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        attn, new_cache = attend(q, k, v, layer_cache, layer_idx)
+        attn, caches = attend(q, k, v, caches, layer_idx)
         h = h + jnp.einsum("...thd,hde->...te", attn, lp["wo"])
         normed2 = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         h = h + _mlp(cfg, lp, normed2)
-        return (h, layer_idx + 1), new_cache
+        return (h, layer_idx + 1, caches), None
 
-    (x, _), new_caches = lax.scan(
-        layer_fn, (x, jnp.int32(0)), (params["layers"], kv_caches)
+    (x, _, new_caches), _ = lax.scan(
+        layer_fn, (x, jnp.int32(0), kv_caches), params["layers"]
     )
     return x, new_caches
 
@@ -223,8 +226,8 @@ def forward_dense(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
-    def attend(q, k, v, layer_cache, layer_idx):
-        return dense_causal_attention(q, k, v), layer_cache
+    def attend(q, k, v, caches, layer_idx):
+        return dense_causal_attention(q, k, v), caches
 
     hidden, _ = forward_tokens(cfg, params, tokens, positions, attend, None)
     return logits_from_hidden(cfg, params, hidden)

@@ -1,21 +1,24 @@
 """Paged attention over the block-table KV cache — XLA reference path.
 
-One function serves both phases of continuous batching:
+Cache layout (single fused buffer): ``(L, N, block_size, 2*KH, D)``.
 
-- decode: S = 1, every running slot advances one token;
-- (chunked) prefill: S = chunk length, the chunk's KV has already been
-  scattered into the cache, so queries attend to the full paged context.
+Why this layout (all measured on v5e):
+- ONE buffer + ONE scatter per layer keeps the donated pool aliased through
+  the scan carry (two carried buffers, or two scatters, cost a full pool
+  copy per step);
+- a token's K+V for all heads is one contiguous ``(2*KH, D)`` slab — the
+  exact bf16 (16, 128) tile at KH=8 — so Pallas writes/reads slice only
+  leading dims and one DMA moves K and V together;
+- the head dim is grouped per tensor-parallel shard: ``[K_shard0, V_shard0,
+  K_shard1, V_shard1, ...]`` so a NamedSharding split over the 2*KH dim
+  hands each shard its own `[K_local, V_local]` halves.
 
-This implementation gathers the (bucketed) context KV via the block table and
-runs a masked softmax-matmul — simple, correct, and what CPU CI runs. On TPU
-the Pallas kernel in ``paged_attention_pallas.py`` replaces it on the decode
-hot path: it walks the block table with async HBM→VMEM DMA and never
-materialises the gather.
+This module is the XLA path: exact, gather-based, used on CPU CI and as the
+fallback; the serving hot path on TPU is ops/paged_attention_pallas.py.
 
 Shapes:
   q:            (B, S, H, D)
-  k/v cache:    (KH, num_blocks, block_size, D)   (single layer; KV-heads
-                lead so the TP shard axis is dim 0 — see kv_cache.py)
+  kv cache:     (L, N, bs, 2*KH, D) fused, or a single layer (N, bs, 2*KH, D)
   block_tables: (B, M) int32 — padded with 0s beyond the sequence's blocks
   context_lens: (B,)  int32 — total tokens in cache per sequence (incl. chunk)
   q_positions:  (B, S) int32 — absolute position per query token, -1 for pad
@@ -29,24 +32,65 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def combine_kv(k: jnp.ndarray, v: jnp.ndarray, tp: int = 1) -> jnp.ndarray:
+    """(T, KH, D) k and v → (T, 2*KH, D) shard-grouped update slab."""
+    T, KH, D = k.shape
+    hp = KH // tp
+    stacked = jnp.stack(
+        [k.reshape(T, tp, hp, D), v.reshape(T, tp, hp, D)], axis=2
+    )  # (T, tp, 2, hp, D)
+    return stacked.reshape(T, 2 * KH, D)
+
+
+def split_kv(kv: jnp.ndarray, tp: int = 1) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Inverse of combine_kv on any (..., 2*KH, D) array."""
+    *lead, KH2, D = kv.shape
+    KH = KH2 // 2
+    hp = KH // tp
+    r = kv.reshape(*lead, tp, 2, hp, D)
+    k = r[..., :, 0, :, :].reshape(*lead, KH, D)
+    v = r[..., :, 1, :, :].reshape(*lead, KH, D)
+    return k, v
+
+
+def write_kv(
+    cache: jnp.ndarray,
+    layer_idx: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    slot_mapping: jnp.ndarray,
+    tp: int = 1,
+) -> jnp.ndarray:
+    """Scatter T tokens' K+V into layer ``layer_idx`` of the fused cache with
+    ONE scatter (in place through a donated scan carry). k/v: (T, KH, D);
+    slot_mapping: (T,) flat block*block_size+offset, -1 = dropped padding."""
+    L, n, bs, KH2, D = cache.shape
+    slots = jnp.where(slot_mapping < 0, n * bs, slot_mapping)
+    update = combine_kv(k.astype(cache.dtype), v.astype(cache.dtype), tp)
+    flat = cache.reshape(L, n * bs, KH2, D)
+    flat = flat.at[layer_idx, slots].set(update, mode="drop", unique_indices=True)
+    return flat.reshape(L, n, bs, KH2, D)
+
+
 def paged_attention(
     q: jnp.ndarray,
-    k_cache: jnp.ndarray,
-    v_cache: jnp.ndarray,
+    kv_layer: jnp.ndarray,  # (N, bs, 2*KH, D) — one layer of the pool
     block_tables: jnp.ndarray,
     context_lens: jnp.ndarray,
     q_positions: jnp.ndarray,
+    tp: int = 1,
     scale: float | None = None,
 ) -> jnp.ndarray:
     B, S, H, D = q.shape
-    KH, _, block_size, _ = k_cache.shape
+    n, block_size, KH2, _ = kv_layer.shape
+    KH = KH2 // 2
     M = block_tables.shape[1]
     G = H // KH
     scale = scale if scale is not None else D**-0.5
 
-    # Gather context: (KH, B, M, bs, D) -> (B, Tc, KH, D)
-    k = k_cache[:, block_tables].reshape(KH, B, M * block_size, D).transpose(1, 2, 0, 3)
-    v = v_cache[:, block_tables].reshape(KH, B, M * block_size, D).transpose(1, 2, 0, 3)
+    # Gather context: (B, M, bs, 2KH, D) -> (B, Tc, KH, D) k and v
+    gathered = kv_layer[block_tables].reshape(B, M * block_size, KH2, D)
+    k, v = split_kv(gathered, tp)
 
     kv_pos = jnp.arange(M * block_size, dtype=jnp.int32)[None, :]  # (1, Tc)
     valid_kv = kv_pos < context_lens[:, None]  # (B, Tc)
@@ -64,27 +108,3 @@ def paged_attention(
     probs = probs / jnp.maximum(denom, 1e-30)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
     return out.reshape(B, S, H, D).astype(q.dtype)
-
-
-def write_kv_to_cache(
-    k_cache: jnp.ndarray,
-    v_cache: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    slot_mapping: jnp.ndarray,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter new KV for T tokens into the block pool.
-
-    k/v: (T, KH, D); caches: (KH, N, bs, D); slot_mapping: (T,) flat indices
-    block*block_size+offset, -1 for padding (dropped). Returns updated caches
-    (XLA performs the update in place when the caller donates the buffers).
-    """
-    KH, n, bs, D = k_cache.shape
-    # negative (padding) slots would wrap in JAX indexing; remap them past the
-    # end so mode="drop" discards them
-    slots = jnp.where(slot_mapping < 0, n * bs, slot_mapping)
-    flat_k = k_cache.reshape(KH, n * bs, D)
-    flat_v = v_cache.reshape(KH, n * bs, D)
-    flat_k = flat_k.at[:, slots].set(k.astype(flat_k.dtype).swapaxes(0, 1), mode="drop")
-    flat_v = flat_v.at[:, slots].set(v.astype(flat_v.dtype).swapaxes(0, 1), mode="drop")
-    return flat_k.reshape(KH, n, bs, D), flat_v.reshape(KH, n, bs, D)

@@ -1,14 +1,29 @@
-"""Pallas TPU kernel: paged decode attention.
+"""Pallas TPU kernels for the paged-KV serving hot path.
 
-The decode hot loop of the serving engine. Per (sequence, kv-head) grid cell
-the kernel walks that sequence's block table, DMAs each KV block HBM→VMEM,
-and maintains a flash-attention running softmax over the G grouped query
-heads. The gather that the XLA reference path materialises
-(ops/paged_attention.py) never exists here — HBM traffic is exactly the live
-context, which is what makes decode HBM-bandwidth-optimal on TPU
-(PAPERS.md: Ragged Paged Attention).
+Three kernels over the fused cache layout ``(L, N, block_size, 2*KH, D)``
+(see ops/paged_attention.py for the layout rationale):
 
-Double-buffered: block j+1's DMA is issued before block j is processed.
+- ``paged_decode_attention_pallas``: one grid cell per sequence; walks the
+  block table in windows of W blocks, one async DMA per block moving the
+  whole ``(bs, 2KH, D)`` K+V slab, double-buffered windows, flash running
+  softmax batched over heads.
+- ``paged_prefill_attention_pallas``: one grid cell per query tile of a
+  single sequence's chunk; same windowed context walk with causal masking —
+  this replaces the XLA dynamic-slice + gather path whose per-layer cost is
+  ~8 ms on a multi-GiB pool (measured v5e).
+- ``kv_cache_write_pallas``: scatters T new tokens into the pool as T async
+  ``(2KH, D)``-slab DMAs on a semaphore ring — the XLA scatter costs a flat
+  ~0.65 ms/layer; this is ~10-20 µs. The cache is aliased input→output, so
+  the donated pool is updated in place.
+
+All kernels take the layer index as a scalar so the full multi-layer pool
+never gets sliced/copied. Grid cells execute sequentially on a TensorCore —
+work per cell is kept coarse (whole sequence / whole tile) and DMAs are
+issued in async batches to hide latency.
+
+Reference context: the reference stack delegates attention kernels to vLLM
+(SURVEY.md §7 step 1); these kernels are the TPU-native equivalent of its
+paged-attention/FlashAttention layer (PAPERS.md: Ragged Paged Attention).
 """
 
 from __future__ import annotations
@@ -23,129 +38,400 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
 def _decode_kernel(
     # scalar prefetch
-    block_tables_ref,  # (B, M) SMEM
-    context_lens_ref,  # (B,)  SMEM
-    # blocked inputs
-    q_ref,  # (1, 1, G, D) VMEM
-    k_hbm,  # (KH, N, bs, D) ANY/HBM — heads lead; DMA slices leading dims only
-    v_hbm,
-    # output
-    o_ref,  # (1, 1, G, D) VMEM
+    bt_ref,  # (B, M) SMEM
+    cl_ref,  # (B,) SMEM
+    layer_ref,  # (1,) SMEM
+    # inputs
+    q_ref,  # (1, KH, G, D) VMEM
+    kv_hbm,  # (L, N, bs, 2KH, D) ANY
+    # outputs
+    o_ref,  # (1, KH, G, D) VMEM
     # scratch
-    k_scr,  # (2, bs, D) VMEM
-    v_scr,
-    sems,  # DMA sems (2, 2)
+    buf,  # (2, W, bs, 2KH, D) VMEM
+    sems,  # (2, W) DMA sems
     *,
     block_size: int,
+    windows: int,
     scale: float,
 ):
     b = pl.program_id(0)
-    kh = pl.program_id(1)
-    ctx = context_lens_ref[b]
-    nblocks = pl.cdiv(ctx, block_size)
-    G, D = q_ref.shape[2], q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32)  # (G, D)
+    layer = layer_ref[0]
+    ctx = cl_ref[b]
+    W = windows
+    bs = block_size
+    KH, G, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    win_tokens = W * bs
+    nwin = pl.cdiv(ctx, win_tokens)
 
-    def dma_k(slot, j):
-        bid = block_tables_ref[b, j]
+    def dma(slot, w, j):
+        bid = bt_ref[b, w * W + j]
         return pltpu.make_async_copy(
-            k_hbm.at[kh, bid], k_scr.at[slot], sems.at[slot, 0]
+            kv_hbm.at[layer, bid], buf.at[slot, j], sems.at[slot, j]
         )
 
-    def dma_v(slot, j):
-        bid = block_tables_ref[b, j]
-        return pltpu.make_async_copy(
-            v_hbm.at[kh, bid], v_scr.at[slot], sems.at[slot, 1]
-        )
+    def issue(slot, w):
+        for j in range(W):
+            dma(slot, w, j).start()
 
-    @pl.when(nblocks > 0)
+    @pl.when(nwin > 0)
     def _():
-        dma_k(0, 0).start()
-        dma_v(0, 0).start()
+        issue(0, 0)
 
-    def body(j, carry):
+    q = q_ref[0].astype(jnp.float32)  # (KH, G, D)
+
+    def body(w, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(j, 2)
-        nxt = jax.lax.rem(j + 1, 2)
+        slot = jax.lax.rem(w, 2)
 
-        @pl.when(j + 1 < nblocks)
+        @pl.when(w + 1 < nwin)
         def _():
-            dma_k(nxt, j + 1).start()
-            dma_v(nxt, j + 1).start()
+            issue(jax.lax.rem(w + 1, 2), w + 1)
 
-        dma_k(slot, j).wait()
-        dma_v(slot, j).wait()
-        k = k_scr[slot].astype(jnp.float32)  # (bs, D)
-        v = v_scr[slot].astype(jnp.float32)
+        for j in range(W):
+            dma(slot, w, j).wait()
 
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (G, bs)
-        pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1
+        kv = buf[slot].reshape(win_tokens, 2 * KH, D)  # (T, 2KH, D)
+        # per-head static loop: Mosaic's batched matmul needs batch dims at
+        # position 0 on both operands, which this layout can't provide
+        s_heads = []
+        for h in range(KH):
+            k_h = kv[:, h, :].astype(jnp.float32)  # (T, D)
+            s_heads.append(
+                jax.lax.dot_general(
+                    q[h], k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # (G, T)
+        s = jnp.stack(s_heads) * scale  # (KH, G, T)
+        kvpos = w * win_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, win_tokens), 2
         )
-        s = jnp.where(pos < ctx, s, NEG_INF)
+        s = jnp.where(kvpos < ctx, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        acc_heads = []
+        for h in range(KH):
+            v_h = kv[:, KH + h, :].astype(jnp.float32)  # (T, D)
+            acc_heads.append(
+                jax.lax.dot_general(
+                    p[h], v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # (G, D)
+        acc_new = acc * alpha + jnp.stack(acc_heads)
         return m_new, l_new, acc_new
 
     init = (
-        jnp.full((G, 1), NEG_INF, jnp.float32),
-        jnp.zeros((G, 1), jnp.float32),
-        jnp.zeros((G, D), jnp.float32),
+        jnp.full((KH, G, 1), NEG_INF, jnp.float32),
+        jnp.zeros((KH, G, 1), jnp.float32),
+        jnp.zeros((KH, G, D), jnp.float32),
     )
-    m, l, acc = jax.lax.fori_loop(0, nblocks, body, init)
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(0, nwin, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # (B, H, D)
-    k_cache: jnp.ndarray,  # (KH, N, bs, D)
-    v_cache: jnp.ndarray,
-    block_tables: jnp.ndarray,  # (B, M) int32
-    context_lens: jnp.ndarray,  # (B,) int32
+    kv_cache: jnp.ndarray,  # (L, N, bs, 2KH, D)
+    block_tables: jnp.ndarray,  # (B, M)
+    context_lens: jnp.ndarray,  # (B,)
+    layer_idx: jnp.ndarray | int = 0,
+    windows: int = 8,
     interpret: bool = False,
 ) -> jnp.ndarray:
     B, H, D = q.shape
-    KH, _, block_size, _ = k_cache.shape
+    L, N, bs, KH2, _ = kv_cache.shape
+    KH = KH2 // 2
     G = H // KH
-    scale = D**-0.5
-
+    # q heads are shard-grouped like the cache: here a single shard's view,
+    # heads ordered [h0..h_{KH-1}] matching [K_0..K_{KH-1}] halves
     q4 = q.reshape(B, KH, G, D)
+    layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KH),
+        num_scalar_prefetch=3,
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, G, D), lambda b, kh, *_: (b, kh, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, G, D), lambda b, kh, *_: (b, kh, 0, 0), memory_space=pltpu.VMEM
-        ),
+        out_specs=pl.BlockSpec((1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, block_size, D), k_cache.dtype),
-            pltpu.VMEM((2, block_size, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, windows, bs, KH2, D), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, windows)),
         ],
     )
-    kernel = functools.partial(_decode_kernel, block_size=block_size, scale=scale)
+    kernel = functools.partial(
+        _decode_kernel, block_size=bs, windows=windows, scale=D**-0.5
+    )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, KH, G, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables, context_lens, q4, k_cache, v_cache)
+    )(block_tables, context_lens, layer_arr, q4, kv_cache)
     return out.reshape(B, H, D)
+
+
+# ---------------------------------------------------------------------------
+# prefill (single sequence, chunked; causal over the paged context)
+# ---------------------------------------------------------------------------
+
+def _prefill_kernel(
+    # scalar prefetch
+    bt_ref,  # (M,) SMEM — this sequence's block table row
+    meta_ref,  # (3,) SMEM — (layer, q_start, ctx_total)
+    # inputs
+    q_ref,  # (R, KH, D) VMEM — R = TQ*G rows of this tile
+    kv_hbm,  # (L, N, bs, 2KH, D) ANY
+    # outputs
+    o_ref,  # (R, KH, D) VMEM
+    # scratch
+    buf,  # (2, W, bs, 2KH, D) VMEM
+    sems,  # (2, W)
+    *,
+    block_size: int,
+    windows: int,
+    q_tile: int,
+    group: int,
+    scale: float,
+):
+    t = pl.program_id(0)
+    layer = meta_ref[0]
+    q_start = meta_ref[1]
+    ctx = meta_ref[2]
+    W = windows
+    bs = block_size
+    win_tokens = W * bs
+    R, KH, D = q_ref.shape
+
+    # this tile's queries reach absolute position q_start + (t+1)*q_tile - 1
+    reach = jnp.minimum(ctx, q_start + (t + 1) * q_tile)
+    nwin = pl.cdiv(reach, win_tokens)
+
+    def dma(slot, w, j):
+        bid = bt_ref[w * W + j]
+        return pltpu.make_async_copy(
+            kv_hbm.at[layer, bid], buf.at[slot, j], sems.at[slot, j]
+        )
+
+    def issue(slot, w):
+        for j in range(W):
+            dma(slot, w, j).start()
+
+    @pl.when(nwin > 0)
+    def _():
+        issue(0, 0)
+
+    q = q_ref[:].astype(jnp.float32)  # (R, KH, D)
+    # row r is query token s = t*TQ + r//G at absolute position q_start + s
+    qpos = q_start + t * q_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (1, R, 1), 1
+    ) // group  # (1, R, 1)
+
+    def body(w, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(w, 2)
+
+        @pl.when(w + 1 < nwin)
+        def _():
+            issue(jax.lax.rem(w + 1, 2), w + 1)
+
+        for j in range(W):
+            dma(slot, w, j).wait()
+
+        kv = buf[slot].reshape(win_tokens, 2 * KH, D)
+        s_heads = []
+        for h in range(KH):
+            k_h = kv[:, h, :].astype(jnp.float32)  # (T, D)
+            q_h = q[:, h, :]  # (R, D)
+            s_heads.append(
+                jax.lax.dot_general(
+                    q_h, k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # (R, T)
+        s = jnp.stack(s_heads) * scale  # (KH, R, T)
+        kvpos = w * win_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, win_tokens), 2
+        )
+        valid = (kvpos <= qpos) & (kvpos < ctx)  # (1, R, T)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_heads = []
+        for h in range(KH):
+            v_h = kv[:, KH + h, :].astype(jnp.float32)
+            acc_heads.append(
+                jax.lax.dot_general(
+                    p[h], v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # (R, D)
+        acc_new = acc * alpha + jnp.stack(acc_heads)
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((KH, R, 1), NEG_INF, jnp.float32),
+        jnp.zeros((KH, R, 1), jnp.float32),
+        jnp.zeros((KH, R, D), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, nwin, body, init)
+    out = acc / jnp.maximum(l, 1e-30)  # (KH, R, D)
+    o_ref[:] = out.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def paged_prefill_attention_pallas(
+    q: jnp.ndarray,  # (S, H, D) — the chunk's queries, S padded to a bucket
+    kv_cache: jnp.ndarray,  # (L, N, bs, 2KH, D)
+    block_table: jnp.ndarray,  # (M,) this sequence's blocks
+    q_start: jnp.ndarray | int,  # chunk's first absolute position
+    ctx_total: jnp.ndarray | int,  # q_start + chunk_len
+    layer_idx: jnp.ndarray | int = 0,
+    q_tile: int = 128,
+    windows: int = 8,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    S, H, D = q.shape
+    L, N, bs, KH2, _ = kv_cache.shape
+    KH = KH2 // 2
+    G = H // KH
+    TQ = min(q_tile, S)
+    n_tiles = S // TQ
+    R = TQ * G
+
+    # rows ordered (s, g): q (S, H, D) -> (S, KH, G, D) -> (S, G, KH, D)
+    q_rows = q.reshape(S, KH, G, D).transpose(0, 2, 1, 3).reshape(S * G, KH, D)
+    meta = jnp.stack(
+        [
+            jnp.asarray(layer_idx, jnp.int32),
+            jnp.asarray(q_start, jnp.int32),
+            jnp.asarray(ctx_total, jnp.int32),
+        ]
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((R, KH, D), lambda t, *_: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((R, KH, D), lambda t, *_: (t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, windows, bs, KH2, D), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, windows)),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_size=bs, windows=windows, q_tile=TQ,
+        group=G, scale=D**-0.5,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S * G, KH, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_table, meta, q_rows, kv_cache)
+    # rows (s, g) back to (S, H, D) with h = kh*G + g
+    return out.reshape(S, G, KH, D).transpose(0, 2, 1, 3).reshape(S, H, D)
+
+
+# ---------------------------------------------------------------------------
+# KV write
+# ---------------------------------------------------------------------------
+
+_RING = 8
+
+
+def _kv_write_kernel(
+    # scalar prefetch
+    slots_ref,  # (T,) SMEM — flat cache slots, -1 = skip
+    layer_ref,  # (1,) SMEM
+    # inputs
+    newkv_ref,  # (T, 2KH, D) VMEM
+    kv_hbm,  # (L, N, bs, 2KH, D) ANY (aliased to output)
+    # output
+    out_hbm,  # aliased kv_hbm
+    # scratch
+    sems,  # (RING,) DMA sems
+    *,
+    block_size: int,
+    total: int,
+):
+    layer = layer_ref[0]
+
+    def dma(i):
+        slot = slots_ref[i]
+        bid = slot // block_size
+        off = slot - bid * block_size
+        return pltpu.make_async_copy(
+            newkv_ref.at[i], out_hbm.at[layer, bid, off], sems.at[i % _RING]
+        )
+
+    def body(i, _):
+        @pl.when(i >= _RING)
+        def _():
+            @pl.when(slots_ref[i - _RING] >= 0)
+            def _():
+                dma(i - _RING).wait()
+
+        @pl.when(slots_ref[i] >= 0)
+        def _():
+            dma(i).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, total, body, 0)
+    # drain the ring
+    for r in range(max(_RING - total, 0), _RING):
+        i = total - _RING + r
+
+        @pl.when(slots_ref[i] >= 0)
+        def _(i=i):
+            dma(i).wait()
+
+
+def kv_cache_write_pallas(
+    kv_cache: jnp.ndarray,  # (L, N, bs, 2KH, D) — donated, updated in place
+    newkv: jnp.ndarray,  # (T, 2KH, D) combined update (see combine_kv)
+    slot_mapping: jnp.ndarray,  # (T,) int32, -1 = padding
+    layer_idx: jnp.ndarray | int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    L, N, bs, KH2, D = kv_cache.shape
+    T = newkv.shape[0]
+    layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_RING,))],
+    )
+    kernel = functools.partial(_kv_write_kernel, block_size=bs, total=T)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        input_output_aliases={3: 0},  # kv_hbm input → output buffer
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(slot_mapping, layer_arr, newkv, kv_cache)

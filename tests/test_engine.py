@@ -115,6 +115,19 @@ def test_preemption_recompute_matches_dense(setup):
         assert got[f"offline-{i}"] == want, f"prompt {i} diverged under preemption"
 
 
+def test_multi_step_decode_matches_dense(setup):
+    """K fused decode iterations per dispatch must not change results."""
+    cfg, mesh, params = setup
+    sched = dataclasses.replace(cfg.scheduler, multi_step=4)
+    eng = make_engine(setup, scheduler=sched)
+    got = eng.generate(PROMPTS, SamplingParams(temperature=0.0, max_tokens=10,
+                                               ignore_eos=True))
+    for i, p in enumerate(PROMPTS):
+        want = naive_greedy(cfg.model, params, p, 10, mesh)
+        assert got[f"offline-{i}"] == want, f"prompt {i} diverged with multi_step"
+        assert len(got[f"offline-{i}"]) == 10  # surplus discarded exactly
+
+
 def test_seeded_sampling_reproducible(setup):
     eng = make_engine(setup)
     sp = SamplingParams(temperature=0.8, top_p=0.9, seed=1234, max_tokens=10,

@@ -1,4 +1,8 @@
-"""Paged KV cache + paged attention correctness."""
+"""Paged KV cache + paged attention correctness (fused (L,N,bs,2KH,D)
+layout): XLA reference path vs dense attention, Pallas kernels vs XLA in
+interpret mode, allocator semantics."""
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -11,63 +15,69 @@ from production_stack_tpu.engine.kv_cache import (
 )
 from production_stack_tpu.ops.attention import dense_causal_attention
 from production_stack_tpu.ops.paged_attention import (
+    combine_kv,
     paged_attention,
-    write_kv_to_cache,
+    split_kv,
+    write_kv,
 )
 
 BS = 4  # block size
+KH, D, L = 2, 8, 2
 
 
-def build_cache(rng, num_blocks, KH, D):
-    k = jnp.zeros((KH, num_blocks, BS, D), jnp.float32)
-    v = jnp.zeros((KH, num_blocks, BS, D), jnp.float32)
-    return k, v
+def empty_cache(num_blocks, kh=KH, d=D, layers=L):
+    return jnp.zeros((layers, num_blocks, BS, 2 * kh, d), jnp.float32)
 
 
-def scatter_sequence(k_cache, v_cache, ks, vs, block_ids):
+def scatter_sequence(cache, layer, ks, vs, block_ids):
     T = ks.shape[0]
     slots = jnp.asarray(slot_mapping_for(block_ids, 0, T, BS))
-    return write_kv_to_cache(k_cache, v_cache, ks, vs, slots)
+    return write_kv(cache, jnp.int32(layer), ks, vs, slots)
+
+
+def test_combine_split_roundtrip():
+    rng = np.random.default_rng(0)
+    k = jnp.asarray(rng.standard_normal((5, 8, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((5, 8, 16)), jnp.float32)
+    for tp in (1, 2, 4):
+        fused = combine_kv(k, v, tp)
+        k2, v2 = split_kv(fused, tp)
+        np.testing.assert_array_equal(np.asarray(k2), np.asarray(k))
+        np.testing.assert_array_equal(np.asarray(v2), np.asarray(v))
 
 
 def test_paged_decode_matches_dense():
     rng = np.random.default_rng(0)
-    H, KH, D = 4, 2, 8
+    H = 4
     lens = [7, 13, 4]
     B = len(lens)
-    k_cache, v_cache = build_cache(rng, num_blocks=32, KH=KH, D=D)
+    cache = empty_cache(32)
 
-    # scatter each sequence's context into disjoint blocks
     tables = np.zeros((B, 8), np.int32)
     all_k, all_v = [], []
     next_block = 0
-    for i, L in enumerate(lens):
-        nb = -(-L // BS)
+    for i, Ln in enumerate(lens):
+        nb = -(-Ln // BS)
         ids = list(range(next_block, next_block + nb))
         next_block += nb
         tables[i, :nb] = ids
-        ks = rng.standard_normal((L, KH, D), dtype=np.float32)
-        vs = rng.standard_normal((L, KH, D), dtype=np.float32)
+        ks = rng.standard_normal((Ln, KH, D), dtype=np.float32)
+        vs = rng.standard_normal((Ln, KH, D), dtype=np.float32)
         all_k.append(ks)
         all_v.append(vs)
-        k_cache, v_cache = scatter_sequence(
-            k_cache, v_cache, jnp.asarray(ks), jnp.asarray(vs), ids
-        )
+        cache = scatter_sequence(cache, 1, jnp.asarray(ks), jnp.asarray(vs), ids)
 
-    # decode: one query per sequence at position len-1
     q = rng.standard_normal((B, 1, H, D), dtype=np.float32)
     out = paged_attention(
-        jnp.asarray(q), k_cache, v_cache,
+        jnp.asarray(q), cache[1],
         jnp.asarray(tables), jnp.asarray(lens, jnp.int32),
-        jnp.asarray([[L - 1] for L in lens], jnp.int32),
+        jnp.asarray([[Ln - 1] for Ln in lens], jnp.int32),
     )
-    # reference: dense causal attention over the full sequence, last token
-    for i, L in enumerate(lens):
-        full_q = np.zeros((1, L, H, D), np.float32)
+    for i, Ln in enumerate(lens):
+        full_q = np.zeros((1, Ln, H, D), np.float32)
         full_q[0, -1] = q[i, 0]
         want = dense_causal_attention(
-            jnp.asarray(full_q),
-            jnp.asarray(all_k[i])[None],
+            jnp.asarray(full_q), jnp.asarray(all_k[i])[None],
             jnp.asarray(all_v[i])[None],
         )[0, -1]
         np.testing.assert_allclose(
@@ -76,28 +86,37 @@ def test_paged_decode_matches_dense():
 
 
 def test_paged_chunk_prefill_matches_dense():
-    """Chunked prefill: second chunk attends to first chunk through the cache."""
     rng = np.random.default_rng(1)
-    H, KH, D = 4, 2, 8
-    L1, L2 = 6, 5  # prefix already cached, new chunk
-    L = L1 + L2
-    k_cache, v_cache = build_cache(rng, num_blocks=16, KH=KH, D=D)
+    H = 4
+    L1, L2 = 6, 5
+    T = L1 + L2
+    cache = empty_cache(16)
     ids = [0, 1, 2]
-    ks = rng.standard_normal((L, KH, D), dtype=np.float32)
-    vs = rng.standard_normal((L, KH, D), dtype=np.float32)
-    k_cache, v_cache = scatter_sequence(k_cache, v_cache, jnp.asarray(ks), jnp.asarray(vs), ids)
+    ks = rng.standard_normal((T, KH, D), dtype=np.float32)
+    vs = rng.standard_normal((T, KH, D), dtype=np.float32)
+    cache = scatter_sequence(cache, 0, jnp.asarray(ks), jnp.asarray(vs), ids)
 
-    qs = rng.standard_normal((L, H, D), dtype=np.float32)
+    qs = rng.standard_normal((T, H, D), dtype=np.float32)
     tables = jnp.asarray([[0, 1, 2, 0]], jnp.int32)
     out = paged_attention(
-        jnp.asarray(qs[None, L1:]), k_cache, v_cache, tables,
-        jnp.asarray([L], jnp.int32),
-        jnp.asarray(np.arange(L1, L, dtype=np.int32)[None]),
+        jnp.asarray(qs[None, L1:]), cache[0], tables,
+        jnp.asarray([T], jnp.int32),
+        jnp.asarray(np.arange(L1, T, dtype=np.int32)[None]),
     )
     want = dense_causal_attention(
         jnp.asarray(qs[None]), jnp.asarray(ks[None]), jnp.asarray(vs[None])
     )[0, L1:]
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+def build_random_cache(rng, layers, n, kh, d):
+    return jnp.asarray(
+        rng.standard_normal((layers, n, BS, 2 * kh, d)), jnp.float32
+    )
 
 
 def test_pallas_decode_matches_xla_interpret():
@@ -106,24 +125,77 @@ def test_pallas_decode_matches_xla_interpret():
         paged_decode_attention_pallas,
     )
 
-    H, KH, D = 8, 4, 16
-    B, N, M = 3, 16, 4
+    kh, d, H = 4, 16, 8
+    B, N, M, layers = 3, 16, 8, 2
+    layer = 1
     lens = np.array([9, 16, 3], np.int32)
-    k_cache = rng.standard_normal((KH, N, BS, D), dtype=np.float32)
-    v_cache = rng.standard_normal((KH, N, BS, D), dtype=np.float32)
+    cache = build_random_cache(rng, layers, N, kh, d)
     tables = rng.integers(0, N, (B, M)).astype(np.int32)
-    q = rng.standard_normal((B, H, D), dtype=np.float32)
+    q = rng.standard_normal((B, H, d), dtype=np.float32)
 
     got = paged_decode_attention_pallas(
-        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(tables), jnp.asarray(lens), interpret=True,
+        jnp.asarray(q), cache, jnp.asarray(tables), jnp.asarray(lens),
+        layer, windows=2, interpret=True,
     )
     want = paged_attention(
-        jnp.asarray(q)[:, None], jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(tables), jnp.asarray(lens),
-        jnp.asarray(lens - 1)[:, None],
+        jnp.asarray(q)[:, None], cache[layer], jnp.asarray(tables),
+        jnp.asarray(lens), jnp.asarray(lens - 1)[:, None],
     )[:, 0]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_prefill_matches_xla_interpret():
+    rng = np.random.default_rng(3)
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        paged_prefill_attention_pallas,
+    )
+
+    kh, d, H = 4, 16, 8
+    N, M, layers = 16, 8, 2
+    layer = 0
+    S_pad, chunk, q_start = 8, 6, 5  # chunked continuation: ctx = 11
+    ctx = q_start + chunk
+    cache = build_random_cache(rng, layers, N, kh, d)
+    table = np.arange(M, dtype=np.int32)
+    q = rng.standard_normal((S_pad, H, d), dtype=np.float32)
+
+    got = paged_prefill_attention_pallas(
+        jnp.asarray(q), cache, jnp.asarray(table),
+        q_start, ctx, layer, q_tile=8, windows=2, interpret=True,
+    )
+    positions = np.full((1, S_pad), -1, np.int32)
+    positions[0, :chunk] = np.arange(q_start, ctx)
+    want = paged_attention(
+        jnp.asarray(q[None]), cache[layer], jnp.asarray(table[None]),
+        jnp.asarray([ctx], jnp.int32), jnp.asarray(positions),
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(got[:chunk]), np.asarray(want[:chunk]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pallas_kv_write_matches_scatter_interpret():
+    rng = np.random.default_rng(4)
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        kv_cache_write_pallas,
+    )
+
+    kh, d, layers, N = 4, 16, 2, 8
+    T = 10
+    cache = build_random_cache(rng, layers, N, kh, d)
+    k = jnp.asarray(rng.standard_normal((T, kh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((T, kh, d)), jnp.float32)
+    slots = np.full(T, -1, np.int32)
+    slots[:7] = rng.permutation(N * BS)[:7]  # 3 padding slots skipped
+    layer = 1
+
+    want = write_kv(cache, jnp.int32(layer), k, v, jnp.asarray(slots))
+    newkv = combine_kv(k, v)
+    got = jax.jit(
+        functools.partial(kv_cache_write_pallas, interpret=True),
+        donate_argnums=(0,),
+    )(cache, newkv, jnp.asarray(slots), jnp.int32(layer))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 # ---------------------------------------------------------------------------
@@ -132,7 +204,7 @@ def test_pallas_decode_matches_xla_interpret():
 
 def test_allocator_prefix_reuse_and_eviction():
     a = PrefixCachingBlockAllocator(num_blocks=8, block_size=4)
-    toks = list(range(17))  # 4 full blocks + 1 token
+    toks = list(range(17))
     got = a.allocate_sequence(toks)
     assert got is not None
     blocks, cached = got
@@ -140,26 +212,24 @@ def test_allocator_prefix_reuse_and_eviction():
     a.commit_full_blocks(toks, blocks)
     a.free_blocks(blocks)
 
-    # same prompt again: 4 full blocks are reusable via prefix cache
     blocks2, cached2 = a.allocate_sequence(toks)
     assert cached2 == 16
     assert blocks2[:4] == blocks[:4]
     assert a.prefix_hits >= 4
     a.free_blocks(blocks2)
 
-    # a different prompt large enough to force eviction of cached blocks
     other = list(range(100, 100 + 32))
     got3 = a.allocate_sequence(other)
     assert got3 is not None
-    assert len(got3[0]) == 8  # all blocks, eviction happened
+    assert len(got3[0]) == 8
 
 
 def test_allocator_out_of_blocks():
     a = PrefixCachingBlockAllocator(num_blocks=2, block_size=4)
-    assert a.allocate_sequence(list(range(12))) is None  # needs 3 > 2
+    assert a.allocate_sequence(list(range(12))) is None
     got = a.allocate_sequence(list(range(8)))
     assert got is not None
-    assert a.append_block() is None  # pool exhausted
+    assert a.append_block() is None
 
 
 def test_slot_mapping():
