@@ -167,6 +167,8 @@ class SchedulerConfig:
     # num-scheduler-steps): amortises host→device dispatch latency; stop
     # conditions are checked every multi_step tokens, surplus is discarded
     multi_step: int = 1
+    # prefill chunks batched into one dispatch (padded to a fixed P)
+    prefill_batch: int = 4
 
 
 @dataclasses.dataclass

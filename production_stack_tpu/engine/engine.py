@@ -103,8 +103,8 @@ class LLMEngine:
         out = self.scheduler.schedule()
         if out.is_empty:
             return []
-        if out.prefill is not None:
-            return self._run_prefill(out.prefill)
+        if out.prefills:
+            return self._run_prefill(out.prefills)
         return self._run_decode(out.decodes)
 
     def _bucket(self, n: int) -> int:
@@ -113,45 +113,68 @@ class LLMEngine:
                 return min(b, self.config.model.max_model_len)
         return self.config.model.max_model_len
 
-    def _run_prefill(self, sp) -> list[RequestOutput]:
-        seq = sp.seq
+    def _run_prefill(self, prefills: list) -> list[RequestOutput]:
         bs = self.config.cache.block_size
-        bucket = self._bucket(sp.chunk_len)
-        chunk_tokens = seq.token_ids[sp.chunk_start : sp.chunk_start + sp.chunk_len]
+        P = self.config.scheduler.prefill_batch
+        M = self.runner.max_blocks_per_seq
+        bucket = self._bucket(max(sp.chunk_len for sp in prefills))
 
-        tokens = np.zeros(bucket, np.int32)
-        tokens[: sp.chunk_len] = chunk_tokens
-        positions = np.full(bucket, -1, np.int32)
-        positions[: sp.chunk_len] = np.arange(sp.chunk_start, sp.chunk_start + sp.chunk_len)
-        slot_mapping = np.full(bucket, -1, np.int32)
-        slot_mapping[: sp.chunk_len] = slot_mapping_for(
-            seq.block_ids, sp.chunk_start, sp.chunk_len, bs
+        tokens = np.zeros((P, bucket), np.int32)
+        positions = np.full((P, bucket), -1, np.int32)
+        slot_mapping = np.full((P, bucket), -1, np.int32)
+        tables = np.zeros((P, M), np.int32)
+        context_lens = np.zeros(P, np.int32)  # 0 = inactive row
+        last_idx = np.zeros(P, np.int32)
+        temps = np.zeros(P, np.float32)
+        top_ps = np.ones(P, np.float32)
+        top_ks = np.full(P, -1, np.int32)
+        seeds = np.zeros(P, np.uint32)
+
+        for i, sp in enumerate(prefills):
+            seq = sp.seq
+            tokens[i, : sp.chunk_len] = seq.token_ids[
+                sp.chunk_start : sp.chunk_start + sp.chunk_len
+            ]
+            positions[i, : sp.chunk_len] = np.arange(
+                sp.chunk_start, sp.chunk_start + sp.chunk_len
+            )
+            slot_mapping[i, : sp.chunk_len] = slot_mapping_for(
+                seq.block_ids, sp.chunk_start, sp.chunk_len, bs
+            )
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            context_lens[i] = sp.chunk_start + sp.chunk_len
+            last_idx[i] = sp.chunk_len - 1
+            s = seq.sampling
+            temps[i] = s.temperature
+            top_ps[i] = s.top_p
+            top_ks[i] = s.top_k
+            seeds[i] = s.seed or 0
+
+        greedy_only = all(sp.seq.sampling.temperature <= 0.0 for sp in prefills)
+        sampled = self.runner.prefill(
+            tokens, positions, tables, context_lens, slot_mapping.reshape(-1),
+            last_idx, temps, top_ps, top_ks, seeds, greedy_only=greedy_only,
         )
-        table = np.zeros(self.runner.max_blocks_per_seq, np.int32)
-        table[: len(seq.block_ids)] = seq.block_ids
 
-        context_len = sp.chunk_start + sp.chunk_len
-        token = self.runner.prefill(
-            tokens, positions, table, context_len, slot_mapping,
-            sp.chunk_len - 1, seq.sampling,
-        )
-        seq.num_computed_tokens = context_len
-
-        if not seq.prefill_done:
-            return []  # more chunks to go
-
-        seq.status = SequenceStatus.RUNNING
-        self._slot_seq[seq.slot] = seq
-        if seq.output_token_ids:
-            # preemption-recompute: context rebuilt, newest token still the
-            # pending decode input — nothing to sample from this prefill
-            return []
-
-        # prompt complete → the fused prefill step sampled the first token
-        seq.first_token_time = time.monotonic()
-        seq.output_token_ids.append(token)
-        self.total_output_tokens += 1
-        return self._postprocess([seq], [[token]])
+        finished_prompts, first_tokens = [], []
+        for i, sp in enumerate(prefills):
+            seq = sp.seq
+            seq.num_computed_tokens = sp.chunk_start + sp.chunk_len
+            if not seq.prefill_done:
+                continue  # more chunks to go
+            seq.status = SequenceStatus.RUNNING
+            self._slot_seq[seq.slot] = seq
+            if seq.output_token_ids:
+                # preemption-recompute: context rebuilt, newest token still
+                # the pending decode input — nothing sampled this step
+                continue
+            token = int(sampled[i])
+            seq.first_token_time = time.monotonic()
+            seq.output_token_ids.append(token)
+            self.total_output_tokens += 1
+            finished_prompts.append(seq)
+            first_tokens.append([token])
+        return self._postprocess(finished_prompts, first_tokens)
 
     def _run_decode(self, decodes: list[Sequence]) -> list[RequestOutput]:
         bs = self.config.cache.block_size

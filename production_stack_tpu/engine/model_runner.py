@@ -106,9 +106,10 @@ class ModelRunner:
         ctx) f32 score/softmax buffers plus the gathered context. Goes away
         when the Pallas ragged-prefill kernel replaces the gather path."""
         sched = self.config.scheduler
-        chunk = min(sched.max_num_batched_tokens, self.cfg.max_model_len)
-        s_max = next((b for b in sched.prefill_buckets if b >= chunk),
-                     self.cfg.max_model_len)
+        # the scheduler never issues a chunk past the largest bucket
+        chunk = min(sched.max_num_batched_tokens, self.cfg.max_model_len,
+                    max(sched.prefill_buckets))
+        s_max = next(b for b in sched.prefill_buckets if b >= chunk)
         ctx = self.cfg.max_model_len
         scores = s_max * ctx * self.cfg.num_kv_heads * self.cfg.q_per_kv * 4
         gather = 2 * ctx * self.cfg.num_kv_heads * self.cfg.head_dim * 2
@@ -151,27 +152,29 @@ class ModelRunner:
             return 1
         return self.mesh.shape[AXIS_TENSOR]
 
-    _SHARD_IN = (
-        P(None, AXIS_TENSOR, None),  # q rows (.., H grouped by shard, D)
-        P(None, AXIS_TENSOR, None),  # newkv (T, 2KH, D)
-        P(None, None, None, AXIS_TENSOR, None),  # cache
-        P(None, None),  # block tables
-        P(None),  # context lens
-        P(None),  # slot mapping
-        P(),  # layer idx
-        P(),  # q_start / unused
-    )
-    _SHARD_OUT = (
-        P(None, AXIS_TENSOR, None),
-        P(None, None, None, AXIS_TENSOR, None),
-    )
-
-    def _sharded(self, inner):
+    def _sharded(self, inner, q_rank: int):
+        """shard_map wrapper over the tensor axis; q_rank distinguishes the
+        decode (B, H, D) and prefill (P, S, H, D) query shapes."""
         if self.tp == 1:
             return inner
+        q_spec = (
+            P(None, AXIS_TENSOR, None) if q_rank == 3
+            else P(None, None, AXIS_TENSOR, None)
+        )
+        in_specs = (
+            q_spec,
+            P(None, AXIS_TENSOR, None),  # newkv (T, 2KH, D)
+            P(None, None, None, AXIS_TENSOR, None),  # cache
+            P(None, None),  # block tables
+            P(None),  # context lens
+            P(None),  # slot mapping
+            P(),  # layer idx
+            P(None),  # q_starts / unused
+        )
+        out_specs = (q_spec, P(None, None, None, AXIS_TENSOR, None))
         return jax.shard_map(
-            inner, mesh=self.mesh, in_specs=self._SHARD_IN,
-            out_specs=self._SHARD_OUT, check_vma=False,
+            inner, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
         )
 
     def _xla_attend(self, q, caches, layer_idx, block_tables, context_lens,
@@ -183,8 +186,14 @@ class ModelRunner:
 
     def _attend_prefill(self, q, k, v, caches, layer_idx, block_tables,
                         context_lens, q_positions, slot_mapping):
+        """Batched prefill: q (P, S, H, D), inactive rows carry ctx 0."""
+        Pn, S, H, D = q.shape
+        KH = k.shape[-2]
+        k_flat = k.reshape(Pn * S, KH, D)
+        v_flat = v.reshape(Pn * S, KH, D)
         if not self.use_pallas:
-            caches = write_kv(caches, layer_idx, k[0], v[0], slot_mapping, self.tp)
+            caches = write_kv(caches, layer_idx, k_flat, v_flat, slot_mapping,
+                              self.tp)
             out = self._xla_attend(q, caches, layer_idx, block_tables,
                                    context_lens, q_positions)
             return out, caches
@@ -194,21 +203,20 @@ class ModelRunner:
             paged_prefill_attention_pallas,
         )
 
-        newkv = combine_kv(k[0].astype(caches.dtype), v[0].astype(caches.dtype),
-                           self.tp)
+        newkv = combine_kv(k_flat.astype(caches.dtype),
+                           v_flat.astype(caches.dtype), self.tp)
+        q_starts = q_positions[:, 0]
 
-        def inner(q2, nk, fused, bt, cl, sm, li, qstart):
+        def inner(q4, nk, fused, bt, cl, sm, li, qstarts):
             fused = kv_cache_write_pallas(fused, nk, sm, li)
-            out = paged_prefill_attention_pallas(
-                q2, fused, bt[0], qstart, cl[0], li
-            )
+            out = paged_prefill_attention_pallas(q4, fused, bt, qstarts, cl, li)
             return out, fused
 
-        out, caches = self._sharded(inner)(
-            q[0], newkv, caches, block_tables, context_lens, slot_mapping,
-            layer_idx, q_positions[0, 0],
+        out, caches = self._sharded(inner, q_rank=4)(
+            q, newkv, caches, block_tables, context_lens, slot_mapping,
+            layer_idx, q_starts,
         )
-        return out[None], caches
+        return out, caches
 
     def _attend_decode(self, q, k, v, caches, layer_idx, block_tables,
                        context_lens, q_positions, slot_mapping):
@@ -232,36 +240,32 @@ class ModelRunner:
             out = paged_decode_attention_pallas(q3, fused, bt, cl, li)
             return out, fused
 
-        out, caches = self._sharded(inner)(
+        out, caches = self._sharded(inner, q_rank=3)(
             q[:, 0], newkv, caches, block_tables, context_lens, slot_mapping,
-            layer_idx, jnp.int32(0),
+            layer_idx, jnp.zeros((1,), jnp.int32),
         )
         return out[:, None], caches
 
     # -- public step API (host numpy in, device out) -------------------------
     def prefill(self, tokens: np.ndarray, positions: np.ndarray,
-                block_table: np.ndarray, context_len: int, slot_mapping: np.ndarray,
-                last_idx: int, sampling=None) -> int:
-        """One sequence's prefill chunk (shapes already padded to a bucket).
-        Samples the next token from the last chunk position in the same
-        dispatch and returns it (host int)."""
-        s = sampling
-        greedy = s is None or s.temperature <= 0.0
+                block_tables: np.ndarray, context_lens: np.ndarray,
+                slot_mapping: np.ndarray, last_idx: np.ndarray,
+                temps: np.ndarray, top_ps: np.ndarray, top_ks: np.ndarray,
+                seeds: np.ndarray, greedy_only: bool = True) -> np.ndarray:
+        """A batch of prefill chunks (shapes padded: tokens (P, S), tables
+        (P, M), slot_mapping (P*S,)). Each chunk's next token is sampled in
+        the same dispatch; returns (P,) host tokens."""
         with jax.set_mesh(self.mesh):
-            self.kv, token = self._prefill(
+            self.kv, sampled = self._prefill(
                 self.params, self.kv,
-                jnp.asarray(tokens[None]), jnp.asarray(positions[None]),
-                jnp.asarray(block_table[None]),
-                jnp.asarray([context_len], jnp.int32),
-                jnp.asarray(slot_mapping),
-                jnp.asarray(last_idx, jnp.int32),
-                jnp.asarray(s.temperature if s else 0.0, jnp.float32),
-                jnp.asarray(s.top_p if s else 1.0, jnp.float32),
-                jnp.asarray(s.top_k if s else -1, jnp.int32),
-                jnp.asarray((s.seed or 0) if s else 0, jnp.uint32),
-                greedy_only=greedy,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(block_tables), jnp.asarray(context_lens),
+                jnp.asarray(slot_mapping), jnp.asarray(last_idx),
+                jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+                jnp.asarray(seeds),
+                greedy_only=greedy_only,
             )
-        return int(token)
+        return np.asarray(jax.device_get(sampled))
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray, context_lens: np.ndarray,
@@ -310,8 +314,12 @@ class ModelRunner:
 
 def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
                   block_tables, context_lens, slot_mapping, last_idx,
-                  temp, top_p, top_k, seed, greedy_only: bool = False):
-    """Prefill chunk + fused first-token sampling (one dispatch, scalar out)."""
+                  temps, top_ps, top_ks, seeds, greedy_only: bool = False):
+    """Batched prefill chunks + fused first-token sampling.
+
+    tokens/positions: (P, S); block_tables (P, M); context_lens (P,) with 0
+    marking inactive padding rows; slot_mapping (P*S,); last_idx (P,) index
+    of each chunk's final token. Returns (new_kv, sampled (P,))."""
     from production_stack_tpu.engine.sampling import sample_tokens
     from production_stack_tpu.models.registry import get_model
 
@@ -326,16 +334,18 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
     hidden, new_kv = model.forward_tokens(
         cfg, params, tokens, positions, attend, kv
     )
-    last_hidden = jax.lax.dynamic_index_in_dim(hidden[0], last_idx, axis=0)
-    logits = model.logits_from_hidden(cfg, params, last_hidden[None])[0]  # (1, V)
+    last_hidden = jnp.take_along_axis(
+        hidden, last_idx[:, None, None], axis=1
+    )[:, 0]  # (P, E)
+    logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
     if greedy_only:
-        token = jnp.argmax(logits[0]).astype(jnp.int32)
+        sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
-        token = sample_tokens(
-            logits, temp[None], top_p[None], top_k[None], seed[None],
-            jnp.zeros((1,), jnp.int32),
-        )[0]
-    return new_kv, token
+        sampled = sample_tokens(
+            logits, temps, top_ps, top_ks, seeds,
+            jnp.zeros_like(last_idx),
+        )
+    return new_kv, sampled
 
 
 def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,

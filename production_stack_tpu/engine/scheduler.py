@@ -37,13 +37,13 @@ class ScheduledPrefill:
 
 @dataclasses.dataclass
 class SchedulerOutput:
-    prefill: Optional[ScheduledPrefill] = None
+    prefills: list[ScheduledPrefill] = dataclasses.field(default_factory=list)
     decodes: list[Sequence] = dataclasses.field(default_factory=list)
     preempted: list[Sequence] = dataclasses.field(default_factory=list)
 
     @property
     def is_empty(self) -> bool:
-        return self.prefill is None and not self.decodes
+        return not self.prefills and not self.decodes
 
 
 class Scheduler:
@@ -131,7 +131,11 @@ class Scheduler:
         out = SchedulerOutput()
         self._try_admit()
 
-        # prefill priority (one chunk per step; chunks are bucketed)
+        # prefill priority: batch up to prefill_batch chunks per dispatch;
+        # the first (FCFS) chunk picks the shape bucket, later chunks are
+        # truncated to it (they continue next step — chunked prefill)
+        budget = self.config.max_num_batched_tokens
+        bucket_cap = max(self.config.prefill_buckets)
         for seq in sorted(self.seqs.values(), key=lambda s: s.arrival_time):
             if seq.status is not SequenceStatus.PREFILLING:
                 continue
@@ -140,13 +144,18 @@ class Scheduler:
                 # prefix-matched on re-admission: nothing to compute
                 seq.status = SequenceStatus.RUNNING
                 continue
+            if len(out.prefills) >= self.config.prefill_batch or budget <= 0:
+                break
             remaining = seq.prefill_target - seq.num_computed_tokens
-            chunk = min(
-                remaining,
-                self.config.max_num_batched_tokens,
-                max(self.config.prefill_buckets),  # never pad past a bucket
+            chunk = min(remaining, budget, bucket_cap)
+            if out.prefills:
+                first_bucket = self._bucket_for(out.prefills[0].chunk_len)
+                chunk = min(chunk, first_bucket)
+            out.prefills.append(
+                ScheduledPrefill(seq, seq.num_computed_tokens, chunk)
             )
-            out.prefill = ScheduledPrefill(seq, seq.num_computed_tokens, chunk)
+            budget -= chunk
+        if out.prefills:
             return out
 
         # decode all running sequences; grow block tables first so every
@@ -185,6 +194,12 @@ class Scheduler:
                 survivors.append(seq)
         out.decodes = survivors
         return out
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if b >= n:
+                return b
+        return max(self.config.prefill_buckets)
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         candidates = [

@@ -189,13 +189,15 @@ def paged_decode_attention_pallas(
 
 def _prefill_kernel(
     # scalar prefetch
-    bt_ref,  # (M,) SMEM — this sequence's block table row
-    meta_ref,  # (3,) SMEM — (layer, q_start, ctx_total)
+    bt_ref,  # (P, M) SMEM — per-sequence block table rows
+    layer_ref,  # (1,) SMEM
+    qstart_ref,  # (P,) SMEM — each chunk's first absolute position
+    ctx_ref,  # (P,) SMEM — q_start + chunk_len per sequence (0 = inactive)
     # inputs
-    q_ref,  # (R, KH, D) VMEM — R = TQ*G rows of this tile
+    q_ref,  # (1, R, KH, D) VMEM — R = TQ*G rows of this tile
     kv_hbm,  # (L, N, bs, 2KH, D) ANY
     # outputs
-    o_ref,  # (R, KH, D) VMEM
+    o_ref,  # (1, R, KH, D) VMEM
     # scratch
     buf,  # (2, W, bs, 2KH, D) VMEM
     sems,  # (2, W)
@@ -206,21 +208,22 @@ def _prefill_kernel(
     group: int,
     scale: float,
 ):
-    t = pl.program_id(0)
-    layer = meta_ref[0]
-    q_start = meta_ref[1]
-    ctx = meta_ref[2]
+    p = pl.program_id(0)
+    t = pl.program_id(1)
+    layer = layer_ref[0]
+    q_start = qstart_ref[p]
+    ctx = ctx_ref[p]
     W = windows
     bs = block_size
     win_tokens = W * bs
-    R, KH, D = q_ref.shape
+    _, R, KH, D = q_ref.shape
 
     # this tile's queries reach absolute position q_start + (t+1)*q_tile - 1
     reach = jnp.minimum(ctx, q_start + (t + 1) * q_tile)
     nwin = pl.cdiv(reach, win_tokens)
 
     def dma(slot, w, j):
-        bid = bt_ref[w * W + j]
+        bid = bt_ref[p, w * W + j]
         return pltpu.make_async_copy(
             kv_hbm.at[layer, bid], buf.at[slot, j], sems.at[slot, j]
         )
@@ -233,7 +236,7 @@ def _prefill_kernel(
     def _():
         issue(0, 0)
 
-    q = q_ref[:].astype(jnp.float32)  # (R, KH, D)
+    q = q_ref[0].astype(jnp.float32)  # (R, KH, D)
     # row r is query token s = t*TQ + r//G at absolute position q_start + s
     qpos = q_start + t * q_tile + jax.lax.broadcasted_iota(
         jnp.int32, (1, R, 1), 1
@@ -291,21 +294,21 @@ def _prefill_kernel(
     )
     m, l, acc = jax.lax.fori_loop(0, nwin, body, init)
     out = acc / jnp.maximum(l, 1e-30)  # (KH, R, D)
-    o_ref[:] = out.transpose(1, 0, 2).astype(o_ref.dtype)
+    o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 
 
 def paged_prefill_attention_pallas(
-    q: jnp.ndarray,  # (S, H, D) — the chunk's queries, S padded to a bucket
+    q: jnp.ndarray,  # (P, S, H, D) — P sequences' chunks, S padded to a bucket
     kv_cache: jnp.ndarray,  # (L, N, bs, 2KH, D)
-    block_table: jnp.ndarray,  # (M,) this sequence's blocks
-    q_start: jnp.ndarray | int,  # chunk's first absolute position
-    ctx_total: jnp.ndarray | int,  # q_start + chunk_len
+    block_tables: jnp.ndarray,  # (P, M) per-sequence block rows
+    q_starts: jnp.ndarray,  # (P,) each chunk's first absolute position
+    ctx_totals: jnp.ndarray,  # (P,) q_start + chunk_len; 0 = inactive row
     layer_idx: jnp.ndarray | int = 0,
     q_tile: int = 128,
     windows: int = 8,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    S, H, D = q.shape
+    P, S, H, D = q.shape
     L, N, bs, KH2, _ = kv_cache.shape
     KH = KH2 // 2
     G = H // KH
@@ -313,24 +316,20 @@ def paged_prefill_attention_pallas(
     n_tiles = S // TQ
     R = TQ * G
 
-    # rows ordered (s, g): q (S, H, D) -> (S, KH, G, D) -> (S, G, KH, D)
-    q_rows = q.reshape(S, KH, G, D).transpose(0, 2, 1, 3).reshape(S * G, KH, D)
-    meta = jnp.stack(
-        [
-            jnp.asarray(layer_idx, jnp.int32),
-            jnp.asarray(q_start, jnp.int32),
-            jnp.asarray(ctx_total, jnp.int32),
-        ]
+    # rows ordered (s, g): (P, S, H, D) -> (P, S*G, KH, D)
+    q_rows = (
+        q.reshape(P, S, KH, G, D).transpose(0, 1, 3, 2, 4).reshape(P, S * G, KH, D)
     )
+    layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(n_tiles,),
+        num_scalar_prefetch=4,
+        grid=(P, n_tiles),
         in_specs=[
-            pl.BlockSpec((R, KH, D), lambda t, *_: (t, 0, 0),
+            pl.BlockSpec((1, R, KH, D), lambda p, t, *_: (p, t, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((R, KH, D), lambda t, *_: (t, 0, 0),
+        out_specs=pl.BlockSpec((1, R, KH, D), lambda p, t, *_: (p, t, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((2, windows, bs, KH2, D), kv_cache.dtype),
@@ -343,12 +342,21 @@ def paged_prefill_attention_pallas(
     )
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((S * G, KH, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((P, S * G, KH, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_table, meta, q_rows, kv_cache)
-    # rows (s, g) back to (S, H, D) with h = kh*G + g
-    return out.reshape(S, G, KH, D).transpose(0, 2, 1, 3).reshape(S, H, D)
+    )(
+        block_tables,
+        layer_arr,
+        jnp.asarray(q_starts, jnp.int32),
+        jnp.asarray(ctx_totals, jnp.int32),
+        q_rows,
+        kv_cache,
+    )
+    # rows (s, g) back to (P, S, H, D) with h = kh*G + g
+    return (
+        out.reshape(P, S, G, KH, D).transpose(0, 1, 3, 2, 4).reshape(P, S, H, D)
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -159,9 +159,12 @@ def test_pallas_prefill_matches_xla_interpret():
     table = np.arange(M, dtype=np.int32)
     q = rng.standard_normal((S_pad, H, d), dtype=np.float32)
 
+    # batch of 2: one real chunk + one inactive padding row (ctx 0)
+    q2 = np.stack([q, np.zeros_like(q)])
     got = paged_prefill_attention_pallas(
-        jnp.asarray(q), cache, jnp.asarray(table),
-        q_start, ctx, layer, q_tile=8, windows=2, interpret=True,
+        jnp.asarray(q2), cache, jnp.asarray(np.stack([table, table])),
+        jnp.asarray([q_start, 0], jnp.int32), jnp.asarray([ctx, 0], jnp.int32),
+        layer, q_tile=8, windows=2, interpret=True,
     )
     positions = np.full((1, S_pad), -1, np.int32)
     positions[0, :chunk] = np.arange(q_start, ctx)
@@ -170,8 +173,9 @@ def test_pallas_prefill_matches_xla_interpret():
         jnp.asarray([ctx], jnp.int32), jnp.asarray(positions),
     )[0]
     np.testing.assert_allclose(
-        np.asarray(got[:chunk]), np.asarray(want[:chunk]), rtol=2e-4, atol=2e-4
+        np.asarray(got[0, :chunk]), np.asarray(want[:chunk]), rtol=2e-4, atol=2e-4
     )
+    assert np.all(np.asarray(got[1]) == 0)  # inactive row untouched
 
 
 def test_pallas_kv_write_matches_scatter_interpret():
