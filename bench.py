@@ -47,7 +47,6 @@ def main() -> None:
         scheduler=SchedulerConfig(
             max_num_seqs=num_seqs,
             max_num_batched_tokens=1024,
-            
             prefill_buckets=(128, 256, 512),
             multi_step=16 if on_tpu else 2,
             prefill_batch=8 if on_tpu else 2,

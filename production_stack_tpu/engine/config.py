@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Optional
+from typing import Any, Optional  # noqa: F401
 
 import jax.numpy as jnp
 
@@ -169,6 +169,16 @@ class SchedulerConfig:
     multi_step: int = 1
     # prefill chunks batched into one dispatch (padded to a fixed P)
     prefill_batch: int = 4
+
+    def bucket_for(self, n: int, max_model_len: Optional[int] = None) -> int:
+        """The padded token length a chunk of n tokens compiles at — the ONE
+        source of bucket rounding (scheduler truncation and engine padding
+        must agree)."""
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b if max_model_len is None else min(b, max_model_len)
+        top = max(self.prefill_buckets)
+        return top if max_model_len is None else min(top, max_model_len)
 
 
 @dataclasses.dataclass

@@ -108,14 +108,15 @@ class LLMEngine:
         return self._run_decode(out.decodes)
 
     def _bucket(self, n: int) -> int:
-        for b in self.config.scheduler.prefill_buckets:
-            if b >= n:
-                return min(b, self.config.model.max_model_len)
-        return self.config.model.max_model_len
+        return self.config.scheduler.bucket_for(n, self.config.model.max_model_len)
 
     def _run_prefill(self, prefills: list) -> list[RequestOutput]:
         bs = self.config.cache.block_size
-        P = self.config.scheduler.prefill_batch
+        # two batch-dim variants only (1 and prefill_batch): a lone prompt
+        # must not pay prefill_batch x bucket dense-transformer tokens
+        # (inactive rows skip attention but not QKV/MLP), while finer
+        # power-of-two steps would multiply compile variants
+        P = 1 if len(prefills) == 1 else self.config.scheduler.prefill_batch
         M = self.runner.max_blocks_per_seq
         bucket = self._bucket(max(sp.chunk_len for sp in prefills))
 

@@ -74,12 +74,15 @@ class ModelRunner:
                 if params is not None
                 else init_or_load(self.cfg, mesh, self.rules, config.seed)
             )
+        self.use_pallas = _pallas_ok(self.cfg, mesh, config.cache.block_size)
         self.num_blocks = self._resolve_num_blocks(num_blocks)
         self.kv = kvmod.init_kv_cache(
             self.cfg, config.cache, mesh, self.rules, self.num_blocks
         )
-        self.max_blocks_per_seq = -(-self.cfg.max_model_len // config.cache.block_size)
-        self.use_pallas = _pallas_ok(self.cfg, mesh, config.cache.block_size)
+        # block-table width padded to a multiple of the kernels' DMA window
+        # (they read whole windows; tables are 0-padded past the live blocks)
+        mbs = -(-self.cfg.max_model_len // config.cache.block_size)
+        self.max_blocks_per_seq = (mbs + 7) // 8 * 8
 
         self._prefill = jax.jit(
             functools.partial(_prefill_step, self.cfg, self._attend_prefill),
@@ -102,17 +105,25 @@ class ModelRunner:
 
     # -- sizing ------------------------------------------------------------
     def _prefill_temp_bytes(self) -> int:
-        """Worst-case transient of the XLA prefill attention: the (KH, G, S,
-        ctx) f32 score/softmax buffers plus the gathered context. Goes away
-        when the Pallas ragged-prefill kernel replaces the gather path."""
+        """Worst-case prefill transient, per attention backend.
+
+        XLA gather path: per batched sequence, (KH, G, S, ctx) f32
+        score/softmax buffers plus the gathered context — times the
+        prefill_batch dimension. Pallas path: windows live in VMEM scratch;
+        only hidden/logits-scale HBM transients remain."""
         sched = self.config.scheduler
+        Pb = max(sched.prefill_batch, 1)
         # the scheduler never issues a chunk past the largest bucket
         chunk = min(sched.max_num_batched_tokens, self.cfg.max_model_len,
                     max(sched.prefill_buckets))
-        s_max = next(b for b in sched.prefill_buckets if b >= chunk)
+        s_max = sched.bucket_for(chunk)
+        if self.use_pallas:
+            hidden = Pb * s_max * self.cfg.hidden_size * 4
+            logits = Pb * self.cfg.vocab_size * 4
+            return int(8 * hidden + 4 * logits)
         ctx = self.cfg.max_model_len
-        scores = s_max * ctx * self.cfg.num_kv_heads * self.cfg.q_per_kv * 4
-        gather = 2 * ctx * self.cfg.num_kv_heads * self.cfg.head_dim * 2
+        scores = Pb * s_max * ctx * self.cfg.num_kv_heads * self.cfg.q_per_kv * 4
+        gather = Pb * 2 * ctx * self.cfg.num_kv_heads * self.cfg.head_dim * 2
         return int(3.5 * scores + 2 * gather)
 
     def _resolve_num_blocks(self, explicit: Optional[int]) -> int:

@@ -149,7 +149,7 @@ class Scheduler:
             remaining = seq.prefill_target - seq.num_computed_tokens
             chunk = min(remaining, budget, bucket_cap)
             if out.prefills:
-                first_bucket = self._bucket_for(out.prefills[0].chunk_len)
+                first_bucket = self.config.bucket_for(out.prefills[0].chunk_len)
                 chunk = min(chunk, first_bucket)
             out.prefills.append(
                 ScheduledPrefill(seq, seq.num_computed_tokens, chunk)
@@ -194,12 +194,6 @@ class Scheduler:
                 survivors.append(seq)
         out.decodes = survivors
         return out
-
-    def _bucket_for(self, n: int) -> int:
-        for b in self.config.prefill_buckets:
-            if b >= n:
-                return b
-        return max(self.config.prefill_buckets)
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         candidates = [

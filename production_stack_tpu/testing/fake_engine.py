@@ -1,0 +1,182 @@
+"""Fake TPU engine: a mock backend with configurable tok/s + TTFT and
+TPU-shaped metrics, so router load tests never need a chip.
+
+Mirrors the reference's router-CI mock
+(src/tests/perftest/fake-openai-server.py:31-160): OpenAI-compatible
+completions/chat endpoints streaming canned tokens at a configured rate, a
+/metrics endpoint emitting the vllm: sample names the router scrapes, plus
+/v1/models, /health, /is_sleeping and /kv/lookup so every routing logic
+(including KV-aware) can be exercised against it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+
+class FakeEngine:
+    def __init__(self, model: str = "fake-model", tokens_per_second: float = 500.0,
+                 ttft: float = 0.02, max_tokens_default: int = 32,
+                 kv_hit_tokens: int = 0):
+        self.model = model
+        self.tps = tokens_per_second
+        self.ttft = ttft
+        self.max_tokens_default = max_tokens_default
+        self.kv_hit_tokens = kv_hit_tokens  # fixed /kv/lookup answer
+        self.running = 0
+        self.total_requests = 0
+        self.sleeping = False
+        self.start = time.time()
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.chat)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/is_sleeping", self.is_sleeping)
+        app.router.add_post("/sleep", self.sleep)
+        app.router.add_post("/wake_up", self.wake)
+        app.router.add_post("/kv/lookup", self.kv_lookup)
+        app.router.add_post("/tokenize", self.tokenize)
+        return app
+
+    async def models(self, request):
+        return web.json_response(
+            {"object": "list",
+             "data": [{"id": self.model, "object": "model",
+                       "created": int(self.start), "owned_by": "fake"}]}
+        )
+
+    async def health(self, request):
+        return web.json_response({"status": "healthy"})
+
+    async def is_sleeping(self, request):
+        return web.json_response({"is_sleeping": self.sleeping})
+
+    async def sleep(self, request):
+        self.sleeping = True
+        return web.json_response({"status": "sleeping"})
+
+    async def wake(self, request):
+        self.sleeping = False
+        return web.json_response({"status": "awake"})
+
+    async def kv_lookup(self, request):
+        body = await request.json()
+        prompt = body.get("prompt") or ""
+        total = max(len(prompt) // 4, 1)
+        return web.json_response(
+            {"matched_tokens": min(self.kv_hit_tokens, total), "total_tokens": total}
+        )
+
+    async def tokenize(self, request):
+        body = await request.json()
+        text = body.get("prompt") or ""
+        ids = list(text.encode())[:8192]
+        return web.json_response({"tokens": ids, "count": len(ids)})
+
+    async def metrics(self, request):
+        lines = [
+            "# TYPE vllm:num_requests_running gauge",
+            f'vllm:num_requests_running{{model_name="{self.model}"}} {self.running}',
+            "# TYPE vllm:num_requests_waiting gauge",
+            f'vllm:num_requests_waiting{{model_name="{self.model}"}} 0',
+            "# TYPE vllm:gpu_cache_usage_perc gauge",
+            f'vllm:gpu_cache_usage_perc{{model_name="{self.model}"}} '
+            f"{min(self.running / 32, 1.0)}",
+            "# TYPE vllm:gpu_prefix_cache_hits_total counter",
+            f'vllm:gpu_prefix_cache_hits_total{{model_name="{self.model}"}} '
+            f"{self.total_requests * self.kv_hit_tokens}",
+            "# TYPE vllm:gpu_prefix_cache_queries_total counter",
+            f'vllm:gpu_prefix_cache_queries_total{{model_name="{self.model}"}} '
+            f"{max(self.total_requests, 1) * 16}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    async def completions(self, request):
+        return await self._serve(request, chat=False)
+
+    async def chat(self, request):
+        return await self._serve(request, chat=True)
+
+    async def _serve(self, request, chat: bool):
+        body = await request.json()
+        n = int(body.get("max_tokens") or self.max_tokens_default)
+        stream = bool(body.get("stream", False))
+        rid = f"fake-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())
+        self.running += 1
+        self.total_requests += 1
+        try:
+            await asyncio.sleep(self.ttft)
+            words = [f"tok{i} " for i in range(n)]
+            usage = {"prompt_tokens": 8, "completion_tokens": n,
+                     "total_tokens": 8 + n}
+            if not stream:
+                await asyncio.sleep(n / self.tps)
+                text = "".join(words)
+                choice = (
+                    {"index": 0, "message": {"role": "assistant", "content": text},
+                     "finish_reason": "length"}
+                    if chat else
+                    {"index": 0, "text": text, "finish_reason": "length",
+                     "logprobs": None}
+                )
+                return web.json_response(
+                    {"id": rid, "object": "chat.completion" if chat else
+                     "text_completion", "created": created,
+                     "model": self.model, "choices": [choice], "usage": usage}
+                )
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            obj = "chat.completion.chunk" if chat else "text_completion"
+            for i, w in enumerate(words):
+                await asyncio.sleep(1.0 / self.tps)
+                delta = {"content": w} if chat else None
+                choice = (
+                    {"index": 0, "delta": delta, "finish_reason": None}
+                    if chat else
+                    {"index": 0, "text": w, "finish_reason": None,
+                     "logprobs": None}
+                )
+                payload = {"id": rid, "object": obj, "created": created,
+                           "model": self.model, "choices": [choice]}
+                if i == len(words) - 1:
+                    payload["usage"] = usage
+                    payload["choices"][0]["finish_reason"] = "length"
+                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        finally:
+            self.running -= 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("fake-tpu-engine")
+    p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--model", default="fake-model")
+    p.add_argument("--tokens-per-second", type=float, default=500)
+    p.add_argument("--ttft", type=float, default=0.02)
+    p.add_argument("--kv-hit-tokens", type=int, default=0)
+    args = p.parse_args(argv)
+    engine = FakeEngine(args.model, args.tokens_per_second, args.ttft,
+                        kv_hit_tokens=args.kv_hit_tokens)
+    web.run_app(engine.build_app(), host=args.host, port=args.port,
+                access_log=None)
+
+
+if __name__ == "__main__":
+    main()
