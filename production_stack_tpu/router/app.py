@@ -111,6 +111,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--external-providers-config", default=None,
                    help="YAML file mapping model ids to external providers")
     p.add_argument("--api-key-file", default=None)
+    # batch / files API (reference: services/batch_service + files_service)
+    p.add_argument("--enable-batch-api", action="store_true")
+    p.add_argument("--file-storage-path", default="/tmp/tpu_router_files")
+    p.add_argument("--batch-db-path", default="/tmp/tpu_router_batches.db")
     return p
 
 
@@ -121,6 +125,7 @@ class RouterApp:
         self.request_service: Optional[RequestService] = None
         self.semantic_cache = None
         self.pii_middleware = None
+        self.batch_processor = None
         self._log_stats_task: Optional[asyncio.Task] = None
 
     # -- initialization (reference: app.py initialize_all) -------------------
@@ -193,6 +198,19 @@ class RouterApp:
             external_providers=external,
         )
 
+        if args.enable_batch_api:
+            from production_stack_tpu.router.services.batch_service import (
+                BatchProcessor,
+            )
+            from production_stack_tpu.router.services.files_service import (
+                initialize_storage,
+            )
+
+            initialize_storage(args.file_storage_path)
+            self.batch_processor = BatchProcessor(
+                args.batch_db_path, request_service=self.request_service
+            )
+
         from production_stack_tpu.router.experimental.feature_gates import (
             initialize_feature_gates,
             get_feature_gates,
@@ -240,6 +258,16 @@ class RouterApp:
         app.router.add_post("/sleep", _sleep)
         app.router.add_post("/wake_up", _wake)
         app.router.add_get("/is_sleeping", _is_sleeping)
+        if self.batch_processor is not None:
+            app.router.add_post("/v1/files", self.upload_file)
+            app.router.add_get("/v1/files", self.list_files)
+            app.router.add_get("/v1/files/{file_id}", self.get_file)
+            app.router.add_delete("/v1/files/{file_id}", self.delete_file)
+            app.router.add_get("/v1/files/{file_id}/content", self.file_content)
+            app.router.add_post("/v1/batches", self.create_batch)
+            app.router.add_get("/v1/batches", self.list_batches)
+            app.router.add_get("/v1/batches/{batch_id}", self.get_batch)
+            app.router.add_post("/v1/batches/{batch_id}/cancel", self.cancel_batch)
         app.on_startup.append(self._on_start)
         app.on_cleanup.append(self._on_stop)
         return app
@@ -263,6 +291,9 @@ class RouterApp:
         await get_service_discovery().start()
         await get_engine_stats_scraper().start()
         await self.request_service.start()
+        if self.batch_processor is not None:
+            self.batch_processor.request_service = self.request_service
+            await self.batch_processor.start()
         if self.args.dynamic_config_file:
             from production_stack_tpu.router.dynamic_config import (
                 DynamicConfigWatcher,
@@ -274,6 +305,8 @@ class RouterApp:
             self._log_stats_task = asyncio.create_task(self._log_stats_worker())
 
     async def _on_stop(self, app) -> None:
+        if self.batch_processor is not None:
+            await self.batch_processor.stop()
         await get_service_discovery().stop()
         await get_engine_stats_scraper().stop()
         await self.request_service.stop()
@@ -349,6 +382,93 @@ class RouterApp:
                 }
             )
         return web.json_response({"engines": out})
+
+    # -- files / batches -------------------------------------------------------
+    async def upload_file(self, request: web.Request) -> web.Response:
+        from production_stack_tpu.router.services.files_service import get_storage
+
+        reader = await request.multipart()
+        purpose, filename, content = "batch", "upload", b""
+        async for part in reader:
+            if part.name == "purpose":
+                purpose = (await part.read()).decode()
+            elif part.name == "file":
+                filename = part.filename or "upload"
+                content = await part.read()
+        obj = await get_storage().save_file(filename, content, purpose)
+        return web.json_response(obj.to_dict())
+
+    async def list_files(self, request: web.Request) -> web.Response:
+        from production_stack_tpu.router.services.files_service import get_storage
+
+        files = await get_storage().list_files()
+        return web.json_response(
+            {"object": "list", "data": [f.to_dict() for f in files]}
+        )
+
+    async def get_file(self, request: web.Request) -> web.Response:
+        from production_stack_tpu.router.services.files_service import get_storage
+
+        try:
+            obj = await get_storage().get_file(request.match_info["file_id"])
+        except KeyError:
+            return web.json_response({"error": {"message": "file not found"}},
+                                     status=404)
+        return web.json_response(obj.to_dict())
+
+    async def delete_file(self, request: web.Request) -> web.Response:
+        from production_stack_tpu.router.services.files_service import get_storage
+
+        fid = request.match_info["file_id"]
+        ok = await get_storage().delete_file(fid)
+        return web.json_response({"id": fid, "object": "file", "deleted": ok},
+                                 status=200 if ok else 404)
+
+    async def file_content(self, request: web.Request) -> web.Response:
+        from production_stack_tpu.router.services.files_service import get_storage
+
+        try:
+            data = await get_storage().get_file_content(request.match_info["file_id"])
+        except KeyError:
+            return web.json_response({"error": {"message": "file not found"}},
+                                     status=404)
+        return web.Response(body=data, content_type="application/octet-stream")
+
+    async def create_batch(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if "input_file_id" not in body or "endpoint" not in body:
+            return web.json_response(
+                {"error": {"message": "input_file_id and endpoint required"}},
+                status=400,
+            )
+        batch = self.batch_processor.create_batch(
+            body["input_file_id"], body["endpoint"],
+            body.get("completion_window", "24h"), body.get("metadata"),
+        )
+        return web.json_response(batch)
+
+    async def list_batches(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"object": "list", "data": self.batch_processor.list_batches()}
+        )
+
+    async def get_batch(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(
+                self.batch_processor.get_batch(request.match_info["batch_id"])
+            )
+        except KeyError:
+            return web.json_response({"error": {"message": "batch not found"}},
+                                     status=404)
+
+    async def cancel_batch(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(
+                self.batch_processor.cancel_batch(request.match_info["batch_id"])
+            )
+        except KeyError:
+            return web.json_response({"error": {"message": "batch not found"}},
+                                     status=404)
 
     async def prometheus(self, request: web.Request) -> web.Response:
         m.refresh_label_gauges(

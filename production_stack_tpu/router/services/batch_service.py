@@ -1,0 +1,221 @@
+"""OpenAI Batch API with a SQLite-backed durable queue.
+
+Reference semantics (src/vllm_router/services/batch_service/
+local_processor.py:32-221): batches are rows in a ``batch_queue`` table that
+survives restarts; a background task claims pending batches, replays each
+JSONL line through the router's own request path against the discovered
+engines, and writes an output file with per-line responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import time
+import uuid
+from typing import Optional
+
+from production_stack_tpu.router.log import init_logger
+from production_stack_tpu.router.services.files_service import get_storage
+
+logger = init_logger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS batch_queue (
+    id TEXT PRIMARY KEY,
+    input_file_id TEXT NOT NULL,
+    endpoint TEXT NOT NULL,
+    completion_window TEXT,
+    status TEXT NOT NULL,
+    created_at INTEGER NOT NULL,
+    started_at INTEGER,
+    completed_at INTEGER,
+    output_file_id TEXT,
+    error_file_id TEXT,
+    request_counts TEXT,
+    metadata TEXT
+)
+"""
+
+
+def _row_to_batch(row) -> dict:
+    (bid, input_file_id, endpoint, window, status, created, started, completed,
+     output_file_id, error_file_id, counts, metadata) = row
+    return {
+        "id": bid,
+        "object": "batch",
+        "endpoint": endpoint,
+        "input_file_id": input_file_id,
+        "completion_window": window,
+        "status": status,
+        "created_at": created,
+        "in_progress_at": started,
+        "completed_at": completed,
+        "output_file_id": output_file_id,
+        "error_file_id": error_file_id,
+        "request_counts": json.loads(counts or "{}"),
+        "metadata": json.loads(metadata or "{}"),
+    }
+
+
+class BatchProcessor:
+    def __init__(self, db_path: str = "/tmp/tpu_router_batches.db",
+                 request_service=None, poll_interval: float = 2.0):
+        self.db = sqlite3.connect(db_path)
+        self.db.execute(_SCHEMA)
+        self.db.commit()
+        self.request_service = request_service
+        self.poll_interval = poll_interval
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        # re-queue batches left in_progress by a crash (durability semantics)
+        self.db.execute(
+            "UPDATE batch_queue SET status='validating' WHERE status='in_progress'"
+        )
+        self.db.commit()
+        self._task = asyncio.create_task(self._worker())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    # -- API ----------------------------------------------------------------
+    def create_batch(self, input_file_id: str, endpoint: str,
+                     completion_window: str = "24h",
+                     metadata: Optional[dict] = None) -> dict:
+        bid = f"batch_{uuid.uuid4().hex[:24]}"
+        now = int(time.time())
+        self.db.execute(
+            "INSERT INTO batch_queue VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            (bid, input_file_id, endpoint, completion_window, "validating",
+             now, None, None, None, None, "{}", json.dumps(metadata or {})),
+        )
+        self.db.commit()
+        return self.get_batch(bid)
+
+    def get_batch(self, batch_id: str) -> dict:
+        row = self.db.execute(
+            "SELECT * FROM batch_queue WHERE id=?", (batch_id,)
+        ).fetchone()
+        if row is None:
+            raise KeyError(batch_id)
+        return _row_to_batch(row)
+
+    def list_batches(self, limit: int = 20) -> list[dict]:
+        rows = self.db.execute(
+            "SELECT * FROM batch_queue ORDER BY created_at DESC LIMIT ?", (limit,)
+        ).fetchall()
+        return [_row_to_batch(r) for r in rows]
+
+    def cancel_batch(self, batch_id: str) -> dict:
+        self.get_batch(batch_id)
+        self.db.execute(
+            "UPDATE batch_queue SET status='cancelled', completed_at=? "
+            "WHERE id=? AND status IN ('validating','in_progress')",
+            (int(time.time()), batch_id),
+        )
+        self.db.commit()
+        return self.get_batch(batch_id)
+
+    # -- worker ---------------------------------------------------------------
+    async def _worker(self) -> None:
+        while True:
+            try:
+                row = self.db.execute(
+                    "SELECT id FROM batch_queue WHERE status='validating' "
+                    "ORDER BY created_at LIMIT 1"
+                ).fetchone()
+                if row:
+                    await self._process(row[0])
+            except Exception as e:
+                logger.error("batch worker error: %s", e)
+            await asyncio.sleep(self.poll_interval)
+
+    def _set(self, batch_id: str, **cols) -> None:
+        sets = ", ".join(f"{k}=?" for k in cols)
+        self.db.execute(
+            f"UPDATE batch_queue SET {sets} WHERE id=?",
+            (*cols.values(), batch_id),
+        )
+        self.db.commit()
+
+    async def _process(self, batch_id: str) -> None:
+        batch = self.get_batch(batch_id)
+        self._set(batch_id, status="in_progress", started_at=int(time.time()))
+        storage = get_storage()
+        try:
+            content = await storage.get_file_content(batch["input_file_id"])
+        except KeyError:
+            self._set(batch_id, status="failed", completed_at=int(time.time()))
+            return
+        lines = [ln for ln in content.decode().splitlines() if ln.strip()]
+        results, completed, failed = [], 0, 0
+        for line in lines:
+            if self.get_batch(batch_id)["status"] == "cancelled":
+                return
+            try:
+                req = json.loads(line)
+                response = await self._dispatch(batch["endpoint"], req)
+                results.append(
+                    {"id": f"batch_req_{uuid.uuid4().hex[:12]}",
+                     "custom_id": req.get("custom_id"),
+                     "response": {"status_code": 200, "body": response},
+                     "error": None}
+                )
+                completed += 1
+            except Exception as e:
+                results.append(
+                    {"id": f"batch_req_{uuid.uuid4().hex[:12]}",
+                     "custom_id": (json.loads(line).get("custom_id")
+                                   if line.startswith("{") else None),
+                     "response": None,
+                     "error": {"message": str(e)}}
+                )
+                failed += 1
+        out = await storage.save_file(
+            f"{batch_id}_output.jsonl",
+            "\n".join(json.dumps(r) for r in results).encode(),
+            purpose="batch_output",
+        )
+        self._set(
+            batch_id, status="completed", completed_at=int(time.time()),
+            output_file_id=out.id,
+            request_counts=json.dumps(
+                {"total": len(lines), "completed": completed, "failed": failed}
+            ),
+        )
+        logger.info("batch %s completed: %d ok, %d failed", batch_id,
+                    completed, failed)
+
+    async def _dispatch(self, endpoint: str, req: dict) -> dict:
+        """Send one batch line to a backend through the shared client."""
+        from production_stack_tpu.router.routing import get_routing_logic
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+        from production_stack_tpu.router.stats import (
+            get_engine_stats_scraper,
+            get_request_stats_monitor,
+        )
+
+        body = req.get("body") or {}
+        model = body.get("model", "")
+        endpoints = [
+            e for e in get_service_discovery().get_endpoint_info()
+            if e.serves(model) and not e.sleep
+        ]
+        if not endpoints:
+            raise RuntimeError(f"no endpoints for model {model!r}")
+        url = await get_routing_logic().route_request(
+            endpoints, get_engine_stats_scraper().get_engine_stats(),
+            get_request_stats_monitor().get_request_stats(), {}, body,
+        )
+        session = self.request_service.session
+        path = req.get("url") or endpoint
+        async with session.post(f"{url}{path}", json=body) as resp:
+            data = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}: {data}")
+            return data

@@ -1,0 +1,121 @@
+"""OpenAI Files API backing store (reference: src/vllm_router/services/
+files_service/ — Storage ABC + local-disk FileStorage + OpenAI file objects).
+
+Files are stored under ``<root>/<user>/<file_id>`` with a JSON sidecar of
+metadata; the default user is "anonymous" (matching the reference's
+per-user pathing)."""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FileObject:
+    id: str
+    bytes: int
+    created_at: int
+    filename: str
+    purpose: str
+    object: str = "file"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Storage(abc.ABC):
+    @abc.abstractmethod
+    async def save_file(self, filename: str, content: bytes, purpose: str,
+                        user: str = "anonymous") -> FileObject: ...
+
+    @abc.abstractmethod
+    async def get_file(self, file_id: str, user: str = "anonymous") -> FileObject: ...
+
+    @abc.abstractmethod
+    async def get_file_content(self, file_id: str, user: str = "anonymous") -> bytes: ...
+
+    @abc.abstractmethod
+    async def list_files(self, user: str = "anonymous") -> list[FileObject]: ...
+
+    @abc.abstractmethod
+    async def delete_file(self, file_id: str, user: str = "anonymous") -> bool: ...
+
+
+class FileStorage(Storage):
+    def __init__(self, root: str = "/tmp/tpu_router_files"):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, user: str) -> str:
+        path = os.path.join(self.root, user.replace("/", "_"))
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _meta_path(self, user: str, file_id: str) -> str:
+        return os.path.join(self._dir(user), f"{file_id}.json")
+
+    def _data_path(self, user: str, file_id: str) -> str:
+        return os.path.join(self._dir(user), file_id)
+
+    async def save_file(self, filename, content, purpose, user="anonymous"):
+        file_id = f"file-{uuid.uuid4().hex[:24]}"
+        obj = FileObject(
+            id=file_id, bytes=len(content), created_at=int(time.time()),
+            filename=filename, purpose=purpose,
+        )
+        with open(self._data_path(user, file_id), "wb") as f:
+            f.write(content)
+        with open(self._meta_path(user, file_id), "w") as f:
+            json.dump(obj.to_dict(), f)
+        return obj
+
+    async def get_file(self, file_id, user="anonymous"):
+        try:
+            with open(self._meta_path(user, file_id)) as f:
+                return FileObject(**json.load(f))
+        except FileNotFoundError:
+            raise KeyError(file_id) from None
+
+    async def get_file_content(self, file_id, user="anonymous"):
+        try:
+            with open(self._data_path(user, file_id), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(file_id) from None
+
+    async def list_files(self, user="anonymous"):
+        out = []
+        d = self._dir(user)
+        for name in os.listdir(d):
+            if name.endswith(".json"):
+                with open(os.path.join(d, name)) as f:
+                    out.append(FileObject(**json.load(f)))
+        return sorted(out, key=lambda o: o.created_at, reverse=True)
+
+    async def delete_file(self, file_id, user="anonymous"):
+        found = False
+        for path in (self._meta_path(user, file_id), self._data_path(user, file_id)):
+            if os.path.exists(path):
+                os.remove(path)
+                found = True
+        return found
+
+
+_storage: Optional[Storage] = None
+
+
+def initialize_storage(root: str = "/tmp/tpu_router_files") -> Storage:
+    global _storage
+    _storage = FileStorage(root)
+    return _storage
+
+
+def get_storage() -> Storage:
+    assert _storage is not None, "file storage not initialized"
+    return _storage

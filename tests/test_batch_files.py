@@ -1,0 +1,96 @@
+"""Files + Batch API end-to-end: upload JSONL → create batch → background
+processor replays lines against a fake engine → output file retrievable
+(reference tier: services/batch_service + files_service)."""
+
+import asyncio
+import json
+import tempfile
+
+from production_stack_tpu.router.app import RouterApp, build_parser
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+
+def test_files_and_batch_lifecycle():
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        fe = FakeEngine(model="fake-model", tokens_per_second=5000, ttft=0.001)
+        ets = TestServer(fe.build_app())
+        await ets.start_server()
+        url = f"http://127.0.0.1:{ets.port}"
+
+        tmp = tempfile.mkdtemp()
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", url,
+            "--static-models", "fake-model",
+            "--enable-batch-api",
+            "--file-storage-path", f"{tmp}/files",
+            "--batch-db-path", f"{tmp}/batches.db",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            # upload input JSONL
+            lines = [
+                json.dumps({
+                    "custom_id": f"req-{i}",
+                    "method": "POST",
+                    "url": "/v1/completions",
+                    "body": {"model": "fake-model", "prompt": f"p{i}",
+                             "max_tokens": 4},
+                })
+                for i in range(3)
+            ]
+            import aiohttp
+
+            form = aiohttp.FormData()
+            form.add_field("purpose", "batch")
+            form.add_field("file", "\n".join(lines).encode(),
+                           filename="input.jsonl")
+            r = await client.post("/v1/files", data=form)
+            assert r.status == 200, await r.text()
+            file_id = (await r.json())["id"]
+
+            r = await client.get("/v1/files")
+            assert any(f["id"] == file_id for f in (await r.json())["data"])
+
+            # create the batch and poll until the worker completes it
+            r = await client.post(
+                "/v1/batches",
+                json={"input_file_id": file_id, "endpoint": "/v1/completions"},
+            )
+            assert r.status == 200
+            batch = await r.json()
+            assert batch["status"] == "validating"
+
+            for _ in range(60):
+                r = await client.get(f"/v1/batches/{batch['id']}")
+                batch = await r.json()
+                if batch["status"] == "completed":
+                    break
+                await asyncio.sleep(0.25)
+            assert batch["status"] == "completed", batch
+            assert batch["request_counts"] == {"total": 3, "completed": 3,
+                                               "failed": 0}
+
+            # fetch output file and validate per-line responses
+            r = await client.get(f"/v1/files/{batch['output_file_id']}/content")
+            out_lines = (await r.read()).decode().splitlines()
+            assert len(out_lines) == 3
+            first = json.loads(out_lines[0])
+            assert first["custom_id"] == "req-0"
+            assert first["response"]["status_code"] == 200
+            assert "choices" in first["response"]["body"]
+
+            # delete the input file
+            r = await client.delete(f"/v1/files/{file_id}")
+            assert (await r.json())["deleted"] is True
+            r = await client.get(f"/v1/files/{file_id}")
+            assert r.status == 404
+        finally:
+            await client.close()
+            await ets.close()
+
+    asyncio.run(main())
