@@ -1,0 +1,56 @@
+{{/*
+Name + label helpers, and the TPU resource rendering that replaces the
+reference chart's GPU vendor-key logic (_helpers.tpl:173-204 there renders
+nvidia.com/gpu / HAMi / MIG keys; here a modelSpec's `tpu:` block becomes a
+google.com/tpu request plus GKE TPU node selectors).
+*/}}
+
+{{- define "stack.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "stack.fullname" -}}
+{{- printf "%s-%s" .Release.Name (include "stack.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "stack.labels" -}}
+helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+release: {{ .Release.Name }}
+environment: serving
+{{- end -}}
+
+{{- define "stack.engineLabels" -}}
+{{ include "stack.labels" .root }}
+app.kubernetes.io/component: serving-engine
+model: {{ .spec.name }}
+{{- if .spec.modelLabel }}
+model-label: {{ .spec.modelLabel }}
+{{- end }}
+{{- end -}}
+
+{{/* TPU resources: chips request + node selection by accelerator/topology */}}
+{{- define "stack.tpuResources" -}}
+resources:
+  requests:
+    {{- toYaml (.spec.resources.requests | default dict) | nindent 4 }}
+    google.com/tpu: {{ .spec.tpu.chips | quote }}
+  limits:
+    {{- toYaml (.spec.resources.limits | default dict) | nindent 4 }}
+    google.com/tpu: {{ .spec.tpu.chips | quote }}
+{{- end -}}
+
+{{- define "stack.tpuNodeSelector" -}}
+nodeSelector:
+  cloud.google.com/gke-tpu-accelerator: {{ .spec.tpu.accelerator }}
+  cloud.google.com/gke-tpu-topology: {{ .spec.tpu.topology | quote }}
+{{- end -}}
+
+{{- define "stack.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create -}}
+{{- default (printf "%s-router" (include "stack.fullname" .)) .Values.serviceAccount.name -}}
+{{- else -}}
+{{- default "default" .Values.serviceAccount.name -}}
+{{- end -}}
+{{- end -}}

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Install the observability stack: kube-prometheus-stack + prometheus-adapter
+# (reference: observability/install.sh). The adapter exposes the engine
+# queue-depth metric for HPA; KEDA reads Prometheus directly.
+set -euo pipefail
+
+NAMESPACE="${MONITORING_NAMESPACE:-monitoring}"
+
+helm repo add prometheus-community \
+  https://prometheus-community.github.io/helm-charts
+helm repo update
+
+helm upgrade --install kube-prometheus-stack \
+  prometheus-community/kube-prometheus-stack \
+  --namespace "$NAMESPACE" --create-namespace \
+  --set grafana.sidecar.dashboards.enabled=true \
+  --set grafana.sidecar.dashboards.label=grafana_dashboard
+
+helm upgrade --install prometheus-adapter \
+  prometheus-community/prometheus-adapter \
+  --namespace "$NAMESPACE" \
+  -f "$(dirname "$0")/prom-adapter.yaml"
+
+echo "observability stack installed in namespace $NAMESPACE"
