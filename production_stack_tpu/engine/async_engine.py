@@ -75,6 +75,12 @@ class AsyncEngine:
                         self.loop.call_soon_threadsafe(self._deliver_error, rid, e)
             elif kind == "abort":
                 self.engine.abort_request(payload)
+            elif kind == "call":
+                fn, fut = payload
+                try:
+                    fut.set_result(fn(self.engine))
+                except Exception as e:
+                    fut.set_exception(e)
             try:
                 item = self.intake.get_nowait()
             except queue.Empty:
@@ -115,6 +121,15 @@ class AsyncEngine:
 
     def abort(self, request_id: str) -> None:
         self.intake.put(("abort", request_id))
+
+    async def run_on_engine(self, fn):
+        """Run fn(engine) on the device-owning thread (KV export/import and
+        anything else touching device state must not race the step loop)."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self.intake.put(("call", (fn, fut)))
+        return await asyncio.wrap_future(fut)
 
     # -- sleep mode (reference: /sleep /wake_up /is_sleeping proxying,
     #    src/vllm_router/services/request_service/request.py:1027-1114) ------

@@ -239,9 +239,32 @@ class LLMEngine:
                     num_prompt_tokens=seq.num_prompt_tokens,
                     num_output_tokens=len(seq.output_token_ids),
                     num_cached_tokens=seq.num_cached_tokens,
+                    block_ids=(seq.released_block_ids if status is not None
+                               else None),
                 )
             )
         return outputs
+
+    # -- KV export/import (disaggregated prefill→decode; P-side blocks stay
+    #    content-addressed after finish, D-side import = prefix injection) --
+    def export_kv(self, block_ids: list[int]):
+        return self.runner.export_blocks(block_ids)
+
+    def import_kv(self, prompt_token_ids: list[int], data) -> int:
+        """Write transferred blocks into the pool and register their content
+        hashes so admission prefix-hits them. Returns tokens now cached."""
+        bs = self.config.cache.block_size
+        n_full = min(int(data.shape[1]), (len(prompt_token_ids) - 1) // bs)
+        if n_full <= 0:
+            return 0
+        alloc = self.scheduler.allocator
+        local = alloc.take_free_blocks(n_full)
+        if local is None:
+            return 0
+        self.runner.import_blocks(local, data[:, :n_full])
+        alloc.commit_full_blocks(prompt_token_ids[: n_full * bs], local)
+        alloc.free_blocks(local)  # refcount 0 → stays cached + matchable
+        return n_full * bs
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[SequenceStatus]:
         s = seq.sampling

@@ -220,6 +220,19 @@ class PrefixCachingBlockAllocator:
         """One more block for a growing (decoding) sequence."""
         return self._pop_free()
 
+    def take_free_blocks(self, n: int) -> Optional[list[int]]:
+        """n fresh blocks (refcount 1) for KV import; None if unavailable."""
+        if n > self.num_free_blocks:
+            return None
+        out = []
+        for _ in range(n):
+            bid = self._pop_free()
+            if bid is None:
+                self.free_blocks(out)
+                return None
+            out.append(bid)
+        return out
+
     def commit_full_blocks(
         self, tokens: Sequence[int], block_ids: Sequence[int]
     ) -> None:

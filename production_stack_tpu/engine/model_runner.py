@@ -310,6 +310,26 @@ class ModelRunner:
             )
         return np.asarray(jax.device_get(sampled))
 
+    # -- KV block export/import (disaggregated prefill→decode transfer) -----
+    def export_blocks(self, block_ids: list[int]) -> np.ndarray:
+        """Gather blocks out of HBM → host (L, n, bs, 2KH, D) array."""
+        idx = jnp.asarray(block_ids, jnp.int32)
+        with jax.set_mesh(self.mesh):
+            data = jax.jit(lambda kv, i: kv[:, i])(self.kv, idx)
+        return np.asarray(jax.device_get(data))
+
+    def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
+        """Scatter transferred blocks into this engine's pool (donated)."""
+        idx = jnp.asarray(block_ids, jnp.int32)
+
+        def _scatter(kv, i, d):
+            return kv.at[:, i].set(d.astype(kv.dtype))
+
+        with jax.set_mesh(self.mesh):
+            self.kv = jax.jit(_scatter, donate_argnums=(0,))(
+                self.kv, idx, jnp.asarray(data)
+            )
+
     def sample(self, logits, temps, top_ps, top_ks, seeds, steps) -> np.ndarray:
         with jax.set_mesh(self.mesh):
             toks = self._sample(

@@ -90,6 +90,7 @@ class Scheduler:
     def _release(self, seq: Sequence) -> None:
         """Return a sequence's blocks and slot to the pools."""
         if seq.block_ids:
+            seq.released_block_ids = list(seq.block_ids)
             self.allocator.free_blocks(seq.block_ids)
             seq.block_ids = []
         if seq.slot >= 0:

@@ -43,6 +43,9 @@ class Sequence:
     slot: int = -1  # decode slot index, -1 = none
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # block ids held at release time (they stay content-addressed in the
+    # allocator until evicted — the handle for P→D KV export)
+    released_block_ids: list[int] = dataclasses.field(default_factory=list)
 
     @property
     def token_ids(self) -> list[int]:
@@ -88,3 +91,4 @@ class RequestOutput:
     num_prompt_tokens: int
     num_output_tokens: int
     num_cached_tokens: int = 0
+    block_ids: Optional[list[int]] = None  # set on finish (KV export handle)

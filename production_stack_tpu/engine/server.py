@@ -71,6 +71,7 @@ class EngineServer:
         app.router.add_post("/detokenize", self.detokenize)
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/kv/lookup", self.kv_lookup)
+        app.router.add_post("/kv/export", self.kv_export)
         app.router.add_post("/sleep", self.sleep)
         app.router.add_post("/wake_up", self.wake_up)
         app.router.add_get("/is_sleeping", self.is_sleeping)
@@ -139,6 +140,75 @@ class EngineServer:
         return web.json_response(
             {"matched_tokens": matched, "total_tokens": len(ids)}
         )
+
+    async def kv_export(self, request: web.Request) -> web.Response:
+        """Disaggregated-prefill KV handoff, producer side: stream the raw
+        (L, n, bs, 2KH, D) slab for the requested blocks. The reference moves
+        these bytes with NIXL/UCX (deployment-vllm-multi.yaml:304-335); here
+        the transport is HTTP between engine pods — same block identity,
+        zero extra deps. Blocks stay content-addressed after a sequence
+        finishes, so recently-prefilled context is exportable until evicted."""
+        body = await request.json()
+        blocks = [int(b) for b in body.get("blocks", [])]
+        if not blocks or any(
+            b < 0 or b >= self.engine.runner.num_blocks for b in blocks
+        ):
+            return web.json_response(
+                {"error": {"message": "invalid block ids"}}, status=400
+            )
+        data = await self.async_engine.run_on_engine(
+            lambda eng: eng.export_kv(blocks)
+        )
+        return web.Response(
+            body=data.tobytes(),
+            content_type="application/octet-stream",
+            headers={
+                "X-KV-Shape": ",".join(map(str, data.shape)),
+                "X-KV-Dtype": str(data.dtype),
+            },
+        )
+
+    async def _maybe_import_kv(self, body: dict, prompt_ids: list[int]) -> None:
+        """Consumer side of the P→D handoff: fetch the producer's blocks and
+        inject them as prefix-cache content, so admission skips recompute of
+        everything but the final prompt token."""
+        params = body.get("kv_transfer_params") or {}
+        host = params.get("remote_host")
+        blocks = params.get("remote_block_ids")
+        if not host or not blocks:
+            return
+        import aiohttp
+        import numpy as np
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{host}/kv/export", json={"blocks": blocks},
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as resp:
+                    if resp.status != 200:
+                        return
+                    shape = tuple(
+                        int(x) for x in resp.headers["X-KV-Shape"].split(",")
+                    )
+                    dtype = resp.headers["X-KV-Dtype"]
+                    raw = await resp.read()
+            if dtype == "bfloat16":
+                import jax.numpy as jnp_
+
+                data = np.frombuffer(raw, jnp_.bfloat16).reshape(shape)
+            else:
+                data = np.frombuffer(raw, np.dtype(dtype)).reshape(shape)
+            cached = await self.async_engine.run_on_engine(
+                lambda eng: eng.import_kv(list(prompt_ids), data)
+            )
+            if cached:
+                body.setdefault("_kv_imported_tokens", cached)
+        except Exception as e:
+            # transfer is best-effort; decode recomputes on miss
+            import logging
+
+            logging.getLogger(__name__).warning("kv import failed: %s", e)
 
     async def detokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -213,13 +283,19 @@ class EngineServer:
                 status=400,
             )
 
+        kv_params = body.get("kv_transfer_params") or {}
+        if kv_params.get("remote_block_ids"):
+            await self._maybe_import_kv(body, prompt_ids)
+        produce_kv = bool(kv_params.get("do_remote_decode"))
+
         gen = self.async_engine.generate(prompt_ids, sampling, rid)
         if stream:
             return await self._stream_response(
                 request, gen, rid, created, model, chat, t_start, sampling
             )
         return await self._full_response(
-            gen, rid, created, model, chat, t_start, len(prompt_ids), sampling
+            gen, rid, created, model, chat, t_start, len(prompt_ids), sampling,
+            produce_kv=produce_kv,
         )
 
     def _check_stop_str(self, text: str, sampling: SamplingParams):
@@ -230,18 +306,21 @@ class EngineServer:
         return None
 
     async def _full_response(self, gen, rid, created, model, chat, t_start,
-                             n_prompt, sampling) -> web.Response:
+                             n_prompt, sampling, produce_kv=False) -> web.Response:
         tk = self.engine.tokenizer
         token_ids: list[int] = []
         finish_reason = None
         first_token_t = None
         cached = 0
+        final_blocks = None
         try:
             async for out in gen:
                 if first_token_t is None:
                     first_token_t = time.monotonic()
                 token_ids.extend(out.new_token_ids)
                 cached = out.num_cached_tokens
+                if out.block_ids is not None:
+                    final_blocks = out.block_ids
                 finish_reason = out.finish_reason or finish_reason
                 text = tk.decode(token_ids)
                 stopped = self._check_stop_str(text, sampling)
@@ -280,16 +359,26 @@ class EngineServer:
                 "logprobs": None,
             }
             obj = "text_completion"
-        return web.json_response(
-            {
-                "id": rid,
-                "object": obj,
-                "created": created,
-                "model": model,
-                "choices": [choice],
-                "usage": usage,
+        payload = {
+            "id": rid,
+            "object": obj,
+            "created": created,
+            "model": model,
+            "choices": [choice],
+            "usage": usage,
+        }
+        if produce_kv and final_blocks:
+            # producer side of the P→D handoff: hand the router/decoder the
+            # block handles (reference: engine-native kv_transfer_params,
+            # request.py:827-837; router fills remote_host)
+            payload["kv_transfer_params"] = {
+                "do_remote_prefill": True,
+                "remote_engine_id": self.model_name,
+                "remote_block_ids": final_blocks,
+                "remote_host": None,
+                "remote_port": None,
             }
-        )
+        return web.json_response(payload)
 
     async def _stream_response(self, request, gen, rid, created, model, chat,
                                t_start, sampling) -> web.StreamResponse:

@@ -294,7 +294,8 @@ class RequestService:
             monitor.on_request_complete(prefill_url, request_id, time.time())
 
         kv_params = pre_data.get("kv_transfer_params") or {}
-        kv_params.setdefault("remote_host", prefill_url)
+        if not kv_params.get("remote_host"):
+            kv_params["remote_host"] = prefill_url
         decode_body = dict(body)
         decode_body["kv_transfer_params"] = kv_params
         logger.info(
