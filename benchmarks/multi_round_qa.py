@@ -1,0 +1,186 @@
+#!/usr/bin/env python3
+"""Multi-round QA benchmark harness.
+
+The reference stack's headline benchmark methodology
+(benchmarks/multi-round-qa/ there; metric definitions in its README §
+"Benchmark Metrics"): simulated users hold multi-round conversations — a
+shared system prompt plus per-user chat history that regrows every round —
+against an OpenAI-compatible endpoint at a controlled arrival QPS. Because
+each round replays the conversation so far, the workload is dominated by
+prefix reuse: it is exactly the shape KV caching, prefix-aware routing and
+KV offload exist to accelerate.
+
+Reports: actual QPS, average prompt throughput (tok/s), average generation
+throughput (tok/s), average TTFT — plus p50/p99 TTFT.
+
+Dependency-free (aiohttp only), so it runs inside the engine/router images.
+
+Usage:
+  python benchmarks/multi_round_qa.py --base-url http://localhost:8001 \
+      --model tiny-llama --num-users 32 --num-rounds 5 --qps 2 \
+      --system-prompt-len 1000 --user-history-len 2000 --answer-len 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import statistics
+import time
+
+import aiohttp
+
+
+def lorem(n_tokens: int, seed: int) -> str:
+    rng = random.Random(seed)
+    words = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+             "hotel", "india", "juliet", "kilo", "lima", "mike", "november"]
+    return " ".join(rng.choice(words) for _ in range(n_tokens))
+
+
+class UserSession:
+    def __init__(self, uid: int, args):
+        self.uid = uid
+        self.args = args
+        self.system_prompt = lorem(args.system_prompt_len, seed=0)  # shared
+        self.history = [
+            {"role": "system",
+             "content": self.system_prompt + lorem(args.user_history_len,
+                                                   seed=uid + 1)}
+        ]
+        self.round = 0
+
+    def next_messages(self) -> list[dict]:
+        self.round += 1
+        self.history.append(
+            {"role": "user",
+             "content": f"round {self.round}: " + lorem(24, self.uid * 997 + self.round)}
+        )
+        return list(self.history)
+
+    def record_answer(self, text: str) -> None:
+        self.history.append({"role": "assistant", "content": text})
+
+
+async def one_request(session, args, user: UserSession, results: list):
+    messages = user.next_messages()
+    t0 = time.perf_counter()
+    ttft = None
+    n_out = 0
+    n_prompt = 0
+    text_parts = []
+    try:
+        async with session.post(
+            f"{args.base_url}/v1/chat/completions",
+            json={"model": args.model, "messages": messages,
+                  "max_tokens": args.answer_len, "temperature": 0.0,
+                  "stream": True, "ignore_eos": True},
+            headers={"x-user-id": f"user-{user.uid}"},
+            timeout=aiohttp.ClientTimeout(total=args.request_timeout),
+        ) as resp:
+            if resp.status != 200:
+                results.append({"ok": False, "error": f"HTTP {resp.status}"})
+                return
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                chunk = json.loads(line[6:])
+                delta = chunk.get("choices", [{}])[0].get("delta", {})
+                if delta.get("content"):
+                    text_parts.append(delta["content"])
+                usage = chunk.get("usage")
+                if usage:
+                    n_out = usage.get("completion_tokens", 0)
+                    n_prompt = usage.get("prompt_tokens", 0)
+    except Exception as e:
+        results.append({"ok": False, "error": str(e)})
+        return
+    elapsed = time.perf_counter() - t0
+    user.record_answer("".join(text_parts))
+    results.append({
+        "ok": True, "ttft": ttft if ttft is not None else elapsed,
+        "elapsed": elapsed,
+        "prompt_tokens": n_prompt or sum(len(m["content"].split()) for m in messages),
+        "output_tokens": n_out or args.answer_len,
+    })
+
+
+async def run(args) -> dict:
+    users = [UserSession(i, args) for i in range(args.num_users)]
+    results: list[dict] = []
+    tasks = []
+    interval = 1.0 / args.qps if args.qps > 0 else 0
+    t_start = time.perf_counter()
+    deadline = t_start + args.duration if args.duration else None
+
+    async with aiohttp.ClientSession() as session:
+        sent = 0
+        per_user_rounds = {u.uid: 0 for u in users}
+        while True:
+            candidates = [u for u in users if per_user_rounds[u.uid] < args.num_rounds]
+            if not candidates:
+                break
+            if deadline and time.perf_counter() > deadline:
+                break
+            user = random.choice(candidates)
+            per_user_rounds[user.uid] += 1
+            tasks.append(asyncio.create_task(
+                one_request(session, args, user, results)
+            ))
+            sent += 1
+            if interval:
+                await asyncio.sleep(interval)
+        await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t_start
+
+    ok = [r for r in results if r.get("ok")]
+    failed = len(results) - len(ok)
+    ttfts = sorted(r["ttft"] for r in ok) or [0.0]
+    summary = {
+        "requests": len(results),
+        "failed": failed,
+        "actual_qps": round(len(ok) / wall, 3),
+        "avg_prompt_throughput_tok_s": round(
+            sum(r["prompt_tokens"] for r in ok) / wall, 1),
+        "avg_generation_throughput_tok_s": round(
+            sum(r["output_tokens"] for r in ok) / wall, 1),
+        "avg_ttft_s": round(statistics.mean(ttfts), 4),
+        "p50_ttft_s": round(ttfts[len(ttfts) // 2], 4),
+        "p99_ttft_s": round(ttfts[min(int(len(ttfts) * 0.99), len(ttfts) - 1)], 4),
+        "avg_latency_s": round(statistics.mean(r["elapsed"] for r in ok), 4)
+        if ok else 0.0,
+        "wall_s": round(wall, 2),
+    }
+    return summary
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("multi-round-qa")
+    p.add_argument("--base-url", default="http://localhost:8001")
+    p.add_argument("--model", required=True)
+    p.add_argument("--num-users", type=int, default=32)
+    p.add_argument("--num-rounds", type=int, default=5)
+    p.add_argument("--qps", type=float, default=2.0)
+    p.add_argument("--system-prompt-len", type=int, default=1000)
+    p.add_argument("--user-history-len", type=int, default=2000)
+    p.add_argument("--answer-len", type=int, default=100)
+    p.add_argument("--duration", type=float, default=None,
+                   help="optional wall-clock cap in seconds")
+    p.add_argument("--request-timeout", type=float, default=300.0)
+    p.add_argument("--output", default=None, help="write summary JSON here")
+    args = p.parse_args(argv)
+    summary = asyncio.run(run(args))
+    print(json.dumps(summary))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(summary, f, indent=2)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+// Native chunk-hash prefix trie — the hot-path data structure behind
+// prefix-aware routing, as a compiled component (the reference implements
+// this picker in Go for its gateway inference extension,
+// src/gateway_inference_extension/prefix_aware_picker.go:134-190; C++ here
+// since this build's native toolchain is C++).
+//
+// Semantics mirror the Python HashTrie (production_stack_tpu/router/
+// hashtrie.py): text is chunked (chunk_size chars), each chunk hashed
+// (FNV-1a 64), the hash chain forms a trie path, every node records the
+// endpoints that served a prompt through it. longest_prefix_match walks the
+// chain intersecting with the available-endpoint set.
+//
+// C ABI for ctypes; guarded by a mutex so any embedding (asyncio thread,
+// gateway worker pool) is safe. Build: make (see Makefile; `make tsan` for
+// the ThreadSanitizer build used in CI).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+uint64_t fnv1a(const char* data, size_t len) {
+    uint64_t h = 1469598103934665603ULL;
+    for (size_t i = 0; i < len; ++i) {
+        h ^= static_cast<unsigned char>(data[i]);
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+struct Node {
+    std::map<uint64_t, std::unique_ptr<Node>> children;
+    std::set<std::string> endpoints;
+};
+
+struct Trie {
+    Node root;
+    size_t chunk_size;
+    size_t max_depth;
+    std::mutex mu;
+};
+
+std::set<std::string> split_lines(const char* joined) {
+    std::set<std::string> out;
+    if (!joined) return out;
+    const char* p = joined;
+    while (*p) {
+        const char* nl = strchr(p, '\n');
+        size_t n = nl ? static_cast<size_t>(nl - p) : strlen(p);
+        if (n) out.emplace(p, n);
+        if (!nl) break;
+        p = nl + 1;
+    }
+    return out;
+}
+
+void remove_endpoint_rec(Node* node, const std::string& ep) {
+    node->endpoints.erase(ep);
+    for (auto& kv : node->children) remove_endpoint_rec(kv.second.get(), ep);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ht_create(size_t chunk_size, size_t max_depth) {
+    auto* t = new Trie();
+    t->chunk_size = chunk_size ? chunk_size : 128;
+    t->max_depth = max_depth ? max_depth : 1024;
+    return t;
+}
+
+void ht_destroy(void* handle) { delete static_cast<Trie*>(handle); }
+
+void ht_insert(void* handle, const char* text, size_t len, const char* endpoint) {
+    auto* t = static_cast<Trie*>(handle);
+    std::lock_guard<std::mutex> lock(t->mu);
+    Node* node = &t->root;
+    node->endpoints.insert(endpoint);
+    size_t limit = std::min(len, t->chunk_size * t->max_depth);
+    for (size_t i = 0; i < limit; i += t->chunk_size) {
+        size_t n = std::min(t->chunk_size, len - i);
+        uint64_t h = fnv1a(text + i, n);
+        auto it = node->children.find(h);
+        if (it == node->children.end()) {
+            it = node->children.emplace(h, std::make_unique<Node>()).first;
+        }
+        node = it->second.get();
+        node->endpoints.insert(endpoint);
+    }
+}
+
+// Returns matched char count; writes '\n'-joined matching endpoints into
+// out (truncated to out_cap, always NUL-terminated).
+size_t ht_match(void* handle, const char* text, size_t len,
+                const char* available_joined, char* out, size_t out_cap) {
+    auto* t = static_cast<Trie*>(handle);
+    std::lock_guard<std::mutex> lock(t->mu);
+    std::set<std::string> selected = split_lines(available_joined);
+    Node* node = &t->root;
+    size_t matched = 0;
+    size_t limit = std::min(len, t->chunk_size * t->max_depth);
+    for (size_t i = 0; i < limit; i += t->chunk_size) {
+        size_t n = std::min(t->chunk_size, len - i);
+        uint64_t h = fnv1a(text + i, n);
+        auto it = node->children.find(h);
+        if (it == node->children.end()) break;
+        Node* nxt = it->second.get();
+        std::set<std::string> inter;
+        for (const auto& ep : nxt->endpoints) {
+            if (selected.count(ep)) inter.insert(ep);
+        }
+        if (inter.empty()) break;
+        matched += t->chunk_size;
+        selected.swap(inter);
+        node = nxt;
+    }
+    // serialize selected
+    std::string joined;
+    for (const auto& ep : selected) {
+        if (!joined.empty()) joined += '\n';
+        joined += ep;
+    }
+    if (out_cap) {
+        size_t n = std::min(joined.size(), out_cap - 1);
+        memcpy(out, joined.data(), n);
+        out[n] = '\0';
+    }
+    return matched;
+}
+
+void ht_remove_endpoint(void* handle, const char* endpoint) {
+    auto* t = static_cast<Trie*>(handle);
+    std::lock_guard<std::mutex> lock(t->mu);
+    remove_endpoint_rec(&t->root, endpoint);
+}
+
+}  // extern "C"

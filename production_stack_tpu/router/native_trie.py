@@ -1,0 +1,92 @@
+"""ctypes binding for the native C++ hash trie (native/hashtrie).
+
+Drop-in for the Python HashTrie on the prefix-routing hot path. The shared
+library is built on demand with the repo Makefile (g++ is part of the image;
+no pybind11 dependency — plain C ABI). Falls back silently: callers should
+use ``load_native_trie()`` and keep the Python trie when it returns None.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Set, Tuple
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "..", "native", "hashtrie"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhashtrie.so")
+
+_MATCH_BUF = 1 << 16
+
+
+def _ensure_built() -> Optional[str]:
+    if os.path.exists(_LIB_PATH):
+        return _LIB_PATH
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR], check=True, capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        return None
+    return _LIB_PATH if os.path.exists(_LIB_PATH) else None
+
+
+class NativeHashTrie:
+    """Same interface as router.hashtrie.HashTrie."""
+
+    def __init__(self, lib: ctypes.CDLL, chunk_size: int = 128,
+                 max_depth: int = 1024):
+        self._lib = lib
+        self.chunk_size = chunk_size
+        self._handle = lib.ht_create(chunk_size, max_depth)
+
+    def __del__(self):
+        try:
+            self._lib.ht_destroy(self._handle)
+        except Exception:
+            pass
+
+    def insert(self, text: str, endpoint: str) -> None:
+        raw = text.encode()
+        self._lib.ht_insert(self._handle, raw, len(raw), endpoint.encode())
+
+    def longest_prefix_match(
+        self, text: str, available: Optional[Set[str]] = None
+    ) -> Tuple[int, Set[str]]:
+        raw = text.encode()
+        joined = "\n".join(sorted(available or ())).encode()
+        out = ctypes.create_string_buffer(_MATCH_BUF)
+        matched = self._lib.ht_match(
+            self._handle, raw, len(raw), joined, out, _MATCH_BUF
+        )
+        eps = set(out.value.decode().split("\n")) - {""}
+        return int(matched), eps
+
+    def remove_endpoint(self, endpoint: str) -> None:
+        self._lib.ht_remove_endpoint(self._handle, endpoint.encode())
+
+
+def load_native_trie(chunk_size: int = 128) -> Optional[NativeHashTrie]:
+    path = _ensure_built()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.ht_create.restype = ctypes.c_void_p
+    lib.ht_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+    lib.ht_destroy.argtypes = [ctypes.c_void_p]
+    lib.ht_insert.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.ht_match.restype = ctypes.c_size_t
+    lib.ht_match.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.ht_remove_endpoint.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    return NativeHashTrie(lib, chunk_size)

@@ -132,8 +132,17 @@ class SessionRouter(Router):
 
 
 class PrefixAwareRouter(Router):
-    def __init__(self, prefix_min_match_length: int = 0, chunk_size: int = 128, **_):
-        self.trie = HashTrie(chunk_size=chunk_size)
+    def __init__(self, prefix_min_match_length: int = 0, chunk_size: int = 128,
+                 use_native_trie: bool = True, **_):
+        self.trie = None
+        if use_native_trie:
+            from production_stack_tpu.router.native_trie import load_native_trie
+
+            self.trie = load_native_trie(chunk_size)
+            if self.trie is not None:
+                logger.info("prefix-aware router using native C++ trie")
+        if self.trie is None:
+            self.trie = HashTrie(chunk_size=chunk_size)
         self.min_match = prefix_min_match_length
 
     async def route_request(self, endpoints, engine_stats, request_stats,

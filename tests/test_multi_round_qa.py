@@ -1,0 +1,50 @@
+"""The multi-round-QA harness runs against the fake engine and reports the
+reference's metric set; multi-round prefix reuse shows up as cache hits when
+run against a real engine."""
+
+import asyncio
+import threading
+
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+
+def test_harness_against_fake_engine():
+    from aiohttp import web
+
+    fe = FakeEngine(model="fake-model", tokens_per_second=5000, ttft=0.001)
+
+    holder = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(fe.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = runner.addresses[0][1]
+        holder["loop"] = loop
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    for _ in range(100):
+        if "port" in holder:
+            break
+        import time
+
+        time.sleep(0.05)
+
+    from benchmarks.multi_round_qa import main
+
+    summary = main([
+        "--base-url", f"http://127.0.0.1:{holder['port']}",
+        "--model", "fake-model", "--num-users", "4", "--num-rounds", "2",
+        "--qps", "20", "--system-prompt-len", "50", "--user-history-len", "50",
+        "--answer-len", "8",
+    ])
+    assert summary["requests"] == 8
+    assert summary["failed"] == 0
+    assert summary["avg_generation_throughput_tok_s"] > 0
+    assert summary["p50_ttft_s"] >= 0
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)

@@ -1,0 +1,68 @@
+"""Native C++ trie: builds with the repo toolchain and matches the Python
+trie's observable semantics on the same inputs."""
+
+import pytest
+
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.native_trie import load_native_trie
+
+
+@pytest.fixture(scope="module")
+def native():
+    trie = load_native_trie(chunk_size=4)
+    if trie is None:
+        pytest.skip("native toolchain unavailable")
+    return trie
+
+
+def test_native_matches_python_semantics(native):
+    py = HashTrie(chunk_size=4)
+    for text, ep in [
+        ("aaaabbbbcccc", "e1"),
+        ("aaaabbbbdddd", "e2"),
+        ("zzzzyyyy", "e3"),
+    ]:
+        native.insert(text, ep)
+        py.insert(text, ep)
+
+    for query, avail in [
+        ("aaaabbbbcccc", {"e1", "e2", "e3"}),
+        ("aaaabbbbzzzz", {"e1", "e2", "e3"}),
+        ("aaaabbbbcccc", {"e2"}),
+        ("zzzz", {"e3"}),
+        ("totally new", {"e1", "e2", "e3"}),
+    ]:
+        n_native, eps_native = native.longest_prefix_match(query, avail)
+        n_py, eps_py = py.longest_prefix_match(query, avail)
+        assert n_native == n_py, (query, avail)
+        assert eps_native == eps_py, (query, avail)
+
+
+def test_native_remove_endpoint(native):
+    native.insert("qqqqwwww", "gone")
+    n, eps = native.longest_prefix_match("qqqqwwww", {"gone"})
+    assert n == 8 and eps == {"gone"}
+    native.remove_endpoint("gone")
+    n, eps = native.longest_prefix_match("qqqqwwww", {"gone"})
+    # no-match semantics (same as the Python/reference trie): zero matched
+    # chars and the untouched available set
+    assert n == 0
+
+
+def test_prefix_router_uses_native():
+    import asyncio
+
+    from production_stack_tpu.router.native_trie import NativeHashTrie
+    from production_stack_tpu.router.protocols import EndpointInfo
+    from production_stack_tpu.router.routing import PrefixAwareRouter
+
+    r = PrefixAwareRouter(use_native_trie=True)
+    if not isinstance(r.trie, NativeHashTrie):
+        pytest.skip("native trie not built")
+    eps = [EndpointInfo(url="http://e1", model_names=["m"]),
+           EndpointInfo(url="http://e2", model_names=["m"])]
+    prompt = "y" * 400
+    first = asyncio.run(r.route_request(eps, {}, {}, {}, {"prompt": prompt}))
+    for _ in range(3):
+        got = asyncio.run(r.route_request(eps, {}, {}, {}, {"prompt": prompt}))
+        assert got == first
