@@ -87,10 +87,14 @@ async def one_request(session, args, user: UserSession, results: list):
                 line = raw.decode().strip()
                 if not line.startswith("data: ") or line == "data: [DONE]":
                     continue
-                if ttft is None:
-                    ttft = time.perf_counter() - t0
                 chunk = json.loads(line[6:])
-                delta = chunk.get("choices", [{}])[0].get("delta", {})
+                choice = chunk.get("choices", [{}])[0]
+                delta = choice.get("delta", {})
+                # TTFT = first *content* (the immediate role-announce chunk
+                # arrives before any model compute)
+                if ttft is None and (delta.get("content") or
+                                     choice.get("finish_reason")):
+                    ttft = time.perf_counter() - t0
                 if delta.get("content"):
                     text_parts.append(delta["content"])
                 usage = chunk.get("usage")

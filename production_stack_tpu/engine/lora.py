@@ -1,0 +1,142 @@
+"""LoRA adapter loading: the engine-side contract behind the reference's
+LoraAdapter operator (it downloads adapters and POSTs
+/v1/load_lora_adapter // /v1/unload_lora_adapter to each engine pod —
+loadadapter_controller.go:553-574).
+
+Round-1 semantics: merge-on-load. The adapter's low-rank pairs are expanded
+(delta = B @ A * alpha/r) and added into the served weights; unload
+subtracts them back. One adapter live at a time per target module set —
+exact for the single-adapter fleet placements the operator performs;
+per-request multi-adapter batching is a later milestone.
+
+Adapter format: HF PEFT directory — adapter_config.json +
+adapter_model.safetensors with ``...layers.N.<module>.lora_A.weight`` (r, in)
+and ``lora_B.weight`` (out, r) tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+from production_stack_tpu.engine.config import ModelConfig
+
+# PEFT target module -> (our stacked param key, conversion rule)
+_TARGETS = {
+    "q_proj": ("wq", "proj_q"),
+    "k_proj": ("wk", "proj_kv"),
+    "v_proj": ("wv", "proj_kv"),
+    "o_proj": ("wo", "proj_o"),
+    "gate_proj": ("w_gate", "t"),
+    "up_proj": ("w_up", "t"),
+    "down_proj": ("w_down", "t"),
+}
+
+_KEY_RE = re.compile(r"layers\.(\d+)\.(?:self_attn|mlp)\.(\w+)\.lora_(A|B)\.weight")
+
+
+@dataclasses.dataclass
+class LoraAdapter:
+    name: str
+    path: str
+    scaling: float
+    # our param key -> stacked delta (L, *param_shape[1:]) float32
+    deltas: dict[str, np.ndarray]
+
+
+def _convert_delta(rule: str, delta: np.ndarray, cfg: ModelConfig) -> np.ndarray:
+    """(out, in) torch-linear delta → our param orientation."""
+    H, KH, D, E = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.hidden_size
+    if rule == "t":
+        return delta.T
+    if rule == "proj_q":
+        return delta.reshape(H, D, E).transpose(2, 0, 1)
+    if rule == "proj_kv":
+        return delta.reshape(KH, D, E).transpose(2, 0, 1)
+    if rule == "proj_o":
+        return delta.reshape(E, H, D).transpose(1, 2, 0)
+    raise ValueError(rule)
+
+
+def load_adapter(name: str, path: str, cfg: ModelConfig) -> LoraAdapter:
+    from safetensors import safe_open
+
+    cfg_path = os.path.join(path, "adapter_config.json")
+    scaling = 1.0
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            acfg = json.load(f)
+        r = acfg.get("r", 8)
+        scaling = acfg.get("lora_alpha", r) / max(r, 1)
+
+    st_path = os.path.join(path, "adapter_model.safetensors")
+    pairs: dict[tuple[int, str], dict[str, np.ndarray]] = {}
+    with safe_open(st_path, framework="np") as f:
+        for key in f.keys():
+            m = _KEY_RE.search(key)
+            if not m:
+                continue
+            layer, module, ab = int(m.group(1)), m.group(2), m.group(3)
+            if module not in _TARGETS:
+                continue
+            pairs.setdefault((layer, module), {})[ab] = f.get_tensor(key)
+
+    per_target: dict[str, dict[int, np.ndarray]] = {}
+    for (layer, module), ab in pairs.items():
+        if "A" not in ab or "B" not in ab:
+            continue
+        delta = (ab["B"].astype(np.float32) @ ab["A"].astype(np.float32)) * scaling
+        our_key, rule = _TARGETS[module]
+        per_target.setdefault(our_key, {})[layer] = _convert_delta(
+            rule, delta, cfg
+        )
+
+    deltas: dict[str, np.ndarray] = {}
+    for our_key, by_layer in per_target.items():
+        sample = next(iter(by_layer.values()))
+        stacked = np.zeros((cfg.num_layers, *sample.shape), np.float32)
+        for layer, d in by_layer.items():
+            stacked[layer] = d
+        deltas[our_key] = stacked
+    if not deltas:
+        raise ValueError(f"adapter at {path!r} has no supported LoRA targets")
+    return LoraAdapter(name=name, path=path, scaling=scaling, deltas=deltas)
+
+
+class LoraManager:
+    """Tracks loaded adapters and applies/removes their merged deltas."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.adapters: dict[str, LoraAdapter] = {}
+        self.merged: Optional[str] = None  # adapter currently in the weights
+
+    def list_adapters(self) -> list[str]:
+        return sorted(self.adapters)
+
+    def load(self, name: str, path: str) -> None:
+        if name in self.adapters:
+            return
+        adapter = load_adapter(name, path, self.engine.config.model)
+        if self.merged is not None:
+            raise RuntimeError(
+                f"adapter {self.merged!r} already merged; unload it first "
+                "(single live adapter per engine in this release)"
+            )
+        self.engine.runner.apply_param_deltas(adapter.deltas, sign=1.0)
+        self.adapters[name] = adapter
+        self.merged = name
+
+    def unload(self, name: str) -> bool:
+        adapter = self.adapters.pop(name, None)
+        if adapter is None:
+            return False
+        if self.merged == name:
+            self.engine.runner.apply_param_deltas(adapter.deltas, sign=-1.0)
+            self.merged = None
+        return True

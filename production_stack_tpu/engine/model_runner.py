@@ -310,6 +310,23 @@ class ModelRunner:
             )
         return np.asarray(jax.device_get(sampled))
 
+    def apply_param_deltas(self, deltas: dict, sign: float) -> None:
+        """In-place add/subtract stacked layer deltas (LoRA merge/unmerge)."""
+        def _apply(layers, **host_deltas):
+            out = dict(layers)
+            for key, d in host_deltas.items():
+                out[key] = (
+                    layers[key].astype(jnp.float32) + sign * d
+                ).astype(layers[key].dtype)
+            return out
+
+        with jax.set_mesh(self.mesh):
+            new_layers = jax.jit(_apply, donate_argnums=(0,))(
+                self.params["layers"],
+                **{k: jnp.asarray(v) for k, v in deltas.items()},
+            )
+        self.params = dict(self.params, layers=new_layers)
+
     # -- KV block export/import (disaggregated prefill→decode transfer) -----
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:
         """Gather blocks out of HBM → host (L, n, bs, 2KH, D) array."""

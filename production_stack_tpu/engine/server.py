@@ -57,6 +57,9 @@ class EngineServer:
         self.engine = engine or LLMEngine(config)
         self.async_engine = AsyncEngine(self.engine)
         self.metrics = ServerMetrics(self.engine, self.model_name)
+        from production_stack_tpu.engine.lora import LoraManager
+
+        self.lora = LoraManager(self.engine)
         self.start_time = time.time()
 
     # -- app assembly --------------------------------------------------------
@@ -72,6 +75,8 @@ class EngineServer:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/kv/export", self.kv_export)
+        app.router.add_post("/v1/load_lora_adapter", self.load_lora)
+        app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/sleep", self.sleep)
         app.router.add_post("/wake_up", self.wake_up)
         app.router.add_get("/is_sleeping", self.is_sleeping)
@@ -95,22 +100,58 @@ class EngineServer:
         return web.json_response({"version": __version__})
 
     async def models(self, request: web.Request) -> web.Response:
-        return web.json_response(
+        cards = [
             {
-                "object": "list",
-                "data": [
-                    {
-                        "id": self.model_name,
-                        "object": "model",
-                        "created": int(self.start_time),
-                        "owned_by": "production-stack-tpu",
-                        "root": self.model_name,
-                        "parent": None,
-                        "max_model_len": self.config.model.max_model_len,
-                    }
-                ],
+                "id": self.model_name,
+                "object": "model",
+                "created": int(self.start_time),
+                "owned_by": "production-stack-tpu",
+                "root": self.model_name,
+                "parent": None,
+                "max_model_len": self.config.model.max_model_len,
             }
+        ]
+        for name in self.lora.list_adapters():
+            cards.append(
+                {
+                    "id": name,
+                    "object": "model",
+                    "created": int(self.start_time),
+                    "owned_by": "production-stack-tpu",
+                    "root": self.model_name,
+                    "parent": self.model_name,
+                }
+            )
+        return web.json_response({"object": "list", "data": cards})
+
+    # -- LoRA (reference operator contract: loadadapter_controller.go:553) --
+    async def load_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name, path = body.get("lora_name"), body.get("lora_path")
+        if not name or not path:
+            return web.json_response(
+                {"error": {"message": "lora_name and lora_path required"}},
+                status=400,
+            )
+        try:
+            await self.async_engine.run_on_engine(
+                lambda eng: self.lora.load(name, path)
+            )
+        except Exception as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        return web.json_response({"status": "loaded", "lora_name": name})
+
+    async def unload_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        ok = await self.async_engine.run_on_engine(
+            lambda eng: self.lora.unload(name)
         )
+        if not ok:
+            return web.json_response(
+                {"error": {"message": f"adapter {name!r} not loaded"}}, status=404
+            )
+        return web.json_response({"status": "unloaded", "lora_name": name})
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(
@@ -469,6 +510,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--served-model-name", default=None)
+    p.add_argument("--num-scheduler-steps", type=int, default=None,
+                   help="decode iterations fused per dispatch (multi-step)")
+    p.add_argument("--prefill-batch", type=int, default=None,
+                   help="prefill chunks batched per dispatch")
+    p.add_argument("--max-num-batched-tokens", type=int, default=None)
+    p.add_argument("--prefill-buckets", default=None,
+                   help="comma-separated token buckets, e.g. 128,512,2048")
     return p
 
 
@@ -491,6 +539,16 @@ def config_from_args(args) -> EngineConfig:
         cfg.cache.block_size = args.block_size
     if args.num_blocks:
         cfg.cache.num_blocks = args.num_blocks
+    if args.num_scheduler_steps:
+        cfg.scheduler.multi_step = args.num_scheduler_steps
+    if args.prefill_batch:
+        cfg.scheduler.prefill_batch = args.prefill_batch
+    if args.max_num_batched_tokens:
+        cfg.scheduler.max_num_batched_tokens = args.max_num_batched_tokens
+    if args.prefill_buckets:
+        cfg.scheduler.prefill_buckets = tuple(
+            int(x) for x in args.prefill_buckets.split(",")
+        )
     cfg.mesh = MeshConfig(
         data=args.data_parallel_size, tensor=args.tensor_parallel_size
     )
