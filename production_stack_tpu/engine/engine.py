@@ -45,6 +45,11 @@ class LLMEngine:
         self.scheduler = Scheduler(
             config.scheduler, config.cache, self.runner.num_blocks
         )
+        from production_stack_tpu.engine.kv_offload import maybe_make_store
+
+        self.host_kv = maybe_make_store(config.cache)
+        if self.host_kv is not None:
+            self.scheduler.admission_hook = self._host_extend_seq
         B = config.scheduler.max_num_seqs
         M = self.runner.max_blocks_per_seq
         # persistent decode-batch host arrays (rewritten in place each step)
@@ -106,6 +111,42 @@ class LLMEngine:
         if out.prefills:
             return self._run_prefill(out.prefills)
         return self._run_decode(out.decodes)
+
+    # -- host-DRAM KV tier (see engine/kv_offload.py) ------------------------
+    def _host_extend_seq(self, seq: Sequence) -> None:
+        """Admission hook: extend a freshly admitted sequence's cached prefix
+        from the host tier (blocks evicted from HBM but surviving in host
+        DRAM are re-imported instead of recomputed)."""
+        bs = self.config.cache.block_size
+        if seq.num_computed_tokens % bs:
+            return
+        start_block = seq.num_computed_tokens // bs
+        slabs, n = self.host_kv.match_extension(seq.token_ids, start_block)
+        if not n:
+            return
+        import numpy as np
+
+        target = seq.block_ids[start_block : start_block + n]
+        data = np.stack(slabs).transpose(1, 0, 2, 3, 4)  # (L, n, bs, ...)
+        self.runner.import_blocks(target, data)
+        seq.num_computed_tokens += n * bs
+        seq.num_cached_tokens += n * bs
+        self.scheduler.allocator.commit_full_blocks(
+            seq.token_ids[: seq.num_computed_tokens],
+            seq.block_ids[: start_block + n],
+        )
+
+    def _host_offload_finished(self, seq: Sequence) -> None:
+        """Copy a finishing sequence's full blocks to the host tier."""
+        bs = self.config.cache.block_size
+        n_full = min(len(seq.token_ids) // bs, len(seq.block_ids))
+        if n_full <= 0:
+            return
+        import numpy as np
+
+        data = self.runner.export_blocks(seq.block_ids[:n_full])
+        slabs = np.ascontiguousarray(data.transpose(1, 0, 2, 3, 4))
+        self.host_kv.put_sequence(seq.token_ids[: n_full * bs], slabs)
 
     def _bucket(self, n: int) -> int:
         return self.config.scheduler.bucket_for(n, self.config.model.max_model_len)
@@ -227,6 +268,8 @@ class LLMEngine:
         for seq, toks in zip(seqs, token_lists):
             status = self._check_stop(seq, toks[-1]) if toks else None
             if status is not None:
+                if self.host_kv is not None:
+                    self._host_offload_finished(seq)
                 self.scheduler.finish(seq, status)
                 self._slot_seq.pop(seq.slot, None)
                 seq.finish_time = time.monotonic()
@@ -281,7 +324,7 @@ class LLMEngine:
     # -- metrics (the /metrics contract) -------------------------------------
     def stats(self) -> dict:
         alloc = self.scheduler.allocator
-        return {
+        out = {
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
             "gpu_cache_usage_perc": alloc.usage,
@@ -289,7 +332,15 @@ class LLMEngine:
             "gpu_prefix_cache_queries_total": alloc.prefix_queries,
             "prompt_tokens_total": self.total_prompt_tokens,
             "generation_tokens_total": self.total_output_tokens,
+            "cpu_cache_usage_perc": 0.0,
+            "cpu_prefix_cache_hits_total": 0,
+            "cpu_prefix_cache_queries_total": 0,
         }
+        if self.host_kv is not None:
+            out["cpu_cache_usage_perc"] = self.host_kv.usage
+            out["cpu_prefix_cache_hits_total"] = self.host_kv.hits
+            out["cpu_prefix_cache_queries_total"] = self.host_kv.queries
+        return out
 
     # -- convenience for tests / offline use ---------------------------------
     def generate(

@@ -1,0 +1,90 @@
+"""Host-DRAM KV offload tier (the LMCache CPU-offload equivalent — the
+reference wires LMCACHE_LOCAL_CPU / cpuOffloadingBufferSize into every
+engine pod, deployment-vllm-multi.yaml:284-345; BASELINE.json names
+HBM↔host↔remote tiering the north-star).
+
+Design: the HBM pool's prefix cache is the hot tier; this store is the warm
+tier. When a sequence finishes, its full blocks' slabs are copied
+device→host and indexed by the same content-hash chain the allocator uses.
+On admission, any chain extension that misses HBM but hits the host store
+is imported into freshly allocated blocks — so KV survives HBM eviction and
+conversation rounds keep their prefix even under memory pressure.
+
+Capacity-bounded LRU of block slabs; all lookups/stores are host-side dict
+ops keyed by the allocator's chain hashes.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional, Sequence
+
+import numpy as np
+
+from production_stack_tpu.engine.kv_cache import _HASH_SEED, _chain_hash
+
+
+class HostKVStore:
+    def __init__(self, capacity_blocks: int, block_size: int):
+        self.capacity = capacity_blocks
+        self.block_size = block_size
+        self.store: "collections.OrderedDict[int, np.ndarray]" = (
+            collections.OrderedDict()
+        )  # chain_hash -> (L, bs, 2KH, D) slab
+        self.stores = 0
+        self.hits = 0
+        self.queries = 0
+
+    @property
+    def usage(self) -> float:
+        return len(self.store) / max(self.capacity, 1)
+
+    def chain_hashes(self, tokens: Sequence[int]) -> list[int]:
+        out, prev = [], _HASH_SEED
+        for i in range(len(tokens) // self.block_size):
+            chunk = tuple(tokens[i * self.block_size : (i + 1) * self.block_size])
+            prev = _chain_hash(prev, chunk)
+            out.append(prev)
+        return out
+
+    def put_sequence(self, tokens: Sequence[int], slabs: np.ndarray) -> int:
+        """Store full-block slabs of a finished sequence.
+        slabs: (n_full, L, bs, 2KH, D) — one slab per full block."""
+        added = 0
+        for h, slab in zip(self.chain_hashes(tokens), slabs):
+            if h in self.store:
+                self.store.move_to_end(h)
+                continue
+            while len(self.store) >= self.capacity:
+                self.store.popitem(last=False)
+            self.store[h] = slab
+            added += 1
+        self.stores += added
+        return added
+
+    def match_extension(
+        self, tokens: Sequence[int], start_block: int
+    ) -> tuple[list[np.ndarray], int]:
+        """Longest run of host-cached blocks continuing a chain from
+        ``start_block`` (the number of blocks the HBM tier already covers).
+        Never extends past the last full block (the final token always
+        recomputes). Returns (slabs, n_blocks)."""
+        hashes = self.chain_hashes(tokens)
+        max_usable = max((len(tokens) - 1) // self.block_size, 0)
+        slabs: list[np.ndarray] = []
+        for i in range(start_block, min(len(hashes), max_usable)):
+            self.queries += 1
+            slab = self.store.get(hashes[i])
+            if slab is None:
+                break
+            self.store.move_to_end(hashes[i])
+            self.hits += 1
+            slabs.append(slab)
+        return slabs, len(slabs)
+
+
+def maybe_make_store(cache_config) -> Optional[HostKVStore]:
+    if cache_config.host_offload_blocks > 0:
+        return HostKVStore(cache_config.host_offload_blocks,
+                           cache_config.block_size)
+    return None

@@ -67,6 +67,22 @@ class EngineStatsCollector:
         yield counter(
             "vllm:gpu_prefix_cache_queries", "Prefix cache block queries", queries
         )
+        # host-DRAM KV tier (LMCache CPU-offload equivalent)
+        yield gauge(
+            "vllm:cpu_cache_usage_perc",
+            "Host-DRAM KV offload tier usage (1 = 100%)",
+            s.get("cpu_cache_usage_perc", 0.0),
+        )
+        yield counter(
+            "vllm:cpu_prefix_cache_hits",
+            "Host-tier prefix block hits",
+            s.get("cpu_prefix_cache_hits_total", 0),
+        )
+        yield counter(
+            "vllm:cpu_prefix_cache_queries",
+            "Host-tier prefix block queries",
+            s.get("cpu_prefix_cache_queries_total", 0),
+        )
         yield counter(
             "vllm:prompt_tokens", "Cumulative prompt tokens", s["prompt_tokens_total"]
         )

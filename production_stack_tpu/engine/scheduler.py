@@ -56,6 +56,9 @@ class Scheduler:
         self.waiting: collections.deque[Sequence] = collections.deque()
         self.seqs: dict[str, Sequence] = {}  # admitted, not finished
         self.free_slots = list(range(sched.max_num_seqs - 1, -1, -1))
+        # invoked right after a sequence is admitted, before its first chunk
+        # is scheduled (the host-KV tier extends cached prefixes here)
+        self.admission_hook = None
 
     # -- queue management ---------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -126,6 +129,8 @@ class Scheduler:
             seq.slot = self.free_slots.pop()
             seq.status = SequenceStatus.PREFILLING
             self.seqs[seq.request_id] = seq
+            if self.admission_hook is not None:
+                self.admission_hook(seq)
 
     # -- the per-step decision ----------------------------------------------
     def schedule(self) -> SchedulerOutput:
