@@ -145,25 +145,52 @@ def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
-    """Mixtral sparse MoE block.
+    """Mixtral sparse MoE block — capacity-based top-k dispatch.
 
-    Round-1 implementation computes every expert densely and combines with
-    top-k routing weights — compiler-friendly static shapes, correct
-    semantics; the expert-parallel all_to_all dispatch path lands with the
-    ``expert`` mesh axis work (SURVEY.md §2.9 EP).
+    Tokens are routed to their top-k experts through dispatch/combine
+    one-hots (Mesh-TensorFlow/GSPMD style): expert FFNs see a dense
+    (experts, capacity, E) batch, so with the ``experts`` axis sharded over
+    the expert mesh axis XLA partitions per-expert compute and inserts the
+    all_to_all-equivalent collectives itself — no hand-written dispatch.
+    Static shapes throughout; tokens beyond an expert's capacity are dropped
+    (capacity_factor 2.0 makes that vanishingly rare at Mixtral's k/X).
     """
-    logits = jnp.einsum("...te,ex->...tx", x, lp["router"]).astype(jnp.float32)
+    orig_shape = x.shape
+    E = orig_shape[-1]
+    xt = x.reshape(-1, E)  # (T, E) flattened tokens
+    T = xt.shape[0]
+    X = cfg.num_experts
     k = cfg.num_experts_per_tok
-    top_vals, _ = lax.top_k(logits, k)
-    kth = top_vals[..., -1:]
-    masked = jnp.where(logits >= kth, logits, -jnp.inf)
-    weights = jax.nn.softmax(masked, axis=-1).astype(x.dtype)  # (..., T, X)
-    gate = jnp.einsum("...te,xef->...txf", x, lp["w_gate"])
-    up = jnp.einsum("...te,xef->...txf", x, lp["w_up"])
+
+    logits = jnp.einsum("te,ex->tx", xt, lp["router"]).astype(jnp.float32)
+    top_vals, top_idx = lax.top_k(logits, k)  # (T, k)
+    weights = jax.nn.softmax(top_vals, axis=-1)  # normalised over chosen k
+
+    capacity = max(int(2.0 * T * k / X), k)
+    # position of each (token, choice) within its expert's capacity buffer
+    choice_onehot = jax.nn.one_hot(top_idx, X, dtype=jnp.int32)  # (T, k, X)
+    flat = choice_onehot.reshape(T * k, X)
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat  # (T*k, X)
+    pos = jnp.sum(pos_in_expert * flat, axis=-1).reshape(T, k)  # (T, k)
+    keep = pos < capacity
+
+    # dispatch (T, X, C) one-hot and combine (T, X, C) weighted
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity,
+                            dtype=xt.dtype)  # (T, k, C)
+    disp = jnp.einsum("tkx,tkc->txc", choice_onehot.astype(xt.dtype), pos_oh)
+    comb = jnp.einsum(
+        "tkx,tkc->txc", choice_onehot.astype(jnp.float32) * weights[..., None],
+        pos_oh.astype(jnp.float32),
+    ).astype(xt.dtype)
+
+    expert_in = jnp.einsum("txc,te->xce", disp, xt)  # (X, C, E)
+    gate = jnp.einsum("xce,xef->xcf", expert_in, lp["w_gate"])
+    up = jnp.einsum("xce,xef->xcf", expert_in, lp["w_up"])
     expert_out = jnp.einsum(
-        "...txf,xfe->...txe", jax.nn.silu(gate) * up, lp["w_down"]
+        "xcf,xfe->xce", jax.nn.silu(gate) * up, lp["w_down"]
     )
-    return jnp.einsum("...txe,...tx->...te", expert_out, weights)
+    out = jnp.einsum("txc,xce->te", comb, expert_out)
+    return out.reshape(orig_shape)
 
 
 def forward_tokens(
