@@ -71,7 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8001)
     # service discovery
     p.add_argument("--service-discovery", default="static",
-                   choices=["static", "k8s_pod_ip", "external_only"])
+                   choices=["static", "k8s_pod_ip", "k8s_service_name",
+                            "external_only"])
     p.add_argument("--static-backends", default="",
                    help="comma-separated engine base URLs")
     p.add_argument("--static-models", default="",
@@ -108,6 +109,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--callbacks", default=None,
                    help="module.attribute of a custom callback handler")
     p.add_argument("--semantic-cache-threshold", type=float, default=0.92)
+    p.add_argument("--otel-endpoint", default=None,
+                   help="OTLP gRPC endpoint; W3C propagation is always on")
+    p.add_argument("--otel-service-name", default="tpu-router")
+    p.add_argument("--otel-secure", action="store_true")
     p.add_argument("--external-providers-config", default=None,
                    help="YAML file mapping model ids to external providers")
     p.add_argument("--api-key-file", default=None)
@@ -133,6 +138,13 @@ class RouterApp:
         args = self.args
         set_log_level(args.log_level)
 
+        from production_stack_tpu.router.experimental.tracing import (
+            initialize_tracing,
+        )
+
+        initialize_tracing(args.otel_endpoint, args.otel_service_name,
+                           args.otel_secure)
+
         if args.service_discovery == "static":
             urls = [u for u in args.static_backends.split(",") if u]
             models = [x for x in args.static_models.split(",") if x]
@@ -146,9 +158,16 @@ class RouterApp:
                     health_check_interval=args.health_check_interval,
                 )
             )
-        elif args.service_discovery == "k8s_pod_ip":
+        elif args.service_discovery in ("k8s_pod_ip", "k8s_service_name"):
+            from production_stack_tpu.router.service_discovery import (
+                K8sServiceNameServiceDiscovery,
+            )
+
+            cls = (K8sPodIPServiceDiscovery
+                   if args.service_discovery == "k8s_pod_ip"
+                   else K8sServiceNameServiceDiscovery)
             initialize_service_discovery(
-                K8sPodIPServiceDiscovery(
+                cls(
                     namespace=args.k8s_namespace,
                     label_selector=args.k8s_label_selector,
                     port=args.k8s_port,

@@ -1,0 +1,111 @@
+"""Distributed tracing (reference: src/vllm_router/experimental/otel/
+tracing.py — OTLP gRPC exporter + BatchSpanProcessor, W3C context extract
+from inbound headers and inject into backend requests, SERVER span per
+router request and CLIENT span per backend attempt).
+
+This image ships only the OpenTelemetry *API*: W3C traceparent propagation
+works unconditionally (so engines and downstream services join the trace);
+spans become recording + exported when opentelemetry-sdk and the OTLP
+exporter are installed in the deployment image (the Dockerfiles can add
+them; init degrades gracefully otherwise).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+_tracer = None
+_propagator = None
+_enabled = False
+
+
+def initialize_tracing(endpoint: Optional[str], service_name: str = "tpu-router",
+                       secure: bool = False) -> bool:
+    """Returns True when spans will actually be recorded+exported."""
+    global _tracer, _propagator, _enabled
+    from opentelemetry import trace
+    from opentelemetry.trace.propagation.tracecontext import (
+        TraceContextTextMapPropagator,
+    )
+
+    _propagator = TraceContextTextMapPropagator()
+    exporting = False
+    if endpoint:
+        try:
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                OTLPSpanExporter,
+            )
+
+            provider = TracerProvider(
+                resource=Resource.create({"service.name": service_name})
+            )
+            provider.add_span_processor(
+                BatchSpanProcessor(
+                    OTLPSpanExporter(endpoint=endpoint, insecure=not secure)
+                )
+            )
+            trace.set_tracer_provider(provider)
+            exporting = True
+            logger.info("OTel tracing exporting to %s", endpoint)
+        except ImportError:
+            logger.warning(
+                "--otel-endpoint set but opentelemetry-sdk/exporter not "
+                "installed; running with W3C propagation only"
+            )
+    _tracer = trace.get_tracer("production_stack_tpu.router")
+    _enabled = True
+    return exporting
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def extract_context(headers) -> Optional[object]:
+    if not _enabled or _propagator is None:
+        return None
+    return _propagator.extract(carrier=dict(headers))
+
+
+def inject_headers(headers: dict, context=None) -> dict:
+    if _enabled and _propagator is not None:
+        _propagator.inject(carrier=headers, context=context)
+    return headers
+
+
+class request_span:
+    """SERVER (or CLIENT) span context manager; no-op when tracing is off."""
+
+    def __init__(self, name: str, context=None, kind: str = "server",
+                 attributes: Optional[dict] = None):
+        self.name = name
+        self.context = context
+        self.kind = kind
+        self.attributes = attributes or {}
+        self._cm = None
+        self.span = None
+
+    def __enter__(self):
+        if not _enabled or _tracer is None:
+            return None
+        from opentelemetry.trace import SpanKind
+
+        kind = SpanKind.SERVER if self.kind == "server" else SpanKind.CLIENT
+        self._cm = _tracer.start_as_current_span(
+            self.name, context=self.context, kind=kind,
+            attributes=self.attributes,
+        )
+        self.span = self._cm.__enter__()
+        return self.span
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            return self._cm.__exit__(*exc)
+        return False

@@ -178,23 +178,38 @@ class RequestService:
         """One backend attempt. Raises BackendError before any byte has been
         relayed (so failover is safe); after first byte, errors terminate the
         stream."""
+        from production_stack_tpu.router.experimental import tracing
+
         monitor = get_request_stats_monitor()
         stream = bool(body.get("stream", False))
         monitor.on_new_request(url, request_id, time.time())
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
+        # CLIENT span per backend attempt; W3C context continues into the
+        # engine so its logs/traces join the request
+        span_cm = tracing.request_span(
+            f"backend {endpoint_path}",
+            context=tracing.extract_context(request.headers),
+            kind="client",
+            attributes={"backend.url": url, "model": model,
+                        "request.id": request_id, "streaming": stream},
+        )
+        span_cm.__enter__()
+        tracing.inject_headers(headers)
         try:
             backend = await self.session.post(
                 f"{url}{endpoint_path}", json=body, headers=headers
             )
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             monitor.on_request_complete(url, request_id, time.time())
+            span_cm.__exit__(None, None, None)
             raise BackendError("connect", f"{type(e).__name__}: {e}") from e
 
         if backend.status >= 500:
             text = await backend.text()
             backend.release()
             monitor.on_request_complete(url, request_id, time.time())
+            span_cm.__exit__(None, None, None)
             raise BackendError("http_5xx", f"HTTP {backend.status}: {text[:200]}")
 
         resp = web.StreamResponse(
@@ -236,6 +251,9 @@ class RequestService:
                 server=url, model=model, status=status_label
             ).observe(now - t_start)
             backend.release()
+            if span_cm.span is not None:
+                span_cm.span.set_attribute("http.status_code", backend.status)
+            span_cm.__exit__(None, None, None)
             if status_label == "200":
                 if self.post_response is not None and not stream:
                     try:

@@ -298,6 +298,68 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         return False
 
 
+class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
+    """Watches Services instead of Pods and routes to the service DNS name —
+    for clusters where pod IPs aren't directly reachable from the router
+    (reference: service_discovery.py:892-1423; 1:1 service-per-pod layout
+    recommended there)."""
+
+    async def _watch_loop(self) -> None:
+        url = f"{self.api_server}/api/v1/namespaces/{self.namespace}/services"
+        params = {"watch": "true"}
+        if self.label_selector:
+            params["labelSelector"] = self.label_selector
+        while True:
+            try:
+                async with aiohttp.ClientSession(headers=self._headers()) as s:
+                    async with s.get(
+                        url, params=params, ssl=self._ssl(),
+                        timeout=aiohttp.ClientTimeout(total=None, sock_read=None),
+                    ) as resp:
+                        resp.raise_for_status()
+                        self._healthy = True
+                        async for line in resp.content:
+                            if line.strip():
+                                await self._on_service_event(s, json.loads(line))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._healthy = False
+                logger.warning("k8s service watch error (%s); retrying in 2s", e)
+                await asyncio.sleep(2)
+
+    async def _on_service_event(self, session: aiohttp.ClientSession,
+                                event: dict) -> None:
+        etype = event.get("type")
+        svc = event.get("object", {})
+        meta = svc.get("metadata", {})
+        name = meta.get("name")
+        if not name:
+            return
+        if etype == "DELETED":
+            if name in self.endpoints:
+                logger.info("engine service %s removed", name)
+                del self.endpoints[name]
+            return
+        ports = svc.get("spec", {}).get("ports") or []
+        port = next((p.get("port") for p in ports if p.get("port")), self.port)
+        url = f"http://{name}.{self.namespace}.svc:{port}"
+        labels = meta.get("labels", {})
+        try:
+            models, model_info = await self._query_models(session, url)
+            sleeping = await self._query_sleep(session, url)
+        except Exception as e:
+            logger.warning("service %s added but /v1/models failed: %s", name, e)
+            return
+        self.known_models.update(models)
+        self.endpoints[name] = EndpointInfo(
+            url=url, model_names=models, model_info=model_info,
+            model_label=labels.get("model"), pod_name=name,
+            namespace=self.namespace, sleep=sleeping,
+        )
+        logger.info("engine service %s added at %s serving %s", name, url, models)
+
+
 _discovery: Optional[ServiceDiscovery] = None
 
 

@@ -1,0 +1,115 @@
+"""K8s discovery against a fake apiserver (the envtest-equivalent tier,
+SURVEY.md §4): the watcher consumes chunked watch events, queries the pod's
+/v1/models, and maintains the endpoint map through ADDED/DELETED."""
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from production_stack_tpu.router.service_discovery import (
+    K8sPodIPServiceDiscovery,
+)
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+
+class FakeApiServer:
+    """Minimal kube-apiserver: one watch stream fed from a queue."""
+
+    def __init__(self):
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/pods", self.watch_pods
+        )
+        return app
+
+    async def watch_pods(self, request: web.Request) -> web.StreamResponse:
+        assert request.query.get("watch") == "true"
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        while True:
+            event = await self.queue.get()
+            if event is None:
+                break
+            await resp.write((json.dumps(event) + "\n").encode())
+        await resp.write_eof()
+        return resp
+
+    def pod_event(self, etype: str, name: str, ip: str, ready: bool = True):
+        return {
+            "type": etype,
+            "object": {
+                "metadata": {"name": name, "labels": {"model": "decode"}},
+                "status": {
+                    "podIP": ip,
+                    "containerStatuses": [{"ready": ready}],
+                },
+            },
+        }
+
+
+def test_pod_watch_lifecycle():
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        engine = FakeEngine(model="fake-model")
+        ets = TestServer(engine.build_app())
+        await ets.start_server()
+
+        api = FakeApiServer()
+        ats = TestServer(api.build_app())
+        await ats.start_server()
+
+        sd = K8sPodIPServiceDiscovery(
+            namespace="default",
+            label_selector="app=engine",
+            port=ets.port,  # pod IP 127.0.0.1 + engine port
+            api_server=f"http://127.0.0.1:{ats.port}",
+            token="fake-token",
+        )
+        await sd.start()
+        try:
+            await api.queue.put(api.pod_event("ADDED", "engine-0", "127.0.0.1"))
+            for _ in range(100):
+                if sd.get_endpoint_info():
+                    break
+                await asyncio.sleep(0.05)
+            eps = sd.get_endpoint_info()
+            assert len(eps) == 1
+            assert eps[0].model_names == ["fake-model"]
+            assert eps[0].model_label == "decode"
+            assert "fake-model" in sd.known_models
+            assert sd.get_health()
+
+            # not-ready pod of the same name → removed
+            await api.queue.put(
+                api.pod_event("MODIFIED", "engine-0", "127.0.0.1", ready=False)
+            )
+            for _ in range(100):
+                if not sd.get_endpoint_info():
+                    break
+                await asyncio.sleep(0.05)
+            assert sd.get_endpoint_info() == []
+
+            # back, then DELETED
+            await api.queue.put(api.pod_event("ADDED", "engine-0", "127.0.0.1"))
+            for _ in range(100):
+                if sd.get_endpoint_info():
+                    break
+                await asyncio.sleep(0.05)
+            await api.queue.put(api.pod_event("DELETED", "engine-0", "127.0.0.1"))
+            for _ in range(100):
+                if not sd.get_endpoint_info():
+                    break
+                await asyncio.sleep(0.05)
+            assert sd.get_endpoint_info() == []
+        finally:
+            await sd.stop()
+            await api.queue.put(None)
+            await ats.close()
+            await ets.close()
+
+    asyncio.run(main())

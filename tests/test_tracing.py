@@ -1,0 +1,49 @@
+"""Tracing: W3C traceparent propagates router→engine even in API-only mode
+(no opentelemetry-sdk installed in this image)."""
+
+import asyncio
+
+from production_stack_tpu.router.app import RouterApp, build_parser
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+
+def test_traceparent_propagates_to_backend():
+    seen = {}
+
+    class RecordingFake(FakeEngine):
+        async def _serve(self, request, chat):
+            seen["traceparent"] = request.headers.get("traceparent")
+            return await super()._serve(request, chat)
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        fe = RecordingFake(model="fake-model", tokens_per_second=5000,
+                           ttft=0.001)
+        ets = TestServer(fe.build_app())
+        await ets.start_server()
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"http://127.0.0.1:{ets.port}",
+            "--static-models", "fake-model",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            # inbound W3C context must be continued to the backend hop
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "x", "max_tokens": 2},
+                headers={"traceparent":
+                         "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"},
+            )
+            assert r.status == 200
+            tp = seen.get("traceparent")
+            assert tp is not None, "traceparent not propagated"
+            assert tp.split("-")[1] == "0af7651916cd43dd8448eb211c80319c"
+        finally:
+            await client.close()
+            await ets.close()
+
+    asyncio.run(main())
