@@ -47,6 +47,9 @@ class LoraAdapter:
     scaling: float
     # our param key -> stacked delta (L, *param_shape[1:]) float32
     deltas: dict[str, np.ndarray]
+    # the delta that actually landed after serving-dtype rounding; unmerge
+    # subtracts this so base weights restore exactly
+    effective: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
 
 def _convert_delta(rule: str, delta: np.ndarray, cfg: ModelConfig) -> np.ndarray:
@@ -128,7 +131,9 @@ class LoraManager:
                 f"adapter {self.merged!r} already merged; unload it first "
                 "(single live adapter per engine in this release)"
             )
-        self.engine.runner.apply_param_deltas(adapter.deltas, sign=1.0)
+        adapter.effective = self.engine.runner.apply_param_deltas(
+            adapter.deltas, sign=1.0
+        )
         self.adapters[name] = adapter
         self.merged = name
 
@@ -137,6 +142,6 @@ class LoraManager:
         if adapter is None:
             return False
         if self.merged == name:
-            self.engine.runner.apply_param_deltas(adapter.deltas, sign=-1.0)
+            self.engine.runner.apply_param_deltas(adapter.effective, sign=-1.0)
             self.merged = None
         return True

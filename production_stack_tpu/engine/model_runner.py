@@ -310,22 +310,30 @@ class ModelRunner:
             )
         return np.asarray(jax.device_get(sampled))
 
-    def apply_param_deltas(self, deltas: dict, sign: float) -> None:
-        """In-place add/subtract stacked layer deltas (LoRA merge/unmerge)."""
+    def apply_param_deltas(self, deltas: dict, sign: float) -> dict:
+        """In-place add/subtract stacked layer deltas (LoRA merge/unmerge).
+
+        Returns the EFFECTIVE applied delta per key (new − old in float32,
+        i.e. after serving-dtype rounding): unmerging must subtract that —
+        subtracting the requested fp32 delta from bf16-rounded weights would
+        drift the base model a little further on every adapter swap."""
         def _apply(layers, **host_deltas):
             out = dict(layers)
+            eff = {}
             for key, d in host_deltas.items():
-                out[key] = (
-                    layers[key].astype(jnp.float32) + sign * d
-                ).astype(layers[key].dtype)
-            return out
+                old = layers[key].astype(jnp.float32)
+                new = (old + sign * d).astype(layers[key].dtype)
+                out[key] = new
+                eff[key] = new.astype(jnp.float32) - old
+            return out, eff
 
         with jax.set_mesh(self.mesh):
-            new_layers = jax.jit(_apply, donate_argnums=(0,))(
+            new_layers, eff = jax.jit(_apply, donate_argnums=(0,))(
                 self.params["layers"],
                 **{k: jnp.asarray(v) for k, v in deltas.items()},
             )
         self.params = dict(self.params, layers=new_layers)
+        return {k: np.asarray(jax.device_get(v)) for k, v in eff.items()}
 
     # -- KV block export/import (disaggregated prefill→decode transfer) -----
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:

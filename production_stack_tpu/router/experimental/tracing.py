@@ -27,10 +27,21 @@ def initialize_tracing(endpoint: Optional[str], service_name: str = "tpu-router"
                        secure: bool = False) -> bool:
     """Returns True when spans will actually be recorded+exported."""
     global _tracer, _propagator, _enabled
-    from opentelemetry import trace
-    from opentelemetry.trace.propagation.tracecontext import (
-        TraceContextTextMapPropagator,
-    )
+    try:
+        from opentelemetry import trace
+        from opentelemetry.trace.propagation.tracecontext import (
+            TraceContextTextMapPropagator,
+        )
+    except ImportError:
+        # opentelemetry-api not in this image: tracing is a no-op (the
+        # router must boot fine without it)
+        if endpoint:
+            logger.warning(
+                "--otel-endpoint set but opentelemetry-api is not installed; "
+                "tracing disabled"
+            )
+        _enabled = False
+        return False
 
     _propagator = TraceContextTextMapPropagator()
     exporting = False

@@ -197,19 +197,32 @@ class RequestService:
         span_cm.__enter__()
         tracing.inject_headers(headers)
         try:
+            return await self._attempt(
+                request, endpoint_path, body, url, model, request_id, t_start,
+                monitor, stream, headers, span_cm,
+            )
+        finally:
+            span_cm.__exit__(None, None, None)
+
+    async def _attempt(self, request, endpoint_path, body, url, model,
+                       request_id, t_start, monitor, stream, headers,
+                       span_cm) -> web.StreamResponse:
+        try:
             backend = await self.session.post(
                 f"{url}{endpoint_path}", json=body, headers=headers
             )
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             monitor.on_request_complete(url, request_id, time.time())
-            span_cm.__exit__(None, None, None)
             raise BackendError("connect", f"{type(e).__name__}: {e}") from e
 
         if backend.status >= 500:
-            text = await backend.text()
-            backend.release()
-            monitor.on_request_complete(url, request_id, time.time())
-            span_cm.__exit__(None, None, None)
+            try:
+                text = await backend.text()
+            except aiohttp.ClientError:
+                text = "<unreadable body>"
+            finally:
+                backend.release()
+                monitor.on_request_complete(url, request_id, time.time())
             raise BackendError("http_5xx", f"HTTP {backend.status}: {text[:200]}")
 
         resp = web.StreamResponse(
@@ -253,7 +266,6 @@ class RequestService:
             backend.release()
             if span_cm.span is not None:
                 span_cm.span.set_attribute("http.status_code", backend.status)
-            span_cm.__exit__(None, None, None)
             if status_label == "200":
                 if self.post_response is not None and not stream:
                     try:

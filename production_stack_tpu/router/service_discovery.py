@@ -302,7 +302,53 @@ class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
     """Watches Services instead of Pods and routes to the service DNS name —
     for clusters where pod IPs aren't directly reachable from the router
     (reference: service_discovery.py:892-1423; 1:1 service-per-pod layout
-    recommended there)."""
+    recommended there).
+
+    Unlike Pods, Services emit no readiness MODIFIED events, so a service
+    whose engine wasn't serving yet (image pull, weight load) is kept on a
+    retry list and re-probed periodically until it answers /v1/models."""
+
+    RETRY_INTERVAL = 10.0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # name -> (url, labels) awaiting a successful /v1/models probe
+        self._pending: dict[str, tuple[str, dict]] = {}
+        self._retry_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        await super().start()
+        self._retry_task = asyncio.create_task(self._retry_loop())
+
+    async def stop(self) -> None:
+        await super().stop()
+        if self._retry_task:
+            self._retry_task.cancel()
+
+    async def _retry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.RETRY_INTERVAL)
+            if not self._pending:
+                continue
+            async with aiohttp.ClientSession() as s:
+                for name, (url, labels) in list(self._pending.items()):
+                    if await self._try_register(s, name, url, labels):
+                        self._pending.pop(name, None)
+
+    async def _try_register(self, session, name, url, labels) -> bool:
+        try:
+            models, model_info = await self._query_models(session, url)
+            sleeping = await self._query_sleep(session, url)
+        except Exception:
+            return False
+        self.known_models.update(models)
+        self.endpoints[name] = EndpointInfo(
+            url=url, model_names=models, model_info=model_info,
+            model_label=labels.get("model"), pod_name=name,
+            namespace=self.namespace, sleep=sleeping,
+        )
+        logger.info("engine service %s added at %s serving %s", name, url, models)
+        return True
 
     async def _watch_loop(self) -> None:
         url = f"{self.api_server}/api/v1/namespaces/{self.namespace}/services"
@@ -337,6 +383,7 @@ class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
         if not name:
             return
         if etype == "DELETED":
+            self._pending.pop(name, None)
             if name in self.endpoints:
                 logger.info("engine service %s removed", name)
                 del self.endpoints[name]
@@ -345,19 +392,12 @@ class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
         port = next((p.get("port") for p in ports if p.get("port")), self.port)
         url = f"http://{name}.{self.namespace}.svc:{port}"
         labels = meta.get("labels", {})
-        try:
-            models, model_info = await self._query_models(session, url)
-            sleeping = await self._query_sleep(session, url)
-        except Exception as e:
-            logger.warning("service %s added but /v1/models failed: %s", name, e)
-            return
-        self.known_models.update(models)
-        self.endpoints[name] = EndpointInfo(
-            url=url, model_names=models, model_info=model_info,
-            model_label=labels.get("model"), pod_name=name,
-            namespace=self.namespace, sleep=sleeping,
-        )
-        logger.info("engine service %s added at %s serving %s", name, url, models)
+        if not await self._try_register(session, name, url, labels):
+            logger.warning(
+                "service %s added but engine not answering yet; will retry",
+                name,
+            )
+            self._pending[name] = (url, labels)
 
 
 _discovery: Optional[ServiceDiscovery] = None
