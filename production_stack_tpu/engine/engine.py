@@ -342,6 +342,45 @@ class LLMEngine:
             out["cpu_prefix_cache_queries_total"] = self.host_kv.queries
         return out
 
+    def warmup(self) -> None:
+        """Pre-compile every serving shape variant so no live request pays a
+        compile: each prefill bucket at P=1, the P=prefill_batch variant,
+        the greedy and general samplers, and the decode program."""
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        sched = self.config.scheduler
+        vocab = self.config.model.vocab_size
+        buckets = [
+            b for b in sched.prefill_buckets
+            if b <= self.config.model.max_model_len
+        ]
+
+        def run(prompts, temperature):
+            sp = SamplingParams(
+                temperature=temperature,
+                max_tokens=max(sched.multi_step, 1) + 1,  # forces one decode
+                ignore_eos=True,
+            )
+            for i, p in enumerate(prompts):
+                self.add_request(f"warmup-{time.monotonic_ns()}-{i}",
+                                 prompt_token_ids=p, sampling=sp)
+            while self.has_unfinished():
+                self.step()
+
+        for b in buckets:
+            n = max(min(b, sched.max_num_batched_tokens,
+                        self.config.model.max_model_len - sched.multi_step - 2),
+                    1)
+            if self._bucket(n) != b:
+                continue  # budget caps chunks below this bucket: never used
+            run([rng.integers(1, vocab, n).tolist()], 0.0)
+        # P=prefill_batch variant + the general (non-greedy) sampler
+        small = min(buckets[0], 64)
+        batch = [rng.integers(1, vocab, small).tolist()
+                 for _ in range(max(sched.prefill_batch, 2))]
+        run(batch, 0.7)
+
     # -- convenience for tests / offline use ---------------------------------
     def generate(
         self,

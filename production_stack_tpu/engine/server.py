@@ -51,8 +51,10 @@ def _sampling_from_body(body: dict) -> SamplingParams:
 
 
 class EngineServer:
-    def __init__(self, config: EngineConfig, engine: Optional[LLMEngine] = None):
+    def __init__(self, config: EngineConfig, engine: Optional[LLMEngine] = None,
+                 warmup_on_start: bool = False):
         self.config = config
+        self.warmup_on_start = warmup_on_start
         self.model_name = config.model.name
         self.engine = engine or LLMEngine(config)
         self.async_engine = AsyncEngine(self.engine)
@@ -87,6 +89,11 @@ class EngineServer:
     async def _on_start(self, app) -> None:
         self.metrics.ensure_registered()
         await self.async_engine.start()
+        if self.warmup_on_start:
+            t0 = time.monotonic()
+            await self.async_engine.run_on_engine(lambda eng: eng.warmup())
+            print(f"engine warmup (all shape variants) done in "
+                  f"{time.monotonic() - t0:.1f}s", flush=True)
 
     async def _on_stop(self, app) -> None:
         self.async_engine.stop()
@@ -517,6 +524,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-num-batched-tokens", type=int, default=None)
     p.add_argument("--prefill-buckets", default=None,
                    help="comma-separated token buckets, e.g. 128,512,2048")
+    p.add_argument("--skip-warmup", action="store_true",
+                   help="skip startup compilation of all shape variants")
     return p
 
 
@@ -559,7 +568,7 @@ def config_from_args(args) -> EngineConfig:
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
-    server = EngineServer(config)
+    server = EngineServer(config, warmup_on_start=not args.skip_warmup)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
 
