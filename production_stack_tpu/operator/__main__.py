@@ -1,0 +1,3 @@
+from production_stack_tpu.operator.controller import main
+
+main()

@@ -1,0 +1,485 @@
+"""The TPU serving operator: reconcilers for the four CRDs.
+
+Functional parity with the reference's kubebuilder operator
+(operator/internal/controller/*.go there — VLLMRuntime/VLLMRouter/
+CacheServer/LoraAdapter reconcilers): CR → child Deployments/Services/PVCs
+with drift detection and status updates, plus LoRA placement that calls the
+engines' /v1/load_lora_adapter endpoints. Implementation is asyncio Python
+over the raw K8s API (this image has no Go toolchain; the controller logic
+is transport-thin and maps 1:1 onto a compiled rewrite).
+
+Engine pods get ``serving.tpu.io/model: <runtime name>`` labels so the
+LoraAdapter reconciler and the router's discovery can select them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import aiohttp
+
+from production_stack_tpu.operator.k8s_client import K8sClient
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+GROUP = "serving.tpu.io"
+VERSION = "v1alpha1"
+
+DEFAULT_ENGINE_IMAGE = "ghcr.io/example/tpu-serving-engine:0.1.0"
+DEFAULT_ROUTER_IMAGE = "ghcr.io/example/tpu-serving-router:0.1.0"
+
+
+def _crd_path(ns: str, plural: str, name: str = "") -> str:
+    base = f"/apis/{GROUP}/{VERSION}/namespaces/{ns}/{plural}"
+    return f"{base}/{name}" if name else base
+
+
+def _owner_ref(cr: dict) -> dict:
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": cr["kind"],
+        "name": cr["metadata"]["name"],
+        "uid": cr["metadata"].get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# manifest builders
+# ---------------------------------------------------------------------------
+
+def build_engine_deployment(cr: dict, image: str) -> dict:
+    spec = cr.get("spec", {})
+    name = cr["metadata"]["name"]
+    ns = cr["metadata"]["namespace"]
+    tpu = spec.get("tpu", {})
+    ec = spec.get("engineConfig", {})
+    args = ["--model", spec["model"], "--port", "8000"]
+    if spec.get("servedModelName"):
+        args += ["--served-model-name", spec["servedModelName"]]
+    for flag, key in (
+        ("--max-model-len", "maxModelLen"), ("--max-num-seqs", "maxNumSeqs"),
+        ("--dtype", "dtype"), ("--tensor-parallel-size", "tensorParallelSize"),
+        ("--block-size", "blockSize"), ("--num-scheduler-steps", "multiStep"),
+    ):
+        if ec.get(key) is not None:
+            args += [flag, str(ec[key])]
+    args += list(ec.get("extraArgs") or [])
+
+    labels = {
+        "app.kubernetes.io/component": "serving-engine",
+        f"{GROUP}/model": name,
+        "environment": "serving",
+    }
+    if spec.get("modelLabel"):
+        labels["model"] = spec["modelLabel"]
+    container = {
+        "name": "engine",
+        "image": spec.get("image") or image,
+        "command": ["python", "-m", "production_stack_tpu.engine.server"],
+        "args": args,
+        "ports": [{"name": "http", "containerPort": 8000}],
+        "resources": {
+            "requests": {"google.com/tpu": str(tpu.get("chips", 8))},
+            "limits": {"google.com/tpu": str(tpu.get("chips", 8))},
+        },
+        "startupProbe": {
+            "httpGet": {"path": "/health", "port": 8000},
+            "periodSeconds": 10, "failureThreshold": 120,
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": 8000}, "periodSeconds": 5,
+        },
+    }
+    pod_spec = {
+        "nodeSelector": {
+            "cloud.google.com/gke-tpu-accelerator": tpu.get(
+                "accelerator", "tpu-v5-lite-podslice"),
+            "cloud.google.com/gke-tpu-topology": tpu.get("topology", "2x4"),
+        },
+        "tolerations": [
+            {"key": "google.com/tpu", "operator": "Exists",
+             "effect": "NoSchedule"}
+        ],
+        "containers": [container],
+    }
+    if spec.get("pvcStorage"):
+        container["volumeMounts"] = [{"name": "models", "mountPath": "/models"}]
+        pod_spec["volumes"] = [{
+            "name": "models",
+            "persistentVolumeClaim": {"claimName": f"{name}-models"},
+        }]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{name}-engine", "namespace": ns, "labels": labels,
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": {f"{GROUP}/model": name}},
+            "template": {"metadata": {"labels": labels}, "spec": pod_spec},
+        },
+    }
+
+
+def build_engine_service(cr: dict) -> dict:
+    name = cr["metadata"]["name"]
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{name}-engine", "namespace": cr["metadata"]["namespace"],
+            "labels": {f"{GROUP}/model": name},
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {f"{GROUP}/model": name},
+            "ports": [{"name": "http", "port": 8000}],
+        },
+    }
+
+
+def build_pvc(cr: dict) -> dict:
+    name = cr["metadata"]["name"]
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {
+            "name": f"{name}-models", "namespace": cr["metadata"]["namespace"],
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": cr["spec"]["pvcStorage"]}},
+        },
+    }
+
+
+def build_router_deployment(cr: dict, image: str) -> dict:
+    spec = cr.get("spec", {})
+    name = cr["metadata"]["name"]
+    ns = cr["metadata"]["namespace"]
+    args = [
+        "--port", "8001",
+        "--service-discovery", "k8s_pod_ip",
+        "--k8s-namespace", ns,
+        "--k8s-label-selector",
+        spec.get("k8sLabelSelector", "app.kubernetes.io/component=serving-engine"),
+        "--k8s-port", str(spec.get("enginePort", 8000)),
+        "--routing-logic", spec.get("routingLogic", "roundrobin"),
+        "--max-instance-failover-reroute-attempts",
+        str(spec.get("maxFailoverAttempts", 2)),
+    ]
+    if spec.get("sessionKey"):
+        args += ["--session-key", spec["sessionKey"]]
+    args += list(spec.get("extraArgs") or [])
+    labels = {"app.kubernetes.io/component": "router", f"{GROUP}/router": name}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{name}-router", "namespace": ns, "labels": labels,
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": {f"{GROUP}/router": name}},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "serviceAccountName": f"{name}-router",
+                    "containers": [{
+                        "name": "router",
+                        "image": spec.get("image") or image,
+                        "command": ["python", "-m",
+                                    "production_stack_tpu.router.app"],
+                        "args": args,
+                        "ports": [{"name": "http", "containerPort": 8001}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/health", "port": 8001},
+                        },
+                    }],
+                },
+            },
+        },
+    }
+
+
+def build_cache_server_deployment(cr: dict, image: str) -> dict:
+    spec = cr.get("spec", {})
+    name = cr["metadata"]["name"]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{name}-cacheserver",
+            "namespace": cr["metadata"]["namespace"],
+            "labels": {f"{GROUP}/cacheserver": name},
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": {f"{GROUP}/cacheserver": name}},
+            "template": {
+                "metadata": {"labels": {f"{GROUP}/cacheserver": name}},
+                "spec": {"containers": [{
+                    "name": "cacheserver",
+                    "image": spec.get("image") or image,
+                    "command": ["python", "-m",
+                                "production_stack_tpu.kv_server"],
+                    "args": ["--port", str(spec.get("port", 8100)),
+                             "--capacity-blocks",
+                             str(spec.get("capacityBlocks", 65536))],
+                    "ports": [{"containerPort": spec.get("port", 8100)}],
+                }]},
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# the operator
+# ---------------------------------------------------------------------------
+
+def _deploy_drifted(live: dict, desired: dict) -> bool:
+    ls, ds = live.get("spec", {}), desired.get("spec", {})
+    lc = ls.get("template", {}).get("spec", {}).get("containers", [{}])[0]
+    dc = ds.get("template", {}).get("spec", {}).get("containers", [{}])[0]
+    return (
+        ls.get("replicas") != ds.get("replicas")
+        or lc.get("image") != dc.get("image")
+        or lc.get("args") != dc.get("args")
+    )
+
+
+class Operator:
+    def __init__(self, client: K8sClient, namespace: str = "default",
+                 engine_image: str = DEFAULT_ENGINE_IMAGE,
+                 router_image: str = DEFAULT_ROUTER_IMAGE,
+                 engine_port: int = 8000):
+        self.client = client
+        self.ns = namespace
+        self.engine_image = engine_image
+        self.router_image = router_image
+        self.engine_port = engine_port
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        for plural, handler in (
+            ("tpuruntimes", self.reconcile_runtime),
+            ("tpurouters", self.reconcile_router),
+            ("tpucacheservers", self.reconcile_cacheserver),
+            ("loraadapters", self.reconcile_lora),
+        ):
+            self._tasks.append(
+                asyncio.create_task(self._watch_kind(plural, handler))
+            )
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await self.client.close()
+
+    async def _watch_kind(self, plural: str, handler) -> None:
+        while True:
+            try:
+                async for event in self.client.watch(_crd_path(self.ns, plural)):
+                    try:
+                        await handler(event.get("type"), event.get("object", {}))
+                    except Exception as e:
+                        logger.error("reconcile %s failed: %s", plural, e)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning("watch %s error: %s; retrying", plural, e)
+                await asyncio.sleep(2)
+
+    # -- generic child management -------------------------------------------
+    async def _ensure(self, path_base: str, desired: dict) -> None:
+        name = desired["metadata"]["name"]
+        live = await self.client.get(f"{path_base}/{name}")
+        if live is None:
+            await self.client.create(path_base, desired)
+            logger.info("created %s %s", desired["kind"], name)
+        elif desired["kind"] == "Deployment" and _deploy_drifted(live, desired):
+            desired["metadata"]["resourceVersion"] = live["metadata"].get(
+                "resourceVersion", "")
+            await self.client.replace(f"{path_base}/{name}", desired)
+            logger.info("updated %s %s (drift)", desired["kind"], name)
+
+    async def _set_status(self, plural: str, name: str, status: dict) -> None:
+        path = _crd_path(self.ns, plural, name)
+        cr = await self.client.get(path)
+        if cr is None:
+            return
+        cr["status"] = status
+        try:
+            await self.client.replace(f"{path}/status", cr)
+        except Exception:
+            await self.client.replace(path, cr)
+
+    # -- reconcilers ---------------------------------------------------------
+    async def reconcile_runtime(self, etype: str, cr: dict) -> None:
+        if etype == "DELETED":
+            return  # children carry ownerReferences: cluster GC removes them
+        name = cr["metadata"]["name"]
+        deploys = f"/apis/apps/v1/namespaces/{self.ns}/deployments"
+        services = f"/api/v1/namespaces/{self.ns}/services"
+        pvcs = f"/api/v1/namespaces/{self.ns}/persistentvolumeclaims"
+        await self._ensure(deploys, build_engine_deployment(cr, self.engine_image))
+        await self._ensure(services, build_engine_service(cr))
+        if cr["spec"].get("pvcStorage"):
+            await self._ensure(pvcs, build_pvc(cr))
+        live = await self.client.get(f"{deploys}/{name}-engine")
+        await self._set_status(
+            "tpuruntimes", name,
+            {
+                "replicas": cr["spec"].get("replicas", 1),
+                "availableReplicas": (live or {}).get("status", {}).get(
+                    "availableReplicas", 0),
+                "selector": f"{GROUP}/model={name}",
+                "state": "Reconciled",
+            },
+        )
+
+    async def reconcile_router(self, etype: str, cr: dict) -> None:
+        if etype == "DELETED":
+            return
+        name = cr["metadata"]["name"]
+        deploys = f"/apis/apps/v1/namespaces/{self.ns}/deployments"
+        services = f"/api/v1/namespaces/{self.ns}/services"
+        await self._ensure(deploys, build_router_deployment(cr, self.router_image))
+        await self._ensure(services, {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": f"{name}-router", "namespace": self.ns,
+                         "ownerReferences": [_owner_ref(cr)]},
+            "spec": {"selector": {f"{GROUP}/router": name},
+                     "ports": [{"name": "http", "port": 80,
+                                "targetPort": 8001}]},
+        })
+        await self._set_status("tpurouters", name, {"state": "Reconciled"})
+
+    async def reconcile_cacheserver(self, etype: str, cr: dict) -> None:
+        if etype == "DELETED":
+            return
+        name = cr["metadata"]["name"]
+        deploys = f"/apis/apps/v1/namespaces/{self.ns}/deployments"
+        services = f"/api/v1/namespaces/{self.ns}/services"
+        await self._ensure(
+            deploys, build_cache_server_deployment(cr, self.engine_image)
+        )
+        await self._ensure(services, {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": f"{name}-cacheserver", "namespace": self.ns,
+                         "ownerReferences": [_owner_ref(cr)]},
+            "spec": {"selector": {f"{GROUP}/cacheserver": name},
+                     "ports": [{"port": cr["spec"].get("port", 8100)}]},
+        })
+        await self._set_status("tpucacheservers", name, {"state": "Reconciled"})
+
+    # -- LoRA ----------------------------------------------------------------
+    async def _engine_pods(self, base_model: str) -> list[dict]:
+        pods = await self.client.list(
+            f"/api/v1/namespaces/{self.ns}/pods",
+            label_selector=f"{GROUP}/model={base_model}",
+        )
+        out = []
+        for pod in pods.get("items", []):
+            ip = pod.get("status", {}).get("podIP")
+            statuses = pod.get("status", {}).get("containerStatuses") or []
+            if ip and statuses and all(c.get("ready") for c in statuses):
+                out.append(pod)
+        return out
+
+    def _place(self, pods: list[dict], algorithm: str, replicas: Optional[int],
+               loaded_counts: dict[str, int]) -> list[dict]:
+        """Placement parity with the reference's getOptimalPlacement
+        (loraadapter_controller.go:360): default = every pod; ordered =
+        first N by name; equalized = N pods with the fewest adapters."""
+        pods = sorted(pods, key=lambda p: p["metadata"]["name"])
+        n = replicas if replicas else len(pods)
+        if algorithm == "ordered":
+            return pods[:n]
+        if algorithm == "equalized":
+            return sorted(
+                pods, key=lambda p: (loaded_counts.get(
+                    p["metadata"]["name"], 0), p["metadata"]["name"])
+            )[:n]
+        return pods if not replicas else pods[:n]
+
+    async def reconcile_lora(self, etype: str, cr: dict) -> None:
+        spec = cr.get("spec", {})
+        name = cr["metadata"]["name"]
+        adapter_name = spec.get("adapterName") or name
+        base = spec.get("baseModel", "")
+        path = spec.get("source", {}).get("path", "")
+        prev = (cr.get("status") or {}).get("loadedPods", [])
+
+        if etype == "DELETED":
+            # unload wherever the status says it was loaded
+            for pod_name, ip in prev:
+                await self._lora_call(ip, "unload", adapter_name)
+            return
+
+        pods = await self._engine_pods(base)
+        placement = spec.get("placement", {})
+        counts: dict[str, int] = {}
+        for p, _ in prev:
+            counts[p] = counts.get(p, 0) + 1
+        chosen = self._place(pods, placement.get("algorithm", "default"),
+                             placement.get("replicas"), counts)
+        loaded = []
+        for pod in chosen:
+            ip = pod["status"]["podIP"]
+            if await self._lora_call(ip, "load", adapter_name, path):
+                loaded.append([pod["metadata"]["name"], ip])
+        await self._set_status(
+            "loraadapters", name,
+            {"loadedPods": loaded, "state": "Loaded" if loaded else "Pending"},
+        )
+
+    async def _lora_call(self, pod_ip: str, action: str, adapter: str,
+                         path: str = "") -> bool:
+        url = f"http://{pod_ip}:{self.engine_port}/v1/{action}_lora_adapter"
+        body = {"lora_name": adapter}
+        if action == "load":
+            body["lora_path"] = path
+        try:
+            s = await self.client.session()
+            async with s.post(url, json=body,
+                              timeout=aiohttp.ClientTimeout(total=60)) as r:
+                return r.status == 200
+        except Exception as e:
+            logger.warning("lora %s on %s failed: %s", action, pod_ip, e)
+            return False
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-serving-operator")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--api-server", default=None)
+    p.add_argument("--engine-image", default=DEFAULT_ENGINE_IMAGE)
+    p.add_argument("--router-image", default=DEFAULT_ROUTER_IMAGE)
+    args = p.parse_args(argv)
+
+    async def run():
+        op = Operator(
+            K8sClient(api_server=args.api_server), namespace=args.namespace,
+            engine_image=args.engine_image, router_image=args.router_image,
+        )
+        await op.start()
+        await asyncio.gather(*op._tasks)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Minimal async Kubernetes API client (raw HTTP, no kubernetes package —
+same zero-dependency approach as the router's service discovery)."""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+from typing import AsyncIterator, Optional
+
+import aiohttp
+
+
+class K8sClient:
+    def __init__(self, api_server: Optional[str] = None,
+                 token: Optional[str] = None, ca_cert: Optional[str] = None,
+                 insecure_tls: bool = False):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        scheme = "https" if port in ("443", "6443") else "http"
+        self.api_server = api_server or (host and f"{scheme}://{host}:{port}")
+        if not self.api_server:
+            raise RuntimeError("no Kubernetes API server configured")
+        token_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+        self.token = token or (
+            open(token_path).read().strip() if os.path.exists(token_path) else None
+        )
+        ca_path = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+        self.ca_cert = ca_cert or (ca_path if os.path.exists(ca_path) else None)
+        self.insecure_tls = insecure_tls
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def _ssl(self):
+        if not self.api_server.startswith("https"):
+            return None
+        if self.insecure_tls:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        if self.ca_cert:
+            return ssl.create_default_context(cafile=self.ca_cert)
+        return None
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            headers = {"Authorization": f"Bearer {self.token}"} if self.token else {}
+            self._session = aiohttp.ClientSession(headers=headers)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    # -- REST verbs ----------------------------------------------------------
+    async def get(self, path: str) -> Optional[dict]:
+        s = await self.session()
+        async with s.get(f"{self.api_server}{path}", ssl=self._ssl()) as r:
+            if r.status == 404:
+                return None
+            r.raise_for_status()
+            return await r.json()
+
+    async def list(self, path: str, label_selector: str = "") -> dict:
+        s = await self.session()
+        params = {"labelSelector": label_selector} if label_selector else {}
+        async with s.get(f"{self.api_server}{path}", params=params,
+                         ssl=self._ssl()) as r:
+            r.raise_for_status()
+            return await r.json()
+
+    async def create(self, path: str, body: dict) -> dict:
+        s = await self.session()
+        async with s.post(f"{self.api_server}{path}", json=body,
+                          ssl=self._ssl()) as r:
+            r.raise_for_status()
+            return await r.json()
+
+    async def replace(self, path: str, body: dict) -> dict:
+        s = await self.session()
+        async with s.put(f"{self.api_server}{path}", json=body,
+                         ssl=self._ssl()) as r:
+            r.raise_for_status()
+            return await r.json()
+
+    async def delete(self, path: str) -> None:
+        s = await self.session()
+        async with s.delete(f"{self.api_server}{path}", ssl=self._ssl()) as r:
+            if r.status not in (200, 202, 404):
+                r.raise_for_status()
+
+    async def watch(self, path: str, label_selector: str = "") -> AsyncIterator[dict]:
+        s = await self.session()
+        params = {"watch": "true"}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        async with s.get(
+            f"{self.api_server}{path}", params=params, ssl=self._ssl(),
+            timeout=aiohttp.ClientTimeout(total=None, sock_read=None),
+        ) as resp:
+            resp.raise_for_status()
+            async for line in resp.content:
+                if line.strip():
+                    yield json.loads(line)

@@ -1,0 +1,145 @@
+"""Stateful fake Kubernetes apiserver — the envtest equivalent (the
+reference tests its operator against envtest's fake apiserver,
+operator/internal/controller/suite_test.go): an in-memory object store with
+create/get/list/replace/delete, label-selector filtering, status
+subresources and watch streams, served over aiohttp so the real controller
+and discovery code run against it unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Optional
+
+from aiohttp import web
+
+_PATH = re.compile(
+    r"^(?:/api/v1|/apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|scale))?$"
+)
+
+
+def _matches(labels: dict, selector: str) -> bool:
+    if not selector:
+        return True
+    for term in selector.split(","):
+        if "=" in term:
+            k, _, v = term.partition("=")
+            if labels.get(k.strip()) != v.strip():
+                return False
+    return True
+
+
+class FakeApiServer:
+    def __init__(self):
+        # (api_base, ns, plural) -> {name: object}
+        self.store: dict[tuple, dict[str, dict]] = {}
+        self.watchers: dict[tuple, list[asyncio.Queue]] = {}
+        self._rv = 0
+
+    # -- helpers -------------------------------------------------------------
+    def _bucket(self, match) -> tuple:
+        group = match.group("group") or "core"
+        return (group, match.group("ns"), match.group("plural"))
+
+    def _notify(self, bucket: tuple, etype: str, obj: dict) -> None:
+        for q in self.watchers.get(bucket, []):
+            q.put_nowait({"type": etype, "object": obj})
+
+    def seed(self, api_base: str, ns: str, plural: str, obj: dict) -> None:
+        """Directly place an object (e.g. Pods) without going through HTTP."""
+        group = "core" if api_base == "/api/v1" else api_base.split("/")[2]
+        bucket = (group, ns, plural)
+        self.store.setdefault(bucket, {})[obj["metadata"]["name"]] = obj
+        self._notify(bucket, "ADDED", obj)
+
+    # -- app ------------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.dispatch)
+        return app
+
+    async def dispatch(self, request: web.Request) -> web.StreamResponse:
+        m = _PATH.match(request.path)
+        if not m:
+            return web.json_response({"error": f"bad path {request.path}"},
+                                     status=404)
+        bucket = self._bucket(m)
+        name, sub = m.group("name"), m.group("sub")
+        objs = self.store.setdefault(bucket, {})
+
+        if request.method == "GET" and name is None:
+            if request.query.get("watch") == "true":
+                return await self._watch(request, bucket)
+            sel = request.query.get("labelSelector", "")
+            items = [o for o in objs.values()
+                     if _matches(o.get("metadata", {}).get("labels", {}), sel)]
+            return web.json_response({"kind": "List", "items": items})
+
+        if request.method == "GET":
+            obj = objs.get(name)
+            if obj is None:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response(obj)
+
+        if request.method == "POST":
+            body = await request.json()
+            n = body["metadata"]["name"]
+            if n in objs:
+                return web.json_response({"error": "exists"}, status=409)
+            self._rv += 1
+            body["metadata"].setdefault("namespace", m.group("ns"))
+            body["metadata"]["resourceVersion"] = str(self._rv)
+            body["metadata"].setdefault("uid", f"uid-{self._rv}")
+            objs[n] = body
+            self._notify(bucket, "ADDED", body)
+            return web.json_response(body)
+
+        if request.method == "PUT":
+            body = await request.json()
+            if name not in objs and sub is None:
+                return web.json_response({"error": "not found"}, status=404)
+            self._rv += 1
+            if sub == "status":
+                objs[name]["status"] = body.get("status", {})
+                objs[name]["metadata"]["resourceVersion"] = str(self._rv)
+                self._notify(bucket, "MODIFIED", objs[name])
+                return web.json_response(objs[name])
+            body["metadata"]["resourceVersion"] = str(self._rv)
+            objs[name] = body
+            self._notify(bucket, "MODIFIED", body)
+            return web.json_response(body)
+
+        if request.method == "DELETE":
+            obj = objs.pop(name, None)
+            if obj is not None:
+                self._notify(bucket, "DELETED", obj)
+            return web.json_response({"status": "Success"})
+
+        return web.json_response({"error": "method"}, status=405)
+
+    async def _watch(self, request: web.Request, bucket: tuple):
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        # replay existing objects, honoring the label selector
+        sel = request.query.get("labelSelector", "")
+        for obj in self.store.get(bucket, {}).values():
+            if _matches(obj.get("metadata", {}).get("labels", {}), sel):
+                q.put_nowait({"type": "ADDED", "object": obj})
+        self.watchers.setdefault(bucket, []).append(q)
+        try:
+            while True:
+                event = await q.get()
+                labels = event["object"].get("metadata", {}).get("labels", {})
+                if not _matches(labels, sel):
+                    continue
+                await resp.write((json.dumps(event) + "\n").encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self.watchers.get(bucket, []).remove(q)
+        return resp

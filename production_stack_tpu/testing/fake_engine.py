@@ -32,6 +32,8 @@ class FakeEngine:
         self.running = 0
         self.total_requests = 0
         self.sleeping = False
+        self.lora_loaded: list[str] = []
+        self.lora_unloaded: list[str] = []
         self.start = time.time()
 
     def build_app(self) -> web.Application:
@@ -46,7 +48,19 @@ class FakeEngine:
         app.router.add_post("/wake_up", self.wake)
         app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/tokenize", self.tokenize)
+        app.router.add_post("/v1/load_lora_adapter", self.load_lora)
+        app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         return app
+
+    async def load_lora(self, request):
+        body = await request.json()
+        self.lora_loaded.append(body.get("lora_name"))
+        return web.json_response({"status": "loaded"})
+
+    async def unload_lora(self, request):
+        body = await request.json()
+        self.lora_unloaded.append(body.get("lora_name"))
+        return web.json_response({"status": "unloaded"})
 
     async def models(self, request):
         return web.json_response(

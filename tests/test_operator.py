@@ -1,0 +1,177 @@
+"""Operator reconciliation against the stateful fake apiserver (the
+reference's envtest tier): CR → children with TPU resources, drift repair,
+scale, and LoRA placement calling real (fake) engine endpoints."""
+
+import asyncio
+
+from production_stack_tpu.operator.controller import GROUP, Operator
+from production_stack_tpu.operator.k8s_client import K8sClient
+from production_stack_tpu.testing.fake_apiserver import FakeApiServer
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+NS = "default"
+DEPLOYS = f"/apis/apps/v1/namespaces/{NS}/deployments"
+CRS = f"/apis/{GROUP}/v1alpha1/namespaces/{NS}"
+
+
+def runtime_cr(name="rt1", replicas=2):
+    return {
+        "apiVersion": f"{GROUP}/v1alpha1",
+        "kind": "TPURuntime",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "model": "llama-3-8b",
+            "servedModelName": "llama-3-8b",
+            "replicas": replicas,
+            "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x4",
+                    "chips": 8},
+            "engineConfig": {"maxModelLen": 8192, "tensorParallelSize": 8},
+            "pvcStorage": "100Gi",
+        },
+    }
+
+
+async def wait_for(fn, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        r = await fn()
+        if r:
+            return r
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition never met")
+
+
+async def start_env():
+    from aiohttp.test_utils import TestServer
+
+    api = FakeApiServer()
+    ats = TestServer(api.build_app())
+    await ats.start_server()
+    client = K8sClient(api_server=f"http://127.0.0.1:{ats.port}",
+                       token="fake")
+    op = Operator(client, namespace=NS)
+    await op.start()
+    await asyncio.sleep(0.1)  # watchers attach
+    return api, ats, client, op
+
+
+def test_tpuruntime_reconcile_and_drift():
+    async def main():
+        api, ats, client, op = await start_env()
+        try:
+            await client.create(f"{CRS}/tpuruntimes", runtime_cr())
+            deploy = await wait_for(
+                lambda: client.get(f"{DEPLOYS}/rt1-engine")
+            )
+            container = deploy["spec"]["template"]["spec"]["containers"][0]
+            assert container["resources"]["requests"]["google.com/tpu"] == "8"
+            sel = deploy["spec"]["template"]["spec"]["nodeSelector"]
+            assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+            assert "--tensor-parallel-size" in container["args"]
+            assert deploy["spec"]["replicas"] == 2
+
+            svc = await client.get(f"/api/v1/namespaces/{NS}/services/rt1-engine")
+            assert svc["spec"]["clusterIP"] == "None"
+            pvc = await client.get(
+                f"/api/v1/namespaces/{NS}/persistentvolumeclaims/rt1-models"
+            )
+            assert pvc["spec"]["resources"]["requests"]["storage"] == "100Gi"
+
+            # status set by the reconciler
+            async def has_status():
+                c = await client.get(f"{CRS}/tpuruntimes/rt1")
+                return c.get("status", {}).get("state") == "Reconciled"
+            await wait_for(has_status)
+
+            # scale the CR → drift repair updates the Deployment
+            cr = await client.get(f"{CRS}/tpuruntimes/rt1")
+            cr["spec"]["replicas"] = 5
+            await client.replace(f"{CRS}/tpuruntimes/rt1", cr)
+
+            async def scaled():
+                d = await client.get(f"{DEPLOYS}/rt1-engine")
+                return d["spec"]["replicas"] == 5
+            await wait_for(scaled)
+        finally:
+            await op.stop()
+            await ats.close()
+
+    asyncio.run(main())
+
+
+def test_tpurouter_reconcile():
+    async def main():
+        api, ats, client, op = await start_env()
+        try:
+            await client.create(f"{CRS}/tpurouters", {
+                "apiVersion": f"{GROUP}/v1alpha1", "kind": "TPURouter",
+                "metadata": {"name": "router1", "namespace": NS},
+                "spec": {"replicas": 1, "routingLogic": "prefixaware",
+                         "sessionKey": "x-user-id"},
+            })
+            deploy = await wait_for(
+                lambda: client.get(f"{DEPLOYS}/router1-router")
+            )
+            args = deploy["spec"]["template"]["spec"]["containers"][0]["args"]
+            assert "prefixaware" in args
+            assert "k8s_pod_ip" in args
+        finally:
+            await op.stop()
+            await ats.close()
+
+    asyncio.run(main())
+
+
+def test_loraadapter_placement_and_unload():
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        api, ats, client, op = await start_env()
+        engines = []
+        try:
+            # two ready engine pods backed by real fake-engine servers
+            for i in range(2):
+                fe = FakeEngine(model="llama-3-8b")
+                ets = TestServer(fe.build_app())
+                await ets.start_server()
+                engines.append((fe, ets))
+                api.seed("/api/v1", NS, "pods", {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"rt1-engine-{i}", "namespace": NS,
+                                 "labels": {f"{GROUP}/model": "rt1"}},
+                    "status": {"podIP": "127.0.0.1",
+                               "containerStatuses": [{"ready": True}]},
+                })
+            op.engine_port = engines[0][1].port  # both on 127.0.0.1; same port
+            # point both pods at distinct ports via per-pod override not
+            # supported — use engine 0's port and assert both load calls hit it
+            cr = {
+                "apiVersion": f"{GROUP}/v1alpha1", "kind": "LoraAdapter",
+                "metadata": {"name": "my-adapter", "namespace": NS},
+                "spec": {"baseModel": "rt1", "adapterName": "my-adapter",
+                         "source": {"type": "local", "path": "/adapters/a"},
+                         "placement": {"algorithm": "default"}},
+            }
+            await client.create(f"{CRS}/loraadapters", cr)
+
+            async def loaded():
+                return len(engines[0][0].lora_loaded) >= 2
+            await wait_for(loaded)
+
+            async def status_loaded():
+                c = await client.get(f"{CRS}/loraadapters/my-adapter")
+                return c.get("status", {}).get("state") == "Loaded"
+            await wait_for(status_loaded)
+
+            # deletion unloads from every pod in status
+            await client.delete(f"{CRS}/loraadapters/my-adapter")
+
+            async def unloaded():
+                return len(engines[0][0].lora_unloaded) >= 2
+            await wait_for(unloaded)
+        finally:
+            await op.stop()
+            await ats.close()
+            for _, ets in engines:
+                await ets.close()
+
+    asyncio.run(main())
