@@ -153,6 +153,9 @@ class CacheConfig:
     enable_prefix_caching: bool = True
     # host-DRAM offload tier (LMCache CPU-offload equivalent)
     host_offload_blocks: int = 0
+    # shared remote tier (production_stack_tpu/kv_server URL; LMCache remote
+    # cache-server equivalent)
+    remote_kv_url: Optional[str] = None
 
 
 @dataclasses.dataclass

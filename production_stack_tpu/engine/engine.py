@@ -45,10 +45,14 @@ class LLMEngine:
         self.scheduler = Scheduler(
             config.scheduler, config.cache, self.runner.num_blocks
         )
-        from production_stack_tpu.engine.kv_offload import maybe_make_store
+        from production_stack_tpu.engine.kv_offload import (
+            maybe_make_remote,
+            maybe_make_store,
+        )
 
         self.host_kv = maybe_make_store(config.cache)
-        if self.host_kv is not None:
+        self.remote_kv = maybe_make_remote(config.cache)
+        if self.host_kv is not None or self.remote_kv is not None:
             self.scheduler.admission_hook = self._host_extend_seq
         B = config.scheduler.max_num_seqs
         M = self.runner.max_blocks_per_seq
@@ -115,29 +119,45 @@ class LLMEngine:
     # -- host-DRAM KV tier (see engine/kv_offload.py) ------------------------
     def _host_extend_seq(self, seq: Sequence) -> None:
         """Admission hook: extend a freshly admitted sequence's cached prefix
-        from the host tier (blocks evicted from HBM but surviving in host
-        DRAM are re-imported instead of recomputed)."""
+        from the warm tiers — host DRAM first, then the shared remote store —
+        re-importing blocks instead of recomputing them."""
+        from production_stack_tpu.engine.kv_offload import chain_hashes
+
         bs = self.config.cache.block_size
         if seq.num_computed_tokens % bs:
             return
         start_block = seq.num_computed_tokens // bs
-        slabs, n = self.host_kv.match_extension(seq.token_ids, start_block)
+        max_usable = max((len(seq.token_ids) - 1) // bs, 0)
+        slabs = []
+        cursor = start_block
+        if self.host_kv is not None:
+            h_slabs, n = self.host_kv.match_extension(seq.token_ids, cursor)
+            slabs.extend(h_slabs)
+            cursor += n
+        if self.remote_kv is not None and cursor < max_usable:
+            hashes = chain_hashes(seq.token_ids, bs)
+            r_slabs = self.remote_kv.match_extension(hashes, cursor, max_usable)
+            slabs.extend(r_slabs)
+            cursor += len(r_slabs)
+        n = cursor - start_block
         if not n:
             return
         import numpy as np
 
-        target = seq.block_ids[start_block : start_block + n]
+        target = seq.block_ids[start_block:cursor]
         data = np.stack(slabs).transpose(1, 0, 2, 3, 4)  # (L, n, bs, ...)
         self.runner.import_blocks(target, data)
         seq.num_computed_tokens += n * bs
         seq.num_cached_tokens += n * bs
         self.scheduler.allocator.commit_full_blocks(
             seq.token_ids[: seq.num_computed_tokens],
-            seq.block_ids[: start_block + n],
+            seq.block_ids[:cursor],
         )
 
     def _host_offload_finished(self, seq: Sequence) -> None:
-        """Copy a finishing sequence's full blocks to the host tier."""
+        """Copy a finishing sequence's full blocks to the warm tiers."""
+        from production_stack_tpu.engine.kv_offload import chain_hashes
+
         bs = self.config.cache.block_size
         n_full = min(len(seq.token_ids) // bs, len(seq.block_ids))
         if n_full <= 0:
@@ -146,7 +166,13 @@ class LLMEngine:
 
         data = self.runner.export_blocks(seq.block_ids[:n_full])
         slabs = np.ascontiguousarray(data.transpose(1, 0, 2, 3, 4))
-        self.host_kv.put_sequence(seq.token_ids[: n_full * bs], slabs)
+        if self.host_kv is not None:
+            self.host_kv.put_sequence(seq.token_ids[: n_full * bs], slabs)
+        if self.remote_kv is not None:
+            for h, slab in zip(
+                chain_hashes(seq.token_ids[: n_full * bs], bs), slabs
+            ):
+                self.remote_kv.put_slab(h, slab)
 
     def _bucket(self, n: int) -> int:
         return self.config.scheduler.bucket_for(n, self.config.model.max_model_len)
@@ -268,7 +294,7 @@ class LLMEngine:
         for seq, toks in zip(seqs, token_lists):
             status = self._check_stop(seq, toks[-1]) if toks else None
             if status is not None:
-                if self.host_kv is not None:
+                if self.host_kv is not None or self.remote_kv is not None:
                     self._host_offload_finished(seq)
                 self.scheduler.finish(seq, status)
                 self._slot_seq.pop(seq.slot, None)

@@ -24,6 +24,17 @@ import numpy as np
 from production_stack_tpu.engine.kv_cache import _HASH_SEED, _chain_hash
 
 
+def chain_hashes(tokens: Sequence[int], block_size: int) -> list[int]:
+    """The allocator's content-hash chain for every full block — the shared
+    block identity across the HBM, host-DRAM and remote tiers."""
+    out, prev = [], _HASH_SEED
+    for i in range(len(tokens) // block_size):
+        chunk = tuple(tokens[i * block_size : (i + 1) * block_size])
+        prev = _chain_hash(prev, chunk)
+        out.append(prev)
+    return out
+
+
 class HostKVStore:
     def __init__(self, capacity_blocks: int, block_size: int):
         self.capacity = capacity_blocks
@@ -40,12 +51,7 @@ class HostKVStore:
         return len(self.store) / max(self.capacity, 1)
 
     def chain_hashes(self, tokens: Sequence[int]) -> list[int]:
-        out, prev = [], _HASH_SEED
-        for i in range(len(tokens) // self.block_size):
-            chunk = tuple(tokens[i * self.block_size : (i + 1) * self.block_size])
-            prev = _chain_hash(prev, chunk)
-            out.append(prev)
-        return out
+        return chain_hashes(tokens, self.block_size)
 
     def put_sequence(self, tokens: Sequence[int], slabs: np.ndarray) -> int:
         """Store full-block slabs of a finished sequence.
@@ -83,8 +89,92 @@ class HostKVStore:
         return slabs, len(slabs)
 
 
+class RemoteKVClient:
+    """Engine-side client for the shared remote tier
+    (production_stack_tpu/kv_server). Puts are fire-and-forget on a daemon
+    thread (the serving loop never blocks on the network); gets run at
+    admission with a short timeout — a miss just means recompute."""
+
+    def __init__(self, base_url: str, block_size: int,
+                 get_timeout: float = 2.0):
+        import queue
+        import threading
+
+        self.base_url = base_url.rstrip("/")
+        self.block_size = block_size
+        self.get_timeout = get_timeout
+        self.hits = 0
+        self.queries = 0
+        self._q: "queue.Queue" = queue.Queue(maxsize=1024)
+        self._thread = threading.Thread(target=self._writer, daemon=True)
+        self._thread.start()
+
+    def _writer(self) -> None:
+        import requests
+
+        session = requests.Session()
+        while True:
+            key, data, meta = self._q.get()
+            try:
+                session.put(
+                    f"{self.base_url}/blocks/{key}", data=data,
+                    headers={"X-KV-Meta": meta}, timeout=10,
+                )
+            except Exception:
+                pass  # warm tier is best-effort
+
+    def put_slab(self, chain_hash: int, slab: np.ndarray) -> None:
+        import json
+
+        meta = json.dumps({"shape": list(slab.shape), "dtype": str(slab.dtype)})
+        try:
+            self._q.put_nowait((str(chain_hash), slab.tobytes(), meta))
+        except Exception:
+            pass  # queue full: drop
+
+    def get_slab(self, chain_hash: int) -> Optional[np.ndarray]:
+        import json
+
+        import requests
+
+        self.queries += 1
+        try:
+            r = requests.get(
+                f"{self.base_url}/blocks/{chain_hash}", timeout=self.get_timeout
+            )
+            if r.status_code != 200:
+                return None
+            meta = json.loads(r.headers.get("X-KV-Meta", "{}"))
+            import jax.numpy as jnp_
+
+            dtype = (jnp_.bfloat16 if meta.get("dtype") == "bfloat16"
+                     else np.dtype(meta.get("dtype", "float32")))
+            slab = np.frombuffer(r.content, dtype).reshape(meta["shape"])
+            self.hits += 1
+            return slab
+        except Exception:
+            return None
+
+    def match_extension(self, hashes: list[int], start: int,
+                        max_usable: int) -> list[np.ndarray]:
+        slabs = []
+        for i in range(start, min(len(hashes), max_usable)):
+            slab = self.get_slab(hashes[i])
+            if slab is None:
+                break
+            slabs.append(slab)
+        return slabs
+
+
 def maybe_make_store(cache_config) -> Optional[HostKVStore]:
     if cache_config.host_offload_blocks > 0:
         return HostKVStore(cache_config.host_offload_blocks,
                            cache_config.block_size)
+    return None
+
+
+def maybe_make_remote(cache_config) -> Optional[RemoteKVClient]:
+    url = getattr(cache_config, "remote_kv_url", None)
+    if url:
+        return RemoteKVClient(url, cache_config.block_size)
     return None

@@ -526,6 +526,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated token buckets, e.g. 128,512,2048")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
+    p.add_argument("--host-offload-blocks", type=int, default=0,
+                   help="host-DRAM KV tier capacity (0 = off)")
+    p.add_argument("--remote-kv-url", default=None,
+                   help="shared remote KV server URL (kv_server)")
     return p
 
 
@@ -558,6 +562,10 @@ def config_from_args(args) -> EngineConfig:
         cfg.scheduler.prefill_buckets = tuple(
             int(x) for x in args.prefill_buckets.split(",")
         )
+    if args.host_offload_blocks:
+        cfg.cache.host_offload_blocks = args.host_offload_blocks
+    if args.remote_kv_url:
+        cfg.cache.remote_kv_url = args.remote_kv_url
     cfg.mesh = MeshConfig(
         data=args.data_parallel_size, tensor=args.tensor_parallel_size
     )

@@ -1,0 +1,107 @@
+"""Remote KV cache server — the shared warm tier behind multiple engines
+(the reference's LMCache remote cache server, deployed by cacheserverSpec /
+the CacheServer CRD; tutorial 06-remote-shared-kv-cache there).
+
+Content-addressed block slabs over HTTP: engines PUT slabs keyed by the
+same allocator chain hashes they use locally, any engine GETs them back —
+so a conversation can continue on a different replica without recompute.
+Capacity-bounded LRU in memory.
+
+Run: python -m production_stack_tpu.kv_server --port 8100
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import time
+
+from aiohttp import web
+
+
+class KVServer:
+    def __init__(self, capacity_blocks: int = 65536):
+        self.capacity = capacity_blocks
+        self.blocks: "collections.OrderedDict[str, tuple[bytes, str]]" = (
+            collections.OrderedDict()
+        )  # hash -> (raw bytes, meta json)
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.start = time.time()
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_put("/blocks/{key}", self.put_block)
+        app.router.add_get("/blocks/{key}", self.get_block)
+        app.router.add_post("/lookup", self.lookup)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        return app
+
+    async def health(self, request):
+        return web.json_response({"status": "healthy"})
+
+    async def put_block(self, request: web.Request) -> web.Response:
+        key = request.match_info["key"]
+        data = await request.read()
+        meta = request.headers.get("X-KV-Meta", "{}")
+        if key in self.blocks:
+            self.blocks.move_to_end(key)
+        else:
+            while len(self.blocks) >= self.capacity:
+                self.blocks.popitem(last=False)
+            self.blocks[key] = (data, meta)
+            self.puts += 1
+        return web.json_response({"stored": True})
+
+    async def get_block(self, request: web.Request) -> web.Response:
+        key = request.match_info["key"]
+        entry = self.blocks.get(key)
+        if entry is None:
+            self.misses += 1
+            return web.json_response({"error": "not found"}, status=404)
+        self.blocks.move_to_end(key)
+        self.hits += 1
+        data, meta = entry
+        return web.Response(body=data, content_type="application/octet-stream",
+                            headers={"X-KV-Meta": meta})
+
+    async def lookup(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        keys = body.get("keys") or []
+        return web.json_response(
+            {"present": [k for k in keys if k in self.blocks]}
+        )
+
+    async def metrics(self, request):
+        lines = [
+            "# TYPE kvserver:blocks gauge",
+            f"kvserver:blocks {len(self.blocks)}",
+            "# TYPE kvserver:usage_perc gauge",
+            f"kvserver:usage_perc {len(self.blocks) / max(self.capacity, 1)}",
+            "# TYPE kvserver:hits_total counter",
+            f"kvserver:hits_total {self.hits}",
+            "# TYPE kvserver:misses_total counter",
+            f"kvserver:misses_total {self.misses}",
+            "# TYPE kvserver:puts_total counter",
+            f"kvserver:puts_total {self.puts}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("tpu-kv-server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--capacity-blocks", type=int, default=65536)
+    args = p.parse_args(argv)
+    server = KVServer(args.capacity_blocks)
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                access_log=None)
+
+
+if __name__ == "__main__":
+    main()
