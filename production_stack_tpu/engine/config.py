@@ -39,6 +39,8 @@ class ModelConfig:
     # MoE (mixtral)
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Qwen2-family: biases on the QKV projections
+    qkv_bias: bool = False
     # where to load weights from (safetensors dir); None → random init
     weights_path: Optional[str] = None
     tokenizer: Optional[str] = None  # HF tokenizer path; None → byte tokenizer
@@ -61,9 +63,13 @@ class ModelConfig:
         archs = cfg.get("architectures") or []
         if any("Mixtral" in a for a in archs) or "num_local_experts" in cfg:
             arch = "mixtral"
+        qkv_bias = any("Qwen2" in a for a in archs) or bool(
+            cfg.get("attention_bias", False)
+        )
         hidden = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]
         return ModelConfig(
+            qkv_bias=qkv_bias,
             name=name or cfg.get("_name_or_path", "hf-model"),
             architecture=arch,
             vocab_size=cfg["vocab_size"],
@@ -126,6 +132,18 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         name="llama-3-70b", vocab_size=128256, hidden_size=8192, intermediate_size=28672,
         num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
         rope_theta=500000.0, max_model_len=8192,
+    ),
+    "qwen2-7b-class": ModelConfig(
+        # Qwen2-7B geometry: Llama stack + QKV biases + large rope theta
+        name="qwen2-7b-class", vocab_size=152064, hidden_size=3584,
+        intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+        head_dim=128, rope_theta=1000000.0, max_model_len=32768,
+        qkv_bias=True, tie_word_embeddings=False,
+    ),
+    "tiny-qwen2": ModelConfig(
+        name="tiny-qwen2", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=32, max_model_len=512, qkv_bias=True, dtype="float32",
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b", architecture="mixtral", vocab_size=32000, hidden_size=4096,

@@ -63,6 +63,10 @@ def _hf_key_map(cfg: ModelConfig, i: int) -> dict[str, tuple[str, str]]:
         f"model.layers.{i}.self_attn.o_proj.weight": ("wo", "proj_o"),
         f"model.layers.{i}.post_attention_layernorm.weight": ("mlp_norm", "copy"),
     }
+    if cfg.qkv_bias:  # Qwen2 family
+        m[f"model.layers.{i}.self_attn.q_proj.bias"] = ("bq", "bias_q")
+        m[f"model.layers.{i}.self_attn.k_proj.bias"] = ("bk", "bias_kv")
+        m[f"model.layers.{i}.self_attn.v_proj.bias"] = ("bv", "bias_kv")
     if cfg.architecture == "mixtral" and cfg.num_experts > 0:
         m[f"model.layers.{i}.block_sparse_moe.gate.weight"] = ("router", "t")
         for x in range(cfg.num_experts):
@@ -88,6 +92,10 @@ def _convert(name_rule: str, w: np.ndarray, cfg: ModelConfig) -> np.ndarray:
         return w.reshape(KH, D, E).transpose(2, 0, 1)
     if name_rule == "proj_o":  # (E, H*D) -> (H, D, E)
         return w.reshape(E, H, D).transpose(1, 2, 0)
+    if name_rule == "bias_q":  # (H*D,) -> (H, D)
+        return w.reshape(H, D)
+    if name_rule == "bias_kv":  # (KH*D,) -> (KH, D)
+        return w.reshape(KH, D)
     raise ValueError(name_rule)
 
 

@@ -51,6 +51,14 @@ def param_specs(cfg: ModelConfig) -> dict:
         "wo": (L.LAYERS, L.HEADS, L.HEAD_DIM, L.EMBED),
         "mlp_norm": (L.LAYERS, L.EMBED),
     }
+    if cfg.qkv_bias:  # Qwen2 family
+        layer.update(
+            {
+                "bq": (L.LAYERS, L.HEADS, L.HEAD_DIM),
+                "bk": (L.LAYERS, L.KV_HEADS, L.HEAD_DIM),
+                "bv": (L.LAYERS, L.KV_HEADS, L.HEAD_DIM),
+            }
+        )
     if cfg.architecture == "mixtral" and cfg.num_experts > 0:
         layer.update(
             {
@@ -104,6 +112,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         "wo": normal(keys[3], (Ln, H, D, E), H * D),
         "mlp_norm": jnp.ones((Ln, E), dt),
     }
+    if cfg.qkv_bias:
+        layers.update(
+            {
+                "bq": normal(keys[10], (Ln, H, D), E),
+                "bk": normal(keys[11], (Ln, KH, D), E),
+                "bv": normal(keys[12], (Ln, KH, D), E),
+            }
+        )
     if cfg.architecture == "mixtral" and cfg.num_experts > 0:
         X = cfg.num_experts
         layers.update(
@@ -220,6 +236,10 @@ def forward_tokens(
         q = jnp.einsum("...te,ehd->...thd", normed, lp["wq"])
         k = jnp.einsum("...te,ehd->...thd", normed, lp["wk"])
         v = jnp.einsum("...te,ehd->...thd", normed, lp["wv"])
+        if cfg.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn, caches = attend(q, k, v, caches, layer_idx)

@@ -119,6 +119,36 @@ def test_dense_forward_tp_invariance(tiny_setup):
     )
 
 
+def test_qwen2_bias_engine_matches_dense():
+    """Qwen2-family (QKV biases) through the full paged engine vs dense."""
+    from production_stack_tpu.engine.config import CacheConfig, SchedulerConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sampling import SamplingParams
+
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-qwen2"),
+        cache=CacheConfig(block_size=4, num_blocks=128),
+        scheduler=SchedulerConfig(max_num_seqs=2, prefill_buckets=(32,)),
+    )
+    mesh = build_mesh(MeshConfig(data=1, tensor=2))
+    params = init_or_load(cfg.model, mesh, seed=0)
+    assert "bq" in params["layers"]
+    eng = LLMEngine(cfg, mesh=mesh, params=params, num_blocks=128)
+    prompt = [5, 9, 2, 44, 7]
+    got = eng.generate(
+        [prompt], SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    )["offline-0"]
+
+    toks = list(prompt)
+    with jax.set_mesh(mesh):
+        for _ in range(6):
+            logits = jax.jit(llama.forward_dense, static_argnums=0)(
+                cfg.model, params, jnp.asarray([toks], jnp.int32)
+            )
+            toks.append(int(jnp.argmax(logits[0, -1])))
+    assert got == toks[len(prompt):]
+
+
 def test_mixtral_moe_forward_runs():
     cfg = ModelConfig.from_pretrained("tiny-mixtral")
     mesh = build_mesh(MeshConfig(data=1, tensor=4, expert=2))
