@@ -67,6 +67,9 @@ class LLMEngine:
         self._top_ks = np.full(B, -1, np.int32)
         self._seeds = np.zeros(B, np.uint32)
         self._steps = np.zeros(B, np.int32)
+        self._presence = np.zeros(B, np.float32)
+        self._frequency = np.zeros(B, np.float32)
+        self._count_reset_slots: list[int] = []
         self._slot_seq: dict[int, Sequence] = {}
         # metrics
         self.total_prompt_tokens = 0
@@ -232,6 +235,9 @@ class LLMEngine:
                 continue  # more chunks to go
             seq.status = SequenceStatus.RUNNING
             self._slot_seq[seq.slot] = seq
+            s = seq.sampling
+            if s.presence_penalty or s.frequency_penalty:
+                self._count_reset_slots.append(seq.slot)
             if seq.output_token_ids:
                 # preemption-recompute: context rebuilt, newest token still
                 # the pending decode input — nothing sampled this step
@@ -263,15 +269,26 @@ class LLMEngine:
             self._top_ks[i] = s.top_k
             self._seeds[i] = s.seed or 0
             self._steps[i] = len(seq.output_token_ids)
+            self._presence[i] = s.presence_penalty
+            self._frequency[i] = s.frequency_penalty
 
         # multi_step fused decode+sample iterations in one dispatch; sampled
         # tokens come back (K, B) and are appended until a stop fires
         greedy_only = all(s.sampling.temperature <= 0.0 for s in decodes)
+        use_penalties = any(
+            s.sampling.presence_penalty or s.sampling.frequency_penalty
+            for s in decodes
+        )
+        if use_penalties and self._count_reset_slots:
+            self.runner.reset_count_rows(self._count_reset_slots)
+            self._count_reset_slots.clear()
         sampled = self.runner.decode_multi(
             self._tokens, self._positions, self._block_tables,
             self._context_lens, self._slot_mapping,
             self._temps, self._top_ps, self._top_ks, self._seeds, self._steps,
             greedy_only=greedy_only,
+            presence=self._presence if use_penalties else None,
+            frequency=self._frequency if use_penalties else None,
         )
         token_lists = []
         for seq in decodes:

@@ -99,9 +99,12 @@ class ModelRunner:
                 max(config.scheduler.multi_step, 1),
             ),
             donate_argnums=(1,),
-            static_argnames=("block_size", "greedy_only"),
+            static_argnames=("block_size", "greedy_only", "use_penalties"),
         )
         self._sample = jax.jit(sample_tokens)
+        # per-slot output-token counts for presence/frequency penalties
+        # ((B, V) int32; allocated on first penalised batch)
+        self.token_counts = None
 
     # -- sizing ------------------------------------------------------------
     def _prefill_temp_bytes(self) -> int:
@@ -291,23 +294,56 @@ class ModelRunner:
             )
         return logits
 
+    def _ensure_counts(self):
+        if self.token_counts is None:
+            with jax.set_mesh(self.mesh):
+                self.token_counts = jnp.zeros(
+                    (self.config.scheduler.max_num_seqs, self.cfg.vocab_size),
+                    jnp.int32,
+                )
+
+    def reset_count_rows(self, slots: list[int]) -> None:
+        """Zero the output-token counts of freshly (re)assigned slots."""
+        self._ensure_counts()
+        idx = jnp.asarray(slots, jnp.int32)
+        with jax.set_mesh(self.mesh):
+            self.token_counts = jax.jit(
+                lambda c, s: c.at[s].set(0), donate_argnums=(0,)
+            )(self.token_counts, idx)
+
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
-                     greedy_only: bool = False) -> np.ndarray:
+                     greedy_only: bool = False,
+                     presence=None, frequency=None) -> np.ndarray:
         """multi_step fused decode+sample iterations; returns sampled tokens
         (num_steps, B) on host. ``greedy_only`` selects the argmax-only
-        compiled variant (skips the top-k machinery entirely)."""
+        compiled variant; presence/frequency arrays activate the penalised
+        variant (counts tracked on device)."""
+        use_penalties = presence is not None
+        if use_penalties:
+            self._ensure_counts()
+            counts = self.token_counts
+            pres = jnp.asarray(presence)
+            freq = jnp.asarray(frequency)
+        else:
+            counts = jnp.zeros((tokens.shape[0], 1), jnp.int32)  # placeholder
+            pres = jnp.zeros(tokens.shape[0], jnp.float32)
+            freq = pres
         with jax.set_mesh(self.mesh):
-            self.kv, sampled = self._decode_multi(
+            (self.kv, new_counts), sampled = self._decode_multi(
                 self.params, self.kv,
                 jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
                 jnp.asarray(block_tables), jnp.asarray(context_lens),
                 jnp.asarray(slot_mapping),
                 jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
                 jnp.asarray(seeds), jnp.asarray(steps),
+                counts, pres, freq,
                 block_size=self.config.cache.block_size,
                 greedy_only=greedy_only,
+                use_penalties=use_penalties,
             )
+        if use_penalties:
+            self.token_counts = new_counts
         return np.asarray(jax.device_get(sampled))
 
     def apply_param_deltas(self, deltas: dict, sign: float) -> dict:
@@ -426,7 +462,9 @@ def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
 def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv,
                        tokens, positions, block_tables, context_lens,
                        slot_mapping, temps, top_ps, top_ks, seeds, steps,
-                       block_size: int, greedy_only: bool = False):
+                       token_counts, presence, frequency,
+                       block_size: int, greedy_only: bool = False,
+                       use_penalties: bool = False):
     """``num_steps`` fused decode+sample iterations in ONE dispatch.
 
     The token sampled at iteration i feeds iteration i+1 entirely on device;
@@ -441,7 +479,7 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
     B = tokens.shape[0]
     active = context_lens > 0
 
-    def one(kv, tok, pos, ctx, slots, step_ctr):
+    def one(kv, tok, pos, ctx, slots, step_ctr, counts):
         def attend(q, k, v, caches, layer_idx):
             return attend_impl(
                 q, k, v, caches, layer_idx, block_tables, ctx, pos[:, None],
@@ -452,6 +490,10 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             cfg, params, tok[:, None], pos[:, None], attend, kv
         )
         logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]
+        if use_penalties:
+            from production_stack_tpu.engine.sampling import penalize_logits
+
+            logits = penalize_logits(logits, counts, presence, frequency)
         if greedy_only:
             sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
@@ -459,8 +501,8 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
         return kv, sampled
 
     def body(carry, _):
-        kv, tok, pos, ctx, slots, step_ctr = carry
-        kv, sampled = one(kv, tok, pos, ctx, slots, step_ctr)
+        kv, tok, pos, ctx, slots, step_ctr, counts = carry
+        kv, sampled = one(kv, tok, pos, ctx, slots, step_ctr, counts)
         new_pos = jnp.where(active, pos + 1, pos)
         new_ctx = jnp.where(active, ctx + 1, ctx)
         block = block_tables[jnp.arange(B), jnp.clip(new_pos, 0, None) // block_size]
@@ -468,8 +510,15 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             active, block * block_size + new_pos % block_size, -1
         )
         tok = jnp.where(active, sampled, tok)
-        return (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1), sampled
+        if use_penalties:
+            counts = counts.at[jnp.arange(B), sampled].add(
+                active.astype(counts.dtype)
+            )
+        return (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1, counts), sampled
 
-    init = (kv, tokens[:, 0], positions[:, 0], context_lens, slot_mapping, steps)
-    (kv, *_), sampled = jax.lax.scan(body, init, None, length=num_steps)
-    return kv, sampled  # (num_steps, B)
+    init = (kv, tokens[:, 0], positions[:, 0], context_lens, slot_mapping,
+            steps, token_counts)
+    (kv, _, _, _, _, _, counts), sampled = jax.lax.scan(
+        body, init, None, length=num_steps
+    )
+    return (kv, counts), sampled  # (num_steps, B)
