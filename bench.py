@@ -37,7 +37,7 @@ def main() -> None:
 
     on_tpu = jax.default_backend() not in ("cpu",)
     model = "llama-3b-class" if on_tpu else "tiny-llama"
-    num_seqs = 64 if on_tpu else 8
+    num_seqs = 192 if on_tpu else 8
     prompt_len = 128
     out_len = 128 if on_tpu else 16
 
