@@ -385,6 +385,53 @@ class LLMEngine:
             out["cpu_prefix_cache_queries_total"] = self.host_kv.queries
         return out
 
+    def embed(self, prompt_token_ids: list[int]) -> "np.ndarray":
+        """Mean-pooled final hidden state — the /v1/embeddings surface (the
+        reference proxies this to vLLM embedding models; a causal LM's
+        pooled hidden is the standard fallback encoder)."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from production_stack_tpu.models.registry import get_model
+
+        if getattr(self, "_embed_fn", None) is None:
+            model = get_model(self.config.model)
+
+            def _embed(cfg, params, tokens, mask):
+                def attend(q, k, v, caches, layer_idx):
+                    from production_stack_tpu.ops.attention import (
+                        dense_causal_attention,
+                    )
+
+                    return dense_causal_attention(q, k, v), caches
+
+                S = tokens.shape[1]
+                positions = jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32), tokens.shape
+                )
+                hidden, _ = model.forward_tokens(
+                    cfg, params, tokens, positions, attend, None
+                )
+                m = mask[:, :, None].astype(jnp.float32)
+                pooled = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
+                return pooled / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+
+            self._embed_fn = jax.jit(
+                functools.partial(_embed, self.config.model)
+            )
+        bucket = self._bucket(len(prompt_token_ids))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(prompt_token_ids)] = prompt_token_ids
+        mask = np.zeros((1, bucket), np.int32)
+        mask[0, : len(prompt_token_ids)] = 1
+        with jax.set_mesh(self.mesh):
+            out = self._embed_fn(self.runner.params, jnp.asarray(tokens),
+                                 jnp.asarray(mask))
+        return np.asarray(jax.device_get(out))[0]
+
     def warmup(self) -> None:
         """Pre-compile every serving shape variant so no live request pays a
         compile: each prefill bucket at P=1, the P=prefill_batch variant,

@@ -77,6 +77,7 @@ class EngineServer:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/kv/export", self.kv_export)
+        app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/sleep", self.sleep)
@@ -130,6 +131,39 @@ class EngineServer:
                 }
             )
         return web.json_response({"object": "list", "data": cards})
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        inputs = body.get("input")
+        if inputs is None:
+            return web.json_response(
+                {"error": {"message": "'input' is required"}}, status=400
+            )
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        tk = self.engine.tokenizer
+        data = []
+        total_tokens = 0
+        for i, text in enumerate(inputs):
+            ids = tk.encode(text) if isinstance(text, str) else list(text)
+            ids = ids[: self.config.model.max_model_len - 1]
+            total_tokens += len(ids)
+            vec = await self.async_engine.run_on_engine(
+                lambda eng, ids=ids: eng.embed(ids)
+            )
+            data.append(
+                {"object": "embedding", "index": i,
+                 "embedding": [float(x) for x in vec]}
+            )
+        return web.json_response(
+            {
+                "object": "list",
+                "model": body.get("model", self.model_name),
+                "data": data,
+                "usage": {"prompt_tokens": total_tokens,
+                          "total_tokens": total_tokens},
+            }
+        )
 
     # -- LoRA (reference operator contract: loadadapter_controller.go:553) --
     async def load_lora(self, request: web.Request) -> web.Response:

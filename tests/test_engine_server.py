@@ -142,6 +142,30 @@ def test_metrics_exposition_contract(server):
     run(with_client(server, fn))
 
 
+def test_embeddings_endpoint(server):
+    async def fn(client):
+        r = await client.post(
+            "/v1/embeddings",
+            json={"model": "tiny-llama", "input": ["hello world", "bye"]},
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "list" and len(data["data"]) == 2
+        dim = len(data["data"][0]["embedding"])
+        assert dim == 128  # tiny-llama hidden size
+        # same input → same vector; different input → different
+        r2 = await client.post(
+            "/v1/embeddings", json={"input": "hello world"}
+        )
+        v0 = (await r2.json())["data"][0]["embedding"]
+        assert v0 == data["data"][0]["embedding"]
+        assert v0 != data["data"][1]["embedding"]
+        r = await client.post("/v1/embeddings", json={})
+        assert r.status == 400
+
+    run(with_client(server, fn))
+
+
 def test_sleep_wake(server):
     async def fn(client):
         r = await client.get("/is_sleeping")
