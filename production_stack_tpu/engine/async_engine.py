@@ -133,10 +133,12 @@ class AsyncEngine:
 
     # -- sleep mode (reference: /sleep /wake_up /is_sleeping proxying,
     #    src/vllm_router/services/request_service/request.py:1027-1114) ------
-    def sleep(self, level: int = 1) -> None:
+    async def sleep(self, level: int = 1) -> None:
         self.paused = True
+        await self.run_on_engine(lambda eng: eng.sleep_mode(level))
 
-    def wake_up(self) -> None:
+    async def wake_up(self) -> None:
+        await self.run_on_engine(lambda eng: eng.wake_mode())
         self.paused = False
 
     @property

@@ -385,6 +385,45 @@ class LLMEngine:
             out["cpu_prefix_cache_queries_total"] = self.host_kv.queries
         return out
 
+    # -- sleep mode (frees HBM; reference semantics: engines release device
+    #    memory on /sleep and restore on /wake_up, request.py:1027-1114) ----
+    def sleep_mode(self, level: int = 1) -> None:
+        """level 1: drop the KV pool (largest HBM allocation), keep weights;
+        level 2: drop weights too. Refuses while requests are in flight."""
+        if self.has_unfinished():
+            raise RuntimeError("cannot sleep with unfinished requests")
+        from production_stack_tpu.engine.kv_cache import (
+            PrefixCachingBlockAllocator,
+        )
+
+        self.runner.kv = None
+        self.scheduler.allocator = PrefixCachingBlockAllocator(
+            self.runner.num_blocks, self.config.cache.block_size,
+            self.config.cache.enable_prefix_caching,
+        )
+        if level >= 2:
+            self.runner.params = None
+        self.sleep_level = level
+
+    def wake_mode(self) -> None:
+        import jax
+
+        from production_stack_tpu.engine import kv_cache as kvmod
+        from production_stack_tpu.engine.weights import init_or_load
+
+        if self.runner.params is None:
+            with jax.set_mesh(self.mesh):
+                self.runner.params = init_or_load(
+                    self.config.model, self.mesh, self.runner.rules,
+                    self.config.seed,
+                )
+        if self.runner.kv is None:
+            self.runner.kv = kvmod.init_kv_cache(
+                self.config.model, self.config.cache, self.mesh,
+                self.runner.rules, self.runner.num_blocks,
+            )
+        self.sleep_level = 0
+
     def embed(self, prompt_token_ids: list[int]) -> "np.ndarray":
         """Mean-pooled final hidden state — the /v1/embeddings surface (the
         reference proxies this to vLLM embedding models; a causal LM's

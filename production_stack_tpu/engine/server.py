@@ -299,11 +299,15 @@ class EngineServer:
     # -- sleep family ---------------------------------------------------------
     async def sleep(self, request: web.Request) -> web.Response:
         level = int(request.query.get("level", 1))
-        self.async_engine.sleep(level)
+        try:
+            await self.async_engine.sleep(level)
+        except RuntimeError as e:
+            self.async_engine.paused = False
+            return web.json_response({"error": {"message": str(e)}}, status=409)
         return web.json_response({"status": "sleeping", "level": level})
 
     async def wake_up(self, request: web.Request) -> web.Response:
-        self.async_engine.wake_up()
+        await self.async_engine.wake_up()
         return web.json_response({"status": "awake"})
 
     async def is_sleeping(self, request: web.Request) -> web.Response:

@@ -170,12 +170,25 @@ def test_sleep_wake(server):
     async def fn(client):
         r = await client.get("/is_sleeping")
         assert (await r.json())["is_sleeping"] is False
-        await client.post("/sleep")
+        # level 2: weights + KV pool actually dropped
+        r = await client.post("/sleep?level=2")
+        assert r.status == 200
+        assert server.engine.runner.kv is None
+        assert server.engine.runner.params is None
         r = await client.get("/is_sleeping")
         assert (await r.json())["is_sleeping"] is True
         await client.post("/wake_up")
         r = await client.get("/is_sleeping")
         assert (await r.json())["is_sleeping"] is False
+        # serving works again after reload (random-init: same seed -> same
+        # params, so greedy output is reproducible)
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "post-wake", "max_tokens": 3, "temperature": 0,
+                  "ignore_eos": True},
+        )
+        assert r.status == 200
+        assert (await r.json())["usage"]["completion_tokens"] == 3
 
     run(with_client(server, fn))
 
