@@ -78,6 +78,7 @@ class EngineServer:
         app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/kv/export", self.kv_export)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/messages", self.messages)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/sleep", self.sleep)
@@ -131,6 +132,106 @@ class EngineServer:
                 }
             )
         return web.json_response({"object": "list", "data": cards})
+
+    async def messages(self, request: web.Request) -> web.StreamResponse:
+        """Anthropic-style Messages API (the reference proxies /v1/messages
+        to engines, main_router.py; here it's served natively)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        msgs = body.get("messages")
+        if not msgs:
+            return web.json_response(
+                {"error": {"message": "'messages' is required"}}, status=400
+            )
+        chat = []
+        if body.get("system"):
+            chat.append({"role": "system", "content": body["system"]})
+        for m in msgs:
+            content = m.get("content")
+            if isinstance(content, list):
+                content = "".join(
+                    b.get("text", "") for b in content if b.get("type") == "text"
+                )
+            chat.append({"role": m.get("role", "user"), "content": content})
+        prompt = self._render_chat(chat)
+        prompt_ids = self.engine.tokenizer.encode(prompt)
+        sampling = _sampling_from_body(body)
+        rid = f"msg_{uuid.uuid4().hex[:24]}"
+
+        if len(prompt_ids) > self.config.model.max_model_len - 1:
+            return web.json_response(
+                {"error": {"message": "prompt too long"}}, status=400
+            )
+        gen = self.async_engine.generate(prompt_ids, sampling, rid)
+        tk = self.engine.tokenizer
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+
+            async def ev(name, payload):
+                await resp.write(
+                    f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+
+            await ev("message_start", {
+                "type": "message_start",
+                "message": {"id": rid, "type": "message", "role": "assistant",
+                            "model": body.get("model", self.model_name),
+                            "content": [],
+                            "usage": {"input_tokens": len(prompt_ids)}},
+            })
+            await ev("content_block_start", {
+                "type": "content_block_start", "index": 0,
+                "content_block": {"type": "text", "text": ""},
+            })
+            token_ids, sent = [], 0
+            n_out = 0
+            finish = "end_turn"
+            async for out in gen:
+                token_ids.extend(out.new_token_ids)
+                n_out = out.num_output_tokens
+                text = tk.decode(token_ids)
+                if len(text) > sent:
+                    await ev("content_block_delta", {
+                        "type": "content_block_delta", "index": 0,
+                        "delta": {"type": "text_delta", "text": text[sent:]},
+                    })
+                    sent = len(text)
+                if out.finished:
+                    finish = ("max_tokens" if out.finish_reason == "length"
+                              else "end_turn")
+            await ev("content_block_stop",
+                     {"type": "content_block_stop", "index": 0})
+            await ev("message_delta", {
+                "type": "message_delta",
+                "delta": {"stop_reason": finish},
+                "usage": {"output_tokens": n_out},
+            })
+            await ev("message_stop", {"type": "message_stop"})
+            await resp.write_eof()
+            return resp
+
+        token_ids = []
+        finish = "end_turn"
+        async for out in gen:
+            token_ids.extend(out.new_token_ids)
+            if out.finished:
+                finish = ("max_tokens" if out.finish_reason == "length"
+                          else "end_turn")
+        return web.json_response({
+            "id": rid, "type": "message", "role": "assistant",
+            "model": body.get("model", self.model_name),
+            "content": [{"type": "text", "text": tk.decode(token_ids)}],
+            "stop_reason": finish,
+            "usage": {"input_tokens": len(prompt_ids),
+                      "output_tokens": len(token_ids)},
+        })
 
     async def embeddings(self, request: web.Request) -> web.Response:
         body = await request.json()

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import time
 from typing import Optional
 
@@ -137,6 +138,15 @@ class RouterApp:
     def initialize(self) -> None:
         args = self.args
         set_log_level(args.log_level)
+
+        # API keys (reference: VLLM_API_KEY env / secrets): one key per line
+        self._api_keys: set[str] = set()
+        if args.api_key_file:
+            with open(args.api_key_file) as f:
+                self._api_keys = {ln.strip() for ln in f if ln.strip()}
+        env_key = os.environ.get("ROUTER_API_KEY")
+        if env_key:
+            self._api_keys.add(env_key)
 
         from production_stack_tpu.router.experimental.tracing import (
             initialize_tracing,
@@ -291,8 +301,24 @@ class RouterApp:
         app.on_cleanup.append(self._on_stop)
         return app
 
+    def _check_api_key(self, request: web.Request) -> Optional[web.Response]:
+        if not self._api_keys:
+            return None
+        auth = request.headers.get("Authorization", "")
+        key = auth.removeprefix("Bearer ").strip()
+        if key in self._api_keys:
+            return None
+        return web.json_response(
+            {"error": {"message": "invalid or missing API key",
+                       "type": "authentication_error"}},
+            status=401,
+        )
+
     def _make_proxy(self, path: str):
         async def handler(request: web.Request) -> web.StreamResponse:
+            denied = self._check_api_key(request)
+            if denied is not None:
+                return denied
             if self.pii_middleware is not None:
                 blocked = await self.pii_middleware.check(request)
                 if blocked is not None:

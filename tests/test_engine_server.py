@@ -142,6 +142,40 @@ def test_metrics_exposition_contract(server):
     run(with_client(server, fn))
 
 
+def test_anthropic_messages_endpoint(server):
+    async def fn(client):
+        r = await client.post(
+            "/v1/messages",
+            json={"model": "tiny-llama", "max_tokens": 4,
+                  "system": "be brief",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "temperature": 0, "ignore_eos": True},
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["type"] == "message" and data["role"] == "assistant"
+        assert data["stop_reason"] == "max_tokens"
+        assert data["usage"]["output_tokens"] == 4
+
+        r = await client.post(
+            "/v1/messages",
+            json={"model": "tiny-llama", "max_tokens": 3, "stream": True,
+                  "messages": [{"role": "user", "content": [
+                      {"type": "text", "text": "hello"}]}],
+                  "temperature": 0, "ignore_eos": True},
+        )
+        assert r.status == 200
+        text = await r.text()
+        for ev in ("message_start", "content_block_start", "message_delta",
+                   "message_stop"):
+            assert f"event: {ev}" in text
+
+        r = await client.post("/v1/messages", json={"max_tokens": 3})
+        assert r.status == 400
+
+    run(with_client(server, fn))
+
+
 def test_embeddings_endpoint(server):
     async def fn(client):
         r = await client.post(

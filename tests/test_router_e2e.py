@@ -197,6 +197,41 @@ def test_streaming_and_metrics_through_router():
     asyncio.run(main())
 
 
+def test_api_key_auth():
+    async def main():
+        import tempfile
+
+        servers, urls = await spawn_engines(1)
+        keyfile = tempfile.NamedTemporaryFile("w", suffix=".keys", delete=False)
+        keyfile.write("sk-valid-key\n")
+        keyfile.close()
+        router, client = await router_client(
+            urls, ("--api-key-file", keyfile.name)
+        )
+        try:
+            body = {"model": "tiny-llama", "prompt": "x", "max_tokens": 2,
+                    "temperature": 0, "ignore_eos": True}
+            r = await client.post("/v1/completions", json=body)
+            assert r.status == 401
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"Authorization": "Bearer wrong"},
+            )
+            assert r.status == 401
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"Authorization": "Bearer sk-valid-key"},
+            )
+            assert r.status == 200
+            # health stays open for probes
+            r = await client.get("/health")
+            assert r.status == 200
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
 def test_unknown_model_404_vs_503():
     async def main():
         servers, urls = await spawn_engines(1)
