@@ -65,10 +65,11 @@ class AsyncEngine:
         while True:
             kind, payload = item
             if kind == "add":
-                rid, prompt_ids, sampling = payload
+                rid, prompt_ids, sampling, adapter_slot = payload
                 try:
                     self.engine.add_request(
-                        rid, prompt_token_ids=prompt_ids, sampling=sampling
+                        rid, prompt_token_ids=prompt_ids, sampling=sampling,
+                        adapter_slot=adapter_slot,
                     )
                 except Exception as e:  # surfaced on the request's stream
                     if self.loop is not None:
@@ -103,11 +104,14 @@ class AsyncEngine:
         prompt_token_ids: Seq[int],
         sampling: SamplingParams,
         request_id: Optional[str] = None,
+        adapter_slot: int = 0,
     ) -> AsyncIterator[RequestOutput]:
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         q: asyncio.Queue = asyncio.Queue()
         self.streams[rid] = q
-        self.intake.put(("add", (rid, list(prompt_token_ids), sampling)))
+        self.intake.put(
+            ("add", (rid, list(prompt_token_ids), sampling, adapter_slot))
+        )
         try:
             while True:
                 item = await q.get()

@@ -209,6 +209,9 @@ class EngineConfig:
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     seed: int = 0
+    # multi-LoRA bank: slot 0 is the base model, adapters occupy 1..max-1
+    max_loras: int = 4
+    max_lora_rank: int = 16
 
     @staticmethod
     def for_model(name: str, **kw) -> "EngineConfig":

@@ -69,6 +69,7 @@ class LLMEngine:
         self._steps = np.zeros(B, np.int32)
         self._presence = np.zeros(B, np.float32)
         self._frequency = np.zeros(B, np.float32)
+        self._adapter_ids = np.zeros(B, np.int32)
         self._count_reset_slots: list[int] = []
         self._slot_seq: dict[int, Sequence] = {}
         # metrics
@@ -82,6 +83,7 @@ class LLMEngine:
         prompt: Optional[str] = None,
         prompt_token_ids: Optional[Seq[int]] = None,
         sampling: Optional[SamplingParams] = None,
+        adapter_slot: int = 0,
     ) -> Sequence:
         if prompt_token_ids is None:
             assert prompt is not None, "prompt or prompt_token_ids required"
@@ -96,7 +98,8 @@ class LLMEngine:
         sampling = (sampling or SamplingParams()).clamped(
             self.config.model.max_model_len, len(prompt_token_ids)
         )
-        seq = Sequence(request_id, list(prompt_token_ids), sampling)
+        seq = Sequence(request_id, list(prompt_token_ids), sampling,
+                       adapter_slot=adapter_slot)
         self.scheduler.add(seq)
         self.total_prompt_tokens += len(prompt_token_ids)
         return seq
@@ -200,6 +203,7 @@ class LLMEngine:
         top_ps = np.ones(P, np.float32)
         top_ks = np.full(P, -1, np.int32)
         seeds = np.zeros(P, np.uint32)
+        adapter_ids = np.zeros(P, np.int32)
 
         for i, sp in enumerate(prefills):
             seq = sp.seq
@@ -220,11 +224,14 @@ class LLMEngine:
             top_ps[i] = s.top_p
             top_ks[i] = s.top_k
             seeds[i] = s.seed or 0
+            adapter_ids[i] = seq.adapter_slot
 
         greedy_only = all(sp.seq.sampling.temperature <= 0.0 for sp in prefills)
+        use_lora = any(sp.seq.adapter_slot for sp in prefills)
         sampled = self.runner.prefill(
             tokens, positions, tables, context_lens, slot_mapping.reshape(-1),
             last_idx, temps, top_ps, top_ks, seeds, greedy_only=greedy_only,
+            adapter_ids=adapter_ids if use_lora else None,
         )
 
         finished_prompts, first_tokens = [], []
@@ -271,10 +278,12 @@ class LLMEngine:
             self._steps[i] = len(seq.output_token_ids)
             self._presence[i] = s.presence_penalty
             self._frequency[i] = s.frequency_penalty
+            self._adapter_ids[i] = seq.adapter_slot
 
         # multi_step fused decode+sample iterations in one dispatch; sampled
         # tokens come back (K, B) and are appended until a stop fires
         greedy_only = all(s.sampling.temperature <= 0.0 for s in decodes)
+        use_lora = any(s.adapter_slot for s in decodes)
         use_penalties = any(
             s.sampling.presence_penalty or s.sampling.frequency_penalty
             for s in decodes
@@ -289,6 +298,7 @@ class LLMEngine:
             greedy_only=greedy_only,
             presence=self._presence if use_penalties else None,
             frequency=self._frequency if use_penalties else None,
+            adapter_ids=self._adapter_ids if use_lora else None,
         )
         token_lists = []
         for seq in decodes:

@@ -111,37 +111,106 @@ def load_adapter(name: str, path: str, cfg: ModelConfig) -> LoraAdapter:
     return LoraAdapter(name=name, path=path, scaling=scaling, deltas=deltas)
 
 
+def load_adapter_raw(name: str, path: str, cfg: ModelConfig,
+                     max_rank: int) -> dict:
+    """Load a PEFT adapter as raw (A, B) pairs in our orientations, stacked
+    per layer and rank-padded for the batched multi-LoRA bank:
+    target -> (A (L, in, Rmax), B (L, Rmax, *out)); scaling folded into B."""
+    from safetensors import safe_open
+
+    cfg_path = os.path.join(path, "adapter_config.json")
+    scaling = 1.0
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            acfg = json.load(f)
+        r = acfg.get("r", 8)
+        scaling = acfg.get("lora_alpha", r) / max(r, 1)
+
+    H, KH, D, E = (cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                   cfg.hidden_size)
+    out_shapes = {
+        "wq": (H, D), "wk": (KH, D), "wv": (KH, D), "wo": (E,),
+        "w_gate": (cfg.intermediate_size,), "w_up": (cfg.intermediate_size,),
+        "w_down": (E,),
+    }
+    pairs: dict[tuple[int, str], dict[str, np.ndarray]] = {}
+    with safe_open(os.path.join(path, "adapter_model.safetensors"),
+                   framework="np") as f:
+        for key in f.keys():
+            m = _KEY_RE.search(key)
+            if not m:
+                continue
+            layer, module, ab = int(m.group(1)), m.group(2), m.group(3)
+            if module not in _TARGETS:
+                continue
+            pairs.setdefault((layer, module), {})[ab] = f.get_tensor(key)
+
+    per_target: dict[str, dict[int, tuple[np.ndarray, np.ndarray]]] = {}
+    for (layer, module), ab in pairs.items():
+        if "A" not in ab or "B" not in ab:
+            continue
+        A = ab["A"].astype(np.float32).T  # (in, r)
+        r = A.shape[1]
+        if r > max_rank:
+            raise ValueError(
+                f"adapter rank {r} exceeds max_lora_rank {max_rank}"
+            )
+        B = ab["B"].astype(np.float32).T * scaling  # (r, out_flat)
+        our_key, _ = _TARGETS[module]
+        per_target.setdefault(our_key, {})[layer] = (A, B)
+
+    if not per_target:
+        raise ValueError(f"adapter at {path!r} has no supported LoRA targets")
+
+    bank: dict = {}
+    for our_key, by_layer in per_target.items():
+        in_dim = next(iter(by_layer.values()))[0].shape[0]
+        out = out_shapes[our_key]
+        A_st = np.zeros((cfg.num_layers, in_dim, max_rank), np.float32)
+        B_st = np.zeros((cfg.num_layers, max_rank, *out), np.float32)
+        for layer, (A, B) in by_layer.items():
+            r = A.shape[1]
+            A_st[layer, :, :r] = A
+            B_st[layer, :r] = B.reshape(r, *out)
+        bank[our_key] = (A_st, B_st)
+    return bank
+
+
 class LoraManager:
-    """Tracks loaded adapters and applies/removes their merged deltas."""
+    """Multi-LoRA bank: adapters occupy slots 1..max_loras-1 of the device
+    bank (slot 0 = zeros = base model); any mix of adapters and base
+    requests serves in one batch (per-token selection in the kernels)."""
 
     def __init__(self, engine):
         self.engine = engine
-        self.adapters: dict[str, LoraAdapter] = {}
-        self.merged: Optional[str] = None  # adapter currently in the weights
+        self.max_loras = engine.config.max_loras
+        self.max_rank = engine.config.max_lora_rank
+        self.slots: dict[str, int] = {}  # adapter name -> slot
 
     def list_adapters(self) -> list[str]:
-        return sorted(self.adapters)
+        return sorted(self.slots)
+
+    def slot_of(self, name: str) -> int:
+        return self.slots.get(name, 0)
 
     def load(self, name: str, path: str) -> None:
-        if name in self.adapters:
+        if name in self.slots:
             return
-        adapter = load_adapter(name, path, self.engine.config.model)
-        if self.merged is not None:
+        used = set(self.slots.values())
+        free = [i for i in range(1, self.max_loras) if i not in used]
+        if not free:
             raise RuntimeError(
-                f"adapter {self.merged!r} already merged; unload it first "
-                "(single live adapter per engine in this release)"
+                f"all {self.max_loras - 1} adapter slots in use; unload one"
             )
-        adapter.effective = self.engine.runner.apply_param_deltas(
-            adapter.deltas, sign=1.0
-        )
-        self.adapters[name] = adapter
-        self.merged = name
+        bank = load_adapter_raw(name, path, self.engine.config.model,
+                                self.max_rank)
+        slot = free[0]
+        self.engine.runner.register_lora(slot, bank)
+        self.slots[name] = slot
 
     def unload(self, name: str) -> bool:
-        adapter = self.adapters.pop(name, None)
-        if adapter is None:
+        slot = self.slots.pop(name, None)
+        if slot is None:
             return False
-        if self.merged == name:
-            self.engine.runner.apply_param_deltas(adapter.effective, sign=-1.0)
-            self.merged = None
+        self.engine.runner.unregister_lora(slot)
         return True

@@ -105,6 +105,9 @@ class ModelRunner:
         # per-slot output-token counts for presence/frequency penalties
         # ((B, V) int32; allocated on first penalised batch)
         self.token_counts = None
+        # multi-LoRA bank: target -> (A (L, N, in, R), B (L, N, R, *out));
+        # slot 0 stays zeros (base model)
+        self.lora_bank: Optional[dict] = None
 
     # -- sizing ------------------------------------------------------------
     def _prefill_temp_bytes(self) -> int:
@@ -265,10 +268,12 @@ class ModelRunner:
                 block_tables: np.ndarray, context_lens: np.ndarray,
                 slot_mapping: np.ndarray, last_idx: np.ndarray,
                 temps: np.ndarray, top_ps: np.ndarray, top_ks: np.ndarray,
-                seeds: np.ndarray, greedy_only: bool = True) -> np.ndarray:
+                seeds: np.ndarray, greedy_only: bool = True,
+                adapter_ids: Optional[np.ndarray] = None) -> np.ndarray:
         """A batch of prefill chunks (shapes padded: tokens (P, S), tables
         (P, M), slot_mapping (P*S,)). Each chunk's next token is sampled in
         the same dispatch; returns (P,) host tokens."""
+        use_lora = adapter_ids is not None and self.lora_bank is not None
         with jax.set_mesh(self.mesh):
             self.kv, sampled = self._prefill(
                 self.params, self.kv,
@@ -277,6 +282,9 @@ class ModelRunner:
                 jnp.asarray(slot_mapping), jnp.asarray(last_idx),
                 jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
                 jnp.asarray(seeds),
+                lora_bank=self.lora_bank if use_lora else None,
+                adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
+                             if use_lora else None),
                 greedy_only=greedy_only,
             )
         return np.asarray(jax.device_get(sampled))
@@ -314,7 +322,8 @@ class ModelRunner:
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
                      greedy_only: bool = False,
-                     presence=None, frequency=None) -> np.ndarray:
+                     presence=None, frequency=None,
+                     adapter_ids=None) -> np.ndarray:
         """multi_step fused decode+sample iterations; returns sampled tokens
         (num_steps, B) on host. ``greedy_only`` selects the argmax-only
         compiled variant; presence/frequency arrays activate the penalised
@@ -329,6 +338,7 @@ class ModelRunner:
             counts = jnp.zeros((tokens.shape[0], 1), jnp.int32)  # placeholder
             pres = jnp.zeros(tokens.shape[0], jnp.float32)
             freq = pres
+        use_lora = adapter_ids is not None and self.lora_bank is not None
         with jax.set_mesh(self.mesh):
             (self.kv, new_counts), sampled = self._decode_multi(
                 self.params, self.kv,
@@ -338,6 +348,8 @@ class ModelRunner:
                 jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
                 jnp.asarray(seeds), jnp.asarray(steps),
                 counts, pres, freq,
+                self.lora_bank if use_lora else None,
+                (jnp.asarray(adapter_ids, jnp.int32) if use_lora else None),
                 block_size=self.config.cache.block_size,
                 greedy_only=greedy_only,
                 use_penalties=use_penalties,
@@ -345,6 +357,37 @@ class ModelRunner:
         if use_penalties:
             self.token_counts = new_counts
         return np.asarray(jax.device_get(sampled))
+
+    # -- multi-LoRA bank -----------------------------------------------------
+    def register_lora(self, slot: int, bank_np: dict) -> None:
+        """Write an adapter's stacked (A, B) pairs into bank slot ``slot``."""
+        N = self.config.max_loras
+        dt = self.cfg.jax_dtype
+        if self.lora_bank is None:
+            self.lora_bank = {}
+        with jax.set_mesh(self.mesh):
+            for key, (A_st, B_st) in bank_np.items():
+                if key not in self.lora_bank:
+                    L = A_st.shape[0]
+                    self.lora_bank[key] = (
+                        jnp.zeros((L, N, *A_st.shape[1:]), dt),
+                        jnp.zeros((L, N, *B_st.shape[1:]), dt),
+                    )
+                A_dev, B_dev = self.lora_bank[key]
+                self.lora_bank[key] = (
+                    A_dev.at[:, slot].set(jnp.asarray(A_st, dt)),
+                    B_dev.at[:, slot].set(jnp.asarray(B_st, dt)),
+                )
+
+    def unregister_lora(self, slot: int) -> None:
+        if self.lora_bank is None:
+            return
+        with jax.set_mesh(self.mesh):
+            for key, (A_dev, B_dev) in self.lora_bank.items():
+                self.lora_bank[key] = (
+                    A_dev.at[:, slot].set(0.0),
+                    B_dev.at[:, slot].set(0.0),
+                )
 
     def apply_param_deltas(self, deltas: dict, sign: float) -> dict:
         """In-place add/subtract stacked layer deltas (LoRA merge/unmerge).
@@ -404,9 +447,20 @@ class ModelRunner:
 # pure device functions (cfg static, attend closed over)
 # ---------------------------------------------------------------------------
 
+def _make_lora(lora_bank, adapter_ids, T: int):
+    """Build the forward-pass lora pytree (or None)."""
+    if lora_bank is None or adapter_ids is None:
+        return None
+    N = next(iter(lora_bank.values()))[0].shape[1]
+    oh = jax.nn.one_hot(adapter_ids, N, dtype=jnp.float32)  # (P, N)
+    onehot = jnp.broadcast_to(oh[:, None, :], (oh.shape[0], T, N))
+    return {"onehot": onehot, "bank": lora_bank}
+
+
 def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
                   block_tables, context_lens, slot_mapping, last_idx,
-                  temps, top_ps, top_ks, seeds, greedy_only: bool = False):
+                  temps, top_ps, top_ks, seeds, lora_bank=None,
+                  adapter_ids=None, greedy_only: bool = False):
     """Batched prefill chunks + fused first-token sampling.
 
     tokens/positions: (P, S); block_tables (P, M); context_lens (P,) with 0
@@ -424,7 +478,8 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
         )
 
     hidden, new_kv = model.forward_tokens(
-        cfg, params, tokens, positions, attend, kv
+        cfg, params, tokens, positions, attend, kv,
+        lora=_make_lora(lora_bank, adapter_ids, tokens.shape[1]),
     )
     last_hidden = jnp.take_along_axis(
         hidden, last_idx[:, None, None], axis=1
@@ -463,6 +518,7 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
                        tokens, positions, block_tables, context_lens,
                        slot_mapping, temps, top_ps, top_ks, seeds, steps,
                        token_counts, presence, frequency,
+                       lora_bank=None, adapter_ids=None, *,
                        block_size: int, greedy_only: bool = False,
                        use_penalties: bool = False):
     """``num_steps`` fused decode+sample iterations in ONE dispatch.
@@ -487,7 +543,8 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             )
 
         hidden, kv = model.forward_tokens(
-            cfg, params, tok[:, None], pos[:, None], attend, kv
+            cfg, params, tok[:, None], pos[:, None], attend, kv,
+            lora=_make_lora(lora_bank, adapter_ids, 1),
         )
         logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]
         if use_penalties:

@@ -35,6 +35,8 @@ class Sequence:
     sampling: SamplingParams
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
+    adapter_slot: int = 0  # multi-LoRA bank slot; 0 = base model
+
     output_token_ids: list[int] = dataclasses.field(default_factory=list)
     status: SequenceStatus = SequenceStatus.WAITING
     block_ids: list[int] = dataclasses.field(default_factory=list)

@@ -165,7 +165,10 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "prompt too long"}}, status=400
             )
-        gen = self.async_engine.generate(prompt_ids, sampling, rid)
+        gen = self.async_engine.generate(
+            prompt_ids, sampling, rid,
+            adapter_slot=self.lora.slot_of(body.get("model", "")),
+        )
         tk = self.engine.tokenizer
 
         if body.get("stream"):
@@ -475,7 +478,10 @@ class EngineServer:
             await self._maybe_import_kv(body, prompt_ids)
         produce_kv = bool(kv_params.get("do_remote_decode"))
 
-        gen = self.async_engine.generate(prompt_ids, sampling, rid)
+        gen = self.async_engine.generate(
+            prompt_ids, sampling, rid,
+            adapter_slot=self.lora.slot_of(model),
+        )
         if stream:
             return await self._stream_response(
                 request, gen, rid, created, model, chat, t_start, sampling
