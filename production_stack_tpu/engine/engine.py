@@ -70,7 +70,7 @@ class LLMEngine:
         self._presence = np.zeros(B, np.float32)
         self._frequency = np.zeros(B, np.float32)
         self._adapter_ids = np.zeros(B, np.int32)
-        self._count_reset_slots: list[int] = []
+        self._count_reset_slots: list[Sequence] = []
         self._slot_seq: dict[int, Sequence] = {}
         # metrics
         self.total_prompt_tokens = 0
@@ -244,7 +244,9 @@ class LLMEngine:
             self._slot_seq[seq.slot] = seq
             s = seq.sampling
             if s.presence_penalty or s.frequency_penalty:
-                self._count_reset_slots.append(seq.slot)
+                # fresh prompt: the prefill-sampled token below must count;
+                # recompute: restore the full output history
+                self._count_reset_slots.append(seq)
             if seq.output_token_ids:
                 # preemption-recompute: context rebuilt, newest token still
                 # the pending decode input — nothing sampled this step
@@ -289,7 +291,9 @@ class LLMEngine:
             for s in decodes
         )
         if use_penalties and self._count_reset_slots:
-            self.runner.reset_count_rows(self._count_reset_slots)
+            for seq in self._count_reset_slots:
+                if seq.slot >= 0:
+                    self.runner.set_count_row(seq.slot, seq.output_token_ids)
             self._count_reset_slots.clear()
         sampled = self.runner.decode_multi(
             self._tokens, self._positions, self._block_tables,
@@ -519,6 +523,15 @@ class LLMEngine:
         batch = [rng.integers(1, vocab, small).tolist()
                  for _ in range(max(sched.prefill_batch, 2))]
         run(batch, 0.7)
+        # penalised decode variant (static use_penalties flag)
+        sp = SamplingParams(temperature=0.0, presence_penalty=0.5,
+                            max_tokens=max(sched.multi_step, 1) + 1,
+                            ignore_eos=True)
+        self.add_request(f"warmup-pen-{time.monotonic_ns()}",
+                         prompt_token_ids=rng.integers(1, vocab, 8).tolist(),
+                         sampling=sp)
+        while self.has_unfinished():
+            self.step()
 
     # -- convenience for tests / offline use ---------------------------------
     def generate(

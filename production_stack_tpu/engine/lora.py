@@ -1,13 +1,13 @@
-"""LoRA adapter loading: the engine-side contract behind the reference's
-LoraAdapter operator (it downloads adapters and POSTs
+"""Multi-LoRA adapter loading: the engine-side contract behind the
+reference's LoraAdapter operator (it downloads adapters and POSTs
 /v1/load_lora_adapter // /v1/unload_lora_adapter to each engine pod —
 loadadapter_controller.go:553-574).
 
-Round-1 semantics: merge-on-load. The adapter's low-rank pairs are expanded
-(delta = B @ A * alpha/r) and added into the served weights; unload
-subtracts them back. One adapter live at a time per target module set —
-exact for the single-adapter fleet placements the operator performs;
-per-request multi-adapter batching is a later milestone.
+Adapters load UNMERGED into a device bank (slot 0 = base model) and every
+request selects its adapter per token, so one batch freely mixes base and
+any adapters (see models/llama.py:_lora_delta). Loading is a control-plane
+operation: the first load also warms the LoRA compiled variants so no
+serving request pays the compile.
 
 Adapter format: HF PEFT directory — adapter_config.json +
 adapter_model.safetensors with ``...layers.N.<module>.lora_A.weight`` (r, in)
@@ -16,7 +16,6 @@ and ``lora_B.weight`` (out, r) tensors.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 import re
@@ -38,77 +37,6 @@ _TARGETS = {
 }
 
 _KEY_RE = re.compile(r"layers\.(\d+)\.(?:self_attn|mlp)\.(\w+)\.lora_(A|B)\.weight")
-
-
-@dataclasses.dataclass
-class LoraAdapter:
-    name: str
-    path: str
-    scaling: float
-    # our param key -> stacked delta (L, *param_shape[1:]) float32
-    deltas: dict[str, np.ndarray]
-    # the delta that actually landed after serving-dtype rounding; unmerge
-    # subtracts this so base weights restore exactly
-    effective: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
-
-
-def _convert_delta(rule: str, delta: np.ndarray, cfg: ModelConfig) -> np.ndarray:
-    """(out, in) torch-linear delta → our param orientation."""
-    H, KH, D, E = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.hidden_size
-    if rule == "t":
-        return delta.T
-    if rule == "proj_q":
-        return delta.reshape(H, D, E).transpose(2, 0, 1)
-    if rule == "proj_kv":
-        return delta.reshape(KH, D, E).transpose(2, 0, 1)
-    if rule == "proj_o":
-        return delta.reshape(E, H, D).transpose(1, 2, 0)
-    raise ValueError(rule)
-
-
-def load_adapter(name: str, path: str, cfg: ModelConfig) -> LoraAdapter:
-    from safetensors import safe_open
-
-    cfg_path = os.path.join(path, "adapter_config.json")
-    scaling = 1.0
-    if os.path.exists(cfg_path):
-        with open(cfg_path) as f:
-            acfg = json.load(f)
-        r = acfg.get("r", 8)
-        scaling = acfg.get("lora_alpha", r) / max(r, 1)
-
-    st_path = os.path.join(path, "adapter_model.safetensors")
-    pairs: dict[tuple[int, str], dict[str, np.ndarray]] = {}
-    with safe_open(st_path, framework="np") as f:
-        for key in f.keys():
-            m = _KEY_RE.search(key)
-            if not m:
-                continue
-            layer, module, ab = int(m.group(1)), m.group(2), m.group(3)
-            if module not in _TARGETS:
-                continue
-            pairs.setdefault((layer, module), {})[ab] = f.get_tensor(key)
-
-    per_target: dict[str, dict[int, np.ndarray]] = {}
-    for (layer, module), ab in pairs.items():
-        if "A" not in ab or "B" not in ab:
-            continue
-        delta = (ab["B"].astype(np.float32) @ ab["A"].astype(np.float32)) * scaling
-        our_key, rule = _TARGETS[module]
-        per_target.setdefault(our_key, {})[layer] = _convert_delta(
-            rule, delta, cfg
-        )
-
-    deltas: dict[str, np.ndarray] = {}
-    for our_key, by_layer in per_target.items():
-        sample = next(iter(by_layer.values()))
-        stacked = np.zeros((cfg.num_layers, *sample.shape), np.float32)
-        for layer, d in by_layer.items():
-            stacked[layer] = d
-        deltas[our_key] = stacked
-    if not deltas:
-        raise ValueError(f"adapter at {path!r} has no supported LoRA targets")
-    return LoraAdapter(name=name, path=path, scaling=scaling, deltas=deltas)
 
 
 def load_adapter_raw(name: str, path: str, cfg: ModelConfig,
@@ -207,6 +135,27 @@ class LoraManager:
         slot = free[0]
         self.engine.runner.register_lora(slot, bank)
         self.slots[name] = slot
+        if len(self.slots) == 1:
+            self._warm(slot)  # compile the LoRA variants at load time
+
+    def _warm(self, slot: int) -> None:
+        """Run a tiny generation with the adapter so the LoRA prefill/decode
+        programs compile now (control plane) instead of mid-traffic."""
+        import time as _time
+
+        from production_stack_tpu.engine.sampling import SamplingParams
+
+        eng = self.engine
+        sp = SamplingParams(
+            temperature=0.0,
+            max_tokens=max(eng.config.scheduler.multi_step, 1) + 1,
+            ignore_eos=True,
+        )
+        eng.add_request(f"lora-warm-{_time.monotonic_ns()}",
+                        prompt_token_ids=[1, 2, 3], sampling=sp,
+                        adapter_slot=slot)
+        while eng.has_unfinished():
+            eng.step()
 
     def unload(self, name: str) -> bool:
         slot = self.slots.pop(name, None)

@@ -309,15 +309,26 @@ class ModelRunner:
                     (self.config.scheduler.max_num_seqs, self.cfg.vocab_size),
                     jnp.int32,
                 )
+            # jitted once; compiled per shape, not per call
+            self._set_count_row_fn = jax.jit(
+                lambda c, slot, row: c.at[slot].set(row),
+                donate_argnums=(0,),
+            )
 
-    def reset_count_rows(self, slots: list[int]) -> None:
-        """Zero the output-token counts of freshly (re)assigned slots."""
+    def set_count_row(self, slot: int, token_ids: list[int]) -> None:
+        """(Re)build one slot's output-token counts — fresh sequences count
+        their prefill-sampled first token; preemption-recompute restores the
+        whole history so penalties don't forget."""
         self._ensure_counts()
-        idx = jnp.asarray(slots, jnp.int32)
+        row = np.zeros(self.cfg.vocab_size, np.int32)
+        for t in token_ids:
+            if 0 <= t < self.cfg.vocab_size:
+                row[t] += 1
         with jax.set_mesh(self.mesh):
-            self.token_counts = jax.jit(
-                lambda c, s: c.at[s].set(0), donate_argnums=(0,)
-            )(self.token_counts, idx)
+            self.token_counts = self._set_count_row_fn(
+                self.token_counts, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(row),
+            )
 
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
@@ -388,31 +399,6 @@ class ModelRunner:
                     A_dev.at[:, slot].set(0.0),
                     B_dev.at[:, slot].set(0.0),
                 )
-
-    def apply_param_deltas(self, deltas: dict, sign: float) -> dict:
-        """In-place add/subtract stacked layer deltas (LoRA merge/unmerge).
-
-        Returns the EFFECTIVE applied delta per key (new − old in float32,
-        i.e. after serving-dtype rounding): unmerging must subtract that —
-        subtracting the requested fp32 delta from bf16-rounded weights would
-        drift the base model a little further on every adapter swap."""
-        def _apply(layers, **host_deltas):
-            out = dict(layers)
-            eff = {}
-            for key, d in host_deltas.items():
-                old = layers[key].astype(jnp.float32)
-                new = (old + sign * d).astype(layers[key].dtype)
-                out[key] = new
-                eff[key] = new.astype(jnp.float32) - old
-            return out, eff
-
-        with jax.set_mesh(self.mesh):
-            new_layers, eff = jax.jit(_apply, donate_argnums=(0,))(
-                self.params["layers"],
-                **{k: jnp.asarray(v) for k, v in deltas.items()},
-            )
-        self.params = dict(self.params, layers=new_layers)
-        return {k: np.asarray(jax.device_get(v)) for k, v in eff.items()}
 
     # -- KV block export/import (disaggregated prefill→decode transfer) -----
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:

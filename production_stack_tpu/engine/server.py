@@ -158,6 +158,8 @@ class EngineServer:
             chat.append({"role": m.get("role", "user"), "content": content})
         prompt = self._render_chat(chat)
         prompt_ids = self.engine.tokenizer.encode(prompt)
+        if body.get("stop_sequences"):  # Anthropic-spec field name
+            body = dict(body, stop=body["stop_sequences"])
         sampling = _sampling_from_body(body)
         rid = f"msg_{uuid.uuid4().hex[:24]}"
 
@@ -200,12 +202,19 @@ class EngineServer:
                 token_ids.extend(out.new_token_ids)
                 n_out = out.num_output_tokens
                 text = tk.decode(token_ids)
+                stopped = self._check_stop_str(text, sampling)
+                if stopped is not None:
+                    self.async_engine.abort(rid)
+                    text = stopped
+                    finish = "stop_sequence"
                 if len(text) > sent:
                     await ev("content_block_delta", {
                         "type": "content_block_delta", "index": 0,
                         "delta": {"type": "text_delta", "text": text[sent:]},
                     })
                     sent = len(text)
+                if stopped is not None:
+                    break
                 if out.finished:
                     finish = ("max_tokens" if out.finish_reason == "length"
                               else "end_turn")
@@ -222,22 +231,34 @@ class EngineServer:
 
         token_ids = []
         finish = "end_turn"
+        text = ""
         async for out in gen:
             token_ids.extend(out.new_token_ids)
+            text = tk.decode(token_ids)
+            stopped = self._check_stop_str(text, sampling)
+            if stopped is not None:
+                self.async_engine.abort(rid)
+                text = stopped
+                finish = "stop_sequence"
+                break
             if out.finished:
                 finish = ("max_tokens" if out.finish_reason == "length"
                           else "end_turn")
         return web.json_response({
             "id": rid, "type": "message", "role": "assistant",
             "model": body.get("model", self.model_name),
-            "content": [{"type": "text", "text": tk.decode(token_ids)}],
+            "content": [{"type": "text", "text": text}],
             "stop_reason": finish,
             "usage": {"input_tokens": len(prompt_ids),
                       "output_tokens": len(token_ids)},
         })
 
     async def embeddings(self, request: web.Request) -> web.Response:
-        body = await request.json()
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
         inputs = body.get("input")
         if inputs is None:
             return web.json_response(
@@ -245,6 +266,8 @@ class EngineServer:
             )
         if isinstance(inputs, str):
             inputs = [inputs]
+        elif isinstance(inputs, list) and inputs and isinstance(inputs[0], int):
+            inputs = [inputs]  # a single pre-tokenized prompt
         tk = self.engine.tokenizer
         data = []
         total_tokens = 0

@@ -262,9 +262,22 @@ class RouterApp:
             self.pii_middleware = PIIMiddleware()
 
     # -- app --------------------------------------------------------------
+    # endpoints that must stay reachable without a key (probes + scraping)
+    _OPEN_PATHS = {"/health", "/metrics", "/version"}
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        if request.path not in self._OPEN_PATHS:
+            denied = self._check_api_key(request)
+            if denied is not None:
+                return denied
+        return await handler(request)
+
     def build_app(self) -> web.Application:
         self.initialize()
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        middlewares = [self._auth_middleware] if self._api_keys else []
+        app = web.Application(client_max_size=256 * 1024 * 1024,
+                              middlewares=middlewares)
         for path in PROXY_POST_PATHS:
             app.router.add_post(path, self._make_proxy(path))
         app.router.add_post("/tokenize", self._make_proxy("/tokenize"))
@@ -316,9 +329,6 @@ class RouterApp:
 
     def _make_proxy(self, path: str):
         async def handler(request: web.Request) -> web.StreamResponse:
-            denied = self._check_api_key(request)
-            if denied is not None:
-                return denied
             if self.pii_middleware is not None:
                 blocked = await self.pii_middleware.check(request)
                 if blocked is not None:

@@ -223,8 +223,16 @@ def test_api_key_auth():
                 headers={"Authorization": "Bearer sk-valid-key"},
             )
             assert r.status == 200
-            # health stays open for probes
+            # control-plane endpoints are guarded too (a keyless /sleep
+            # would be a fleet-wide DoS)
+            r = await client.post("/sleep")
+            assert r.status == 401
+            r = await client.get("/v1/models")
+            assert r.status == 401
+            # health/metrics stay open for probes and scraping
             r = await client.get("/health")
+            assert r.status == 200
+            r = await client.get("/metrics")
             assert r.status == 200
         finally:
             await teardown(servers, client)
