@@ -1,0 +1,120 @@
+"""Dynamic config hot-reload + callbacks + external providers (reference
+tiers: dynamic_config tests, custom-callback loading, provider registry)."""
+
+import asyncio
+import json
+import sys
+import tempfile
+import types
+
+from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
+from production_stack_tpu.router.routing import (
+    PrefixAwareRouter,
+    RoundRobinRouter,
+    get_routing_logic,
+    initialize_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    StaticServiceDiscovery,
+    get_service_discovery,
+    initialize_service_discovery,
+)
+
+
+def test_dynamic_config_reconfigures_discovery_and_routing():
+    async def main():
+        initialize_service_discovery(
+            StaticServiceDiscovery(["http://old:8000"], ["m"])
+        )
+        initialize_routing_logic("roundrobin")
+        assert isinstance(get_routing_logic(), RoundRobinRouter)
+
+        cfg_file = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False)
+        json.dump({
+            "static_backends": "http://new1:8000,http://new2:8000",
+            "static_models": "m2",
+            "routing_logic": "prefixaware",
+            "prefix_min_match_length": 128,
+        }, cfg_file)
+        cfg_file.close()
+
+        watcher = DynamicConfigWatcher(cfg_file.name, interval=0.05)
+        await watcher.start()
+        try:
+            urls = {e.url for e in get_service_discovery().get_endpoint_info()}
+            assert urls == {"http://new1:8000", "http://new2:8000"}
+            assert get_service_discovery().get_endpoint_info()[0].model_names == ["m2"]
+            router = get_routing_logic()
+            assert isinstance(router, PrefixAwareRouter)
+            assert router.min_match == 128
+            # known models survive the swap (scale-to-zero 503 semantics)
+            assert "m" in get_service_discovery().known_models
+
+            # touch the file with a new routing logic → live reconfigure
+            with open(cfg_file.name, "w") as f:
+                json.dump({"routing_logic": "roundrobin"}, f)
+            import os
+
+            os.utime(cfg_file.name, (9999999999, 9999999999))
+            for _ in range(100):
+                if isinstance(get_routing_logic(), RoundRobinRouter):
+                    break
+                await asyncio.sleep(0.05)
+            assert isinstance(get_routing_logic(), RoundRobinRouter)
+        finally:
+            await watcher.stop()
+
+    asyncio.run(main())
+
+
+def test_custom_callbacks_short_circuit_and_post():
+    from production_stack_tpu.router.services.callbacks import load_callbacks
+
+    mod = types.ModuleType("my_callbacks")
+    calls = {"post": 0}
+
+    class Handler:
+        def pre_request(self, request, body):
+            if body.get("blockme"):
+                return {"blocked": True}
+            return None
+
+        def post_request(self, request, body, tail):
+            calls["post"] += 1
+
+    mod.handler = Handler()
+    sys.modules["my_callbacks"] = mod
+    try:
+        h = load_callbacks("my_callbacks.handler")
+        assert h.pre_request(None, {"blockme": 1}) == {"blocked": True}
+        assert h.pre_request(None, {}) is None
+        h.post_request(None, {}, b"")
+        assert calls["post"] == 1
+    finally:
+        del sys.modules["my_callbacks"]
+
+
+def test_external_provider_registry_parsing(tmp_path):
+    from production_stack_tpu.router.services.external_providers import (
+        ExternalProviderRegistry,
+    )
+
+    cfg = tmp_path / "providers.yaml"
+    cfg.write_text(
+        """
+providers:
+  - name: openai
+    base_url: https://api.example.com/v1
+    api_key: test-key
+    models:
+      - id: gpt-4o
+        alias: my-gpt
+"""
+    )
+    reg = ExternalProviderRegistry.from_yaml(str(cfg))
+    assert reg.handles("gpt-4o") and reg.handles("my-gpt")
+    assert not reg.handles("llama")
+    assert reg.model_ids() == ["gpt-4o", "my-gpt"]
+    assert reg.model_to_provider["gpt-4o"].headers() == {
+        "Authorization": "Bearer test-key"
+    }
