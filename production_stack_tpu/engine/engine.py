@@ -10,6 +10,8 @@ stack assumes exists underneath it (SURVEY.md §7 step 1).
 
 from __future__ import annotations
 
+import dataclasses
+import os
 import time
 from typing import Optional, Sequence as Seq
 
@@ -98,6 +100,14 @@ class LLMEngine:
         sampling = (sampling or SamplingParams()).clamped(
             self.config.model.max_model_len, len(prompt_token_ids)
         )
+        if sampling.seed is None:
+            # unseeded sampling must be nondeterministic (OpenAI/vLLM
+            # semantics): identical concurrent prompts must not draw the
+            # same Gumbel noise. User-provided seeds (including 0) are kept.
+            sampling = dataclasses.replace(
+                sampling,
+                seed=int.from_bytes(os.urandom(4), "little"),
+            )
         seq = Sequence(request_id, list(prompt_token_ids), sampling,
                        adapter_slot=adapter_slot)
         self.scheduler.add(seq)

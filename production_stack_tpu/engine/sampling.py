@@ -64,12 +64,17 @@ def sample_tokens(
     k = jnp.where(top_ks <= 0, C, jnp.minimum(top_ks, C))
     keep_topk = ranks < k[:, None]
 
-    probs = jax.nn.softmax(vals, axis=-1)
+    # top-k first, renormalize, then top-p over the surviving mass (vLLM
+    # order) — mass of tokens top-k excludes must not count toward the
+    # top-p prefix.
+    vals_k = jnp.where(keep_topk, vals, NEG_INF)
+    probs = jax.nn.softmax(vals_k, axis=-1)
     cumsum = jnp.cumsum(probs, axis=-1)
-    # keep the smallest prefix whose mass >= top_p (always keep rank 0)
-    keep_topp = (cumsum - probs) < top_ps[:, None]
+    # keep the smallest prefix whose mass >= top_p; rank 0 is kept
+    # explicitly so top_p=0 degenerates to greedy, not uniform-over-C
+    keep_topp = ((cumsum - probs) < top_ps[:, None]) | (ranks == 0)
 
-    masked = jnp.where(keep_topk & keep_topp, vals, NEG_INF)
+    masked = jnp.where(keep_topp, vals_k, NEG_INF)
 
     def _one(row, seed, step):
         key = jax.random.fold_in(jax.random.key(seed), step)

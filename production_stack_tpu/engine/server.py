@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
 import time
 import uuid
@@ -36,18 +37,46 @@ def _sampling_from_body(body: dict) -> SamplingParams:
     stop = body.get("stop") or ()
     if isinstance(stop, str):
         stop = (stop,)
+    seed = body.get("seed")
+    if seed is not None:
+        seed = int(seed) & 0xFFFFFFFF  # device seeds are uint32
+    n = body.get("n")
     return SamplingParams(
         max_tokens=int(body.get("max_tokens") or 16),
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", -1)),
-        seed=body.get("seed"),
+        seed=seed,
         stop=tuple(stop),
         stop_token_ids=tuple(body.get("stop_token_ids") or ()),
         ignore_eos=bool(body.get("ignore_eos", False)),
+        n=int(n) if n is not None else 1,  # n=0 must reach the validator
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
     )
+
+
+MAX_CHOICES = 128  # OpenAI caps n at 128; batched prompts share the cap
+
+
+def _tokens_covering(tk, token_ids: list, text_len: int) -> int:
+    """Smallest token prefix whose decode covers ``text_len`` chars.
+
+    Used to report completion_tokens up to a stop-string cut instead of
+    counting generated-but-discarded tokens. Binary search: decoded length
+    is monotone non-decreasing in the token-prefix length."""
+    if text_len <= 0 or not token_ids:
+        return 0
+    if len(tk.decode(token_ids)) < text_len:
+        return len(token_ids)
+    lo, hi = 1, len(token_ids)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if len(tk.decode(token_ids[:mid])) >= text_len:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
 
 
 class EngineServer:
@@ -460,7 +489,7 @@ class EngineServer:
                 {"error": {"message": "'messages' is required"}}, status=400
             )
         prompt = self._render_chat(body["messages"])
-        return await self._run(request, body, prompt, chat=True)
+        return await self._run(request, body, [prompt], chat=True)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -472,64 +501,140 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "'prompt' is required"}}, status=400
             )
-        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
-            prompt = prompt[0]  # single-prompt batch only (parity: router sends one)
-        return await self._run(request, body, prompt, chat=False)
-
-    async def _run(self, request: web.Request, body: dict, prompt,
-                   chat: bool) -> web.StreamResponse:
-        sampling = _sampling_from_body(body)
-        tk = self.engine.tokenizer
+        # OpenAI accepts: str | [str, ...] | [int, ...] (one tokenized
+        # prompt) | [[int, ...], ...] (a batch of tokenized prompts). Batched
+        # prompts fan out into concurrent engine requests (one choice per
+        # prompt x n).
         if isinstance(prompt, str):
-            prompt_ids = tk.encode(prompt)
+            prompts = [prompt]
+        elif isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            prompts = [prompt]
+        elif (isinstance(prompt, list) and prompt
+              and all(isinstance(p, (str, list)) for p in prompt)):
+            prompts = prompt
         else:
-            prompt_ids = list(prompt)
+            return web.json_response(
+                {"error": {"message": "invalid 'prompt': expected string, "
+                           "token list, or batch thereof",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        return await self._run(request, body, prompts, chat=False)
+
+    async def _run(self, request: web.Request, body: dict, prompts: list,
+                   chat: bool) -> web.StreamResponse:
+        try:
+            sampling = _sampling_from_body(body)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid sampling parameter: {e}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if sampling.n < 1 or sampling.n * len(prompts) > MAX_CHOICES:
+            return web.json_response(
+                {"error": {"message":
+                           f"n x prompt batch size must be in [1, {MAX_CHOICES}]",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        tk = self.engine.tokenizer
+        prompt_ids_list = [
+            tk.encode(p) if isinstance(p, str) else list(p) for p in prompts
+        ]
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex}"
         created = int(time.time())
         model = body.get("model", self.model_name)
         stream = bool(body.get("stream", False))
         t_start = time.monotonic()
 
-        if len(prompt_ids) > self.config.model.max_model_len - 1:
+        for prompt_ids in prompt_ids_list:
+            if len(prompt_ids) > self.config.model.max_model_len - 1:
+                return web.json_response(
+                    {"error": {"message": "prompt too long", "type": "invalid_request_error"}},
+                    status=400,
+                )
+
+        n = max(1, int(sampling.n))
+        nchoices = len(prompt_ids_list) * n
+
+        produce_kv = False
+        kv_params = body.get("kv_transfer_params") or {}
+        if nchoices == 1:  # disagg handoff is defined per single request
+            if kv_params.get("remote_block_ids"):
+                await self._maybe_import_kv(body, prompt_ids_list[0])
+            produce_kv = bool(kv_params.get("do_remote_decode"))
+        elif kv_params:
             return web.json_response(
-                {"error": {"message": "prompt too long", "type": "invalid_request_error"}},
+                {"error": {"message":
+                           "kv_transfer_params requires n=1 and a single prompt",
+                           "type": "invalid_request_error"}},
                 status=400,
             )
 
-        kv_params = body.get("kv_transfer_params") or {}
-        if kv_params.get("remote_block_ids"):
-            await self._maybe_import_kv(body, prompt_ids)
-        produce_kv = bool(kv_params.get("do_remote_decode"))
-
-        gen = self.async_engine.generate(
-            prompt_ids, sampling, rid,
-            adapter_slot=self.lora.slot_of(model),
-        )
+        adapter_slot = self.lora.slot_of(model)
+        gens, rids = [], []
+        for pi, prompt_ids in enumerate(prompt_ids_list):
+            for j in range(n):
+                idx = pi * n + j
+                crid = rid if nchoices == 1 else f"{rid}-{idx}"
+                rids.append(crid)
+                choice_sampling = sampling
+                if sampling.seed is not None and nchoices > 1:
+                    # seeded n>1 must still yield distinct choices
+                    # (OpenAI/vLLM): derive a per-choice seed
+                    choice_sampling = dataclasses.replace(
+                        sampling, seed=(sampling.seed + idx) & 0xFFFFFFFF
+                    )
+                gens.append(self.async_engine.generate(
+                    prompt_ids, choice_sampling, crid,
+                    adapter_slot=adapter_slot,
+                ))
+        n_prompt = sum(len(p) for p in prompt_ids_list)
         if stream:
+            so = body.get("stream_options")
+            so = so if isinstance(so, dict) else {}
             return await self._stream_response(
-                request, gen, rid, created, model, chat, t_start, sampling
+                request, gens, rids, rid, created, model, chat, t_start,
+                n_prompt, sampling,
+                include_usage=bool(so.get("include_usage")),
             )
         return await self._full_response(
-            gen, rid, created, model, chat, t_start, len(prompt_ids), sampling,
+            gens, rids, rid, created, model, chat, t_start, n_prompt, sampling,
             produce_kv=produce_kv,
         )
 
+    async def _abort_all(self, tasks, rids):
+        """Cancel sibling per-choice tasks (gather doesn't on failure), reap
+        them, and abort the engine requests. Returns the reaped results."""
+        for t in tasks:
+            t.cancel()
+        reaped = await asyncio.gather(*tasks, return_exceptions=True)
+        for r in rids:
+            self.async_engine.abort(r)
+        return reaped
+
     def _check_stop_str(self, text: str, sampling: SamplingParams):
+        # cut at the EARLIEST occurrence across all stop strings (vLLM/
+        # OpenAI), not the first stop in list order
+        cut = None
         for s in sampling.stop:
             idx = text.find(s)
-            if idx >= 0:
-                return text[:idx]
-        return None
+            if idx >= 0 and (cut is None or idx < cut):
+                cut = idx
+        return None if cut is None else text[:cut]
 
-    async def _full_response(self, gen, rid, created, model, chat, t_start,
-                             n_prompt, sampling, produce_kv=False) -> web.Response:
+    async def _full_response(self, gens, rids, rid, created, model, chat,
+                             t_start, n_prompt, sampling,
+                             produce_kv=False) -> web.Response:
         tk = self.engine.tokenizer
-        token_ids: list[int] = []
-        finish_reason = None
-        first_token_t = None
-        cached = 0
-        final_blocks = None
-        try:
+
+        async def collect(gen, crid):
+            token_ids: list[int] = []
+            finish_reason = None
+            first_token_t = None
+            cached = 0
+            final_blocks = None
             async for out in gen:
                 if first_token_t is None:
                     first_token_t = time.monotonic()
@@ -541,48 +646,64 @@ class EngineServer:
                 text = tk.decode(token_ids)
                 stopped = self._check_stop_str(text, sampling)
                 if stopped is not None:
-                    self.async_engine.abort(rid)
-                    text = stopped
-                    finish_reason = "stop"
-                    break
-            else:
-                text = tk.decode(token_ids)
+                    self.async_engine.abort(crid)
+                    # count only the tokens that contribute to the kept text
+                    n_kept = _tokens_covering(tk, token_ids, len(stopped))
+                    return (stopped, n_kept, "stop", first_token_t, cached,
+                            final_blocks)
+            return (tk.decode(token_ids), len(token_ids), finish_reason,
+                    first_token_t, cached, final_blocks)
+
+        tasks = [asyncio.ensure_future(collect(g, r))
+                 for g, r in zip(gens, rids)]
+        try:
+            results = await asyncio.gather(*tasks)
         except ValueError as e:
+            await self._abort_all(tasks, rids)
             return web.json_response(
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
                 status=400,
             )
         end = time.monotonic()
-        self.metrics.observe_request(t_start, first_token_t, end, len(token_ids))
+        first_times = [r[3] for r in results if r[3] is not None]
+        first_token_t = min(first_times) if first_times else None
+        n_completion = sum(r[1] for r in results)
+        self.metrics.observe_request(t_start, first_token_t, end, n_completion)
         usage = {
             "prompt_tokens": n_prompt,
-            "completion_tokens": len(token_ids),
-            "total_tokens": n_prompt + len(token_ids),
-            "prompt_tokens_details": {"cached_tokens": cached},
+            "completion_tokens": n_completion,
+            "total_tokens": n_prompt + n_completion,
+            # max, not sum: all n choices of one prompt hit the same cached
+            # prefix; summing would report cached > prompt_tokens
+            "prompt_tokens_details": {
+                "cached_tokens": max((r[4] for r in results), default=0)
+            },
         }
-        if chat:
-            choice = {
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": finish_reason or "stop",
-            }
-            obj = "chat.completion"
-        else:
-            choice = {
-                "index": 0,
-                "text": text,
-                "finish_reason": finish_reason or "stop",
-                "logprobs": None,
-            }
-            obj = "text_completion"
+        choices = []
+        for idx, (text, _n, finish_reason, _t, _c, _b) in enumerate(results):
+            if chat:
+                choices.append({
+                    "index": idx,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish_reason or "stop",
+                })
+            else:
+                choices.append({
+                    "index": idx,
+                    "text": text,
+                    "finish_reason": finish_reason or "stop",
+                    "logprobs": None,
+                })
+        obj = "chat.completion" if chat else "text_completion"
         payload = {
             "id": rid,
             "object": obj,
             "created": created,
             "model": model,
-            "choices": [choice],
+            "choices": choices,
             "usage": usage,
         }
+        final_blocks = results[0][5] if results else None
         if produce_kv and final_blocks:
             # producer side of the P→D handoff: hand the router/decoder the
             # block handles (reference: engine-native kv_transfer_params,
@@ -596,8 +717,9 @@ class EngineServer:
             }
         return web.json_response(payload)
 
-    async def _stream_response(self, request, gen, rid, created, model, chat,
-                               t_start, sampling) -> web.StreamResponse:
+    async def _stream_response(self, request, gens, rids, rid, created, model,
+                               chat, t_start, n_prompt, sampling,
+                               include_usage=False) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -609,48 +731,63 @@ class EngineServer:
         await resp.prepare(request)
         tk = self.engine.tokenizer
         obj = "chat.completion.chunk" if chat else "text_completion"
+        write_lock = asyncio.Lock()
 
         async def send(payload: dict) -> None:
-            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+            async with write_lock:
+                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
         if chat:
-            await send(
-                {
-                    "id": rid, "object": obj, "created": created, "model": model,
-                    "choices": [
-                        {"index": 0, "delta": {"role": "assistant"},
-                         "finish_reason": None}
-                    ],
-                }
-            )
+            for idx in range(len(gens)):
+                await send(
+                    {
+                        "id": rid, "object": obj, "created": created,
+                        "model": model,
+                        "choices": [
+                            {"index": idx, "delta": {"role": "assistant"},
+                             "finish_reason": None}
+                        ],
+                    }
+                )
 
-        token_ids: list[int] = []
-        sent_len = 0
-        first_token_t = None
-        finish_reason = None
-        n_out = 0
-        try:
+        # A stop sequence can span chunk boundaries; hold back enough trailing
+        # chars that a stop prefix is never streamed before it is confirmed
+        # not to be one.
+        holdback = max((len(s) for s in sampling.stop), default=1) - 1
+        shared = {"first_token_t": None}
+
+        async def stream_one(gen, crid, idx) -> int:
+            token_ids: list[int] = []
+            sent_len = 0
+            finish_reason = None
+            n_kept = 0
             async for out in gen:
-                if first_token_t is None:
-                    first_token_t = time.monotonic()
+                if shared["first_token_t"] is None:
+                    shared["first_token_t"] = time.monotonic()
                 token_ids.extend(out.new_token_ids)
-                n_out = out.num_output_tokens
                 text = tk.decode(token_ids)
                 stopped = self._check_stop_str(text, sampling)
                 if stopped is not None:
-                    self.async_engine.abort(rid)
+                    self.async_engine.abort(crid)
                     text = stopped
                     finish_reason = "stop"
-                delta = text[sent_len:]
-                sent_len = len(text)
-                if delta or out.finished or finish_reason:
+                    n_kept = _tokens_covering(tk, token_ids, len(stopped))
+                else:
+                    n_kept = len(token_ids)
+                done = out.finished or finish_reason is not None
+                limit = (len(text) if done or not holdback
+                         else max(sent_len, len(text) - holdback))
+                delta = text[sent_len:limit]
+                sent_len = limit
+                if delta or done:
                     fr = finish_reason or out.finish_reason
-                    done = out.finished or finish_reason is not None
                     if chat:
-                        choice = {"index": 0, "delta": {"content": delta} if delta else {},
+                        choice = {"index": idx,
+                                  "delta": {"content": delta} if delta else {},
                                   "finish_reason": fr if done else None}
                     else:
-                        choice = {"index": 0, "text": delta, "logprobs": None,
+                        choice = {"index": idx, "text": delta,
+                                  "logprobs": None,
                                   "finish_reason": fr if done else None}
                     await send(
                         {"id": rid, "object": obj, "created": created,
@@ -658,13 +795,39 @@ class EngineServer:
                     )
                 if finish_reason is not None:
                     break
+            return n_kept
+
+        n_out = 0
+        tasks = [asyncio.ensure_future(stream_one(g, r, i))
+                 for i, (g, r) in enumerate(zip(gens, rids))]
+        try:
+            kept = await asyncio.gather(*tasks)
+            n_out = sum(kept)
         except ValueError as e:
+            reaped = await self._abort_all(tasks, rids)
+            # count whatever completed choices managed to stream so the
+            # usage chunk / metrics don't report 0 for partial failures
+            n_out = sum(r for r in reaped if isinstance(r, int))
             await send({"error": {"message": str(e)}})
         except (ConnectionResetError, asyncio.CancelledError):
-            self.async_engine.abort(rid)
+            # cancel siblings before teardown so no task writes to the
+            # closed response
+            await self._abort_all(tasks, rids)
             raise
         end = time.monotonic()
-        self.metrics.observe_request(t_start, first_token_t, end, n_out)
+        self.metrics.observe_request(t_start, shared["first_token_t"], end,
+                                     n_out)
+        if include_usage:
+            # final usage chunk (OpenAI stream_options.include_usage shape)
+            await send({
+                "id": rid, "object": obj, "created": created, "model": model,
+                "choices": [],
+                "usage": {
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": n_out,
+                    "total_tokens": n_prompt + n_out,
+                },
+            })
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp

@@ -182,6 +182,16 @@ class RequestService:
 
         monitor = get_request_stats_monitor()
         stream = bool(body.get("stream", False))
+        strip_usage = False
+        if stream:
+            # ask the engine for the final usage chunk so streamed requests
+            # feed token accounting; if the client didn't request it, the
+            # chunk is stripped from the relayed stream (OpenAI parity)
+            so = body.get("stream_options")
+            so = so if isinstance(so, dict) else {}
+            if not so.get("include_usage"):
+                body = {**body, "stream_options": {**so, "include_usage": True}}
+                strip_usage = True
         monitor.on_new_request(url, request_id, time.time())
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
@@ -199,14 +209,14 @@ class RequestService:
         try:
             return await self._attempt(
                 request, endpoint_path, body, url, model, request_id, t_start,
-                monitor, stream, headers, span_cm,
+                monitor, stream, headers, span_cm, strip_usage=strip_usage,
             )
         finally:
             span_cm.__exit__(None, None, None)
 
     async def _attempt(self, request, endpoint_path, body, url, model,
                        request_id, t_start, monitor, stream, headers,
-                       span_cm) -> web.StreamResponse:
+                       span_cm, strip_usage=False) -> web.StreamResponse:
         try:
             backend = await self.session.post(
                 f"{url}{endpoint_path}", json=body, headers=headers
@@ -236,6 +246,10 @@ class RequestService:
         n_output_tokens = 0
         buffer = b""
         status_label = str(backend.status)
+        strip = (strip_usage and backend.status == 200
+                 and backend.headers.get("Content-Type", "")
+                 .startswith("text/event-stream"))
+        pending = b""
         try:
             await resp.prepare(request)
             async for chunk in backend.content.iter_any():
@@ -243,7 +257,21 @@ class RequestService:
                     monitor.on_request_response(url, request_id, time.time())
                     first = False
                 buffer = (buffer + chunk)[-65536:]  # tail only, usage lives there
-                await resp.write(chunk)
+                if not strip:
+                    await resp.write(chunk)
+                    continue
+                # SSE-event-aware relay: drop the router-injected usage-only
+                # chunk the client didn't ask for
+                pending += chunk
+                while True:
+                    event, sep, rest = _split_sse_event(pending)
+                    if sep is None:
+                        break
+                    pending = rest
+                    if not _is_usage_only_event(event):
+                        await resp.write(event + sep)
+            if pending:
+                await resp.write(pending)
             await resp.write_eof()
         except (ConnectionResetError, asyncio.CancelledError):
             status_label = "client_disconnect"
@@ -367,6 +395,34 @@ class BackendError(Exception):
     def __init__(self, kind: str, msg: str):
         super().__init__(msg)
         self.kind = kind
+
+
+def _split_sse_event(buf: bytes):
+    """Split off the first complete SSE event. SSE allows LF or CRLF line
+    endings, so the event delimiter is the earliest of \\n\\n / \\r\\n\\r\\n.
+    Returns (event, delimiter, rest) or (buf, None, b"")."""
+    i_lf = buf.find(b"\n\n")
+    i_crlf = buf.find(b"\r\n\r\n")
+    if i_crlf >= 0 and (i_lf < 0 or i_crlf < i_lf):
+        return buf[:i_crlf], b"\r\n\r\n", buf[i_crlf + 4:]
+    if i_lf >= 0:
+        return buf[:i_lf], b"\n\n", buf[i_lf + 2:]
+    return buf, None, b""
+
+
+def _is_usage_only_event(event: bytes) -> bool:
+    """True for the OpenAI include_usage final chunk: empty choices + usage."""
+    if b'"usage"' not in event:  # cheap pre-filter: skip JSON parse on the
+        return False             # per-token delta hot path
+    event = event.strip()
+    if not event.startswith(b"data: ") or event == b"data: [DONE]":
+        return False
+    try:
+        data = json.loads(event[6:])
+    except Exception:
+        return False
+    return isinstance(data, dict) and data.get("choices") == [] \
+        and data.get("usage") is not None
 
 
 def _extract_usage(tail: bytes, stream: bool) -> Optional[dict]:

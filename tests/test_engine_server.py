@@ -79,6 +79,79 @@ def test_completion_non_streaming(server):
     run(with_client(server, fn))
 
 
+def test_completion_batch_prompts_and_n(server):
+    """Batched prompt list x n fans out into one choice per (prompt, n)
+    with OpenAI index numbering and summed usage."""
+
+    async def fn(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": ["ab", "cd"], "n": 2,
+                  "max_tokens": 3, "temperature": 0, "ignore_eos": True},
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert [c["index"] for c in data["choices"]] == [0, 1, 2, 3]
+        # temperature 0: both choices of one prompt are identical
+        assert data["choices"][0]["text"] == data["choices"][1]["text"]
+        assert data["usage"]["completion_tokens"] == 12
+
+    run(with_client(server, fn))
+
+
+def test_unseeded_sampling_is_nondeterministic(server):
+    async def fn(client):
+        texts = []
+        for _ in range(2):
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "same prompt", "max_tokens": 12,
+                      "temperature": 1.0, "ignore_eos": True},
+            )
+            texts.append((await r.json())["choices"][0]["text"])
+        assert texts[0] != texts[1]
+
+    run(with_client(server, fn))
+
+
+def test_stop_string_usage_and_stream_holdback(server):
+    async def fn(client):
+        base = {"prompt": "xyz", "max_tokens": 10, "temperature": 0,
+                "ignore_eos": True}
+        r = await client.post("/v1/completions", json=base)
+        full = (await r.json())["choices"][0]["text"]
+        assert len(full) >= 4
+        stop = full[2:4]
+        kept = full[: full.find(stop)]
+
+        r = await client.post("/v1/completions", json={**base, "stop": stop})
+        data = await r.json()
+        assert data["choices"][0]["text"] == kept
+        assert data["choices"][0]["finish_reason"] == "stop"
+        # usage counts only tokens up to the stop cut
+        assert data["usage"]["completion_tokens"] <= len(kept) + 1
+
+        # streaming must never leak any part of the stop string
+        r = await client.post(
+            "/v1/completions",
+            json={**base, "stop": stop, "stream": True,
+                  "stream_options": {"include_usage": True}},
+        )
+        deltas, usage = [], None
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunk = json.loads(line[6:])
+                if chunk.get("usage") is not None:
+                    usage = chunk["usage"]
+                for c in chunk.get("choices", []):
+                    deltas.append(c.get("text") or "")
+        assert "".join(deltas) == kept
+        assert usage is not None and usage["completion_tokens"] <= len(kept) + 1
+
+    run(with_client(server, fn))
+
+
 def test_chat_completion_streaming(server):
     async def fn(client):
         r = await client.post(
@@ -87,6 +160,7 @@ def test_chat_completion_streaming(server):
                 "model": "tiny-llama",
                 "messages": [{"role": "user", "content": "hi"}],
                 "max_tokens": 5, "temperature": 0, "stream": True,
+                "stream_options": {"include_usage": True},
                 "ignore_eos": True,
             },
         )
@@ -100,7 +174,11 @@ def test_chat_completion_streaming(server):
         assert chunks[-1] == "[DONE]"
         parsed = [json.loads(c) for c in chunks[:-1]]
         assert parsed[0]["choices"][0]["delta"].get("role") == "assistant"
-        assert parsed[-1]["choices"][0]["finish_reason"] == "length"
+        # final chunk is the usage chunk (include_usage shape); the one
+        # before carries the finish_reason
+        assert parsed[-1]["choices"] == []
+        assert parsed[-1]["usage"]["completion_tokens"] == 5
+        assert parsed[-2]["choices"][0]["finish_reason"] == "length"
 
     run(with_client(server, fn))
 

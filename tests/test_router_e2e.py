@@ -179,6 +179,23 @@ def test_streaming_and_metrics_through_router():
             lines = [l async for l in r.content]
             text = b"".join(lines).decode()
             assert "data: [DONE]" in text
+            # the router injects include_usage for its own token accounting
+            # and must strip the usage-only chunk the client didn't ask for
+            assert '"usage"' not in text or '"choices": []' not in text
+            import json as _json
+
+            for line in text.splitlines():
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    assert _json.loads(line[6:]).get("choices") != []
+            # ...while the router-side token counters got populated
+            from production_stack_tpu.router import metrics as rm
+
+            vals = [
+                s.value
+                for metric in rm.output_tokens_total.collect()
+                for s in metric.samples
+            ]
+            assert sum(vals) >= 3
 
             # scrape engines once, then router /metrics must expose the
             # dashboard gauge set
