@@ -52,7 +52,27 @@ class AsyncEngine:
             self._drain_intake(block=not self.engine.has_unfinished())
             if self.paused or not self.engine.has_unfinished():
                 continue
-            outputs = self.engine.step()
+            try:
+                outputs = self.engine.step()
+            except Exception as e:
+                # a step failure must not kill the worker thread: every
+                # open stream would hang forever. Fail the in-flight
+                # requests and keep serving.
+                import logging
+
+                logging.getLogger(__name__).exception("engine.step failed")
+                err = ValueError(f"engine step failed: {e}")
+                if self.loop is not None:
+                    for rid in list(self.streams):
+                        self.loop.call_soon_threadsafe(
+                            self._deliver_error, rid, err
+                        )
+                sched = self.engine.scheduler
+                rids = [s.request_id for s in list(sched.waiting)]
+                rids += list(sched.seqs)
+                for rid in rids:
+                    self.engine.abort_request(rid)
+                continue
             self.step_count += 1
             if outputs and self.loop is not None:
                 self.loop.call_soon_threadsafe(self._deliver, outputs)

@@ -43,7 +43,16 @@ class LLMEngine:
         self.config = config
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         self.tokenizer = get_tokenizer(config.model.tokenizer)
-        self.runner = ModelRunner(config, self.mesh, params, num_blocks)
+        from production_stack_tpu.parallel.mesh import AXIS_STAGE
+
+        if self.mesh.shape[AXIS_STAGE] > 1:
+            # pipeline-parallel serving: per-stage submeshes + KV pools
+            from production_stack_tpu.engine.pp_runner import StagedModelRunner
+
+            self.runner = StagedModelRunner(config, self.mesh, params,
+                                            num_blocks)
+        else:
+            self.runner = ModelRunner(config, self.mesh, params, num_blocks)
         self.scheduler = Scheduler(
             config.scheduler, config.cache, self.runner.num_blocks
         )
@@ -420,80 +429,32 @@ class LLMEngine:
             PrefixCachingBlockAllocator,
         )
 
-        self.runner.kv = None
+        self.runner.drop_kv()
         self.scheduler.allocator = PrefixCachingBlockAllocator(
             self.runner.num_blocks, self.config.cache.block_size,
             self.config.cache.enable_prefix_caching,
         )
         if level >= 2:
-            self.runner.params = None
+            self.runner.drop_params()
         self.sleep_level = level
 
     def wake_mode(self) -> None:
-        import jax
-
-        from production_stack_tpu.engine import kv_cache as kvmod
-        from production_stack_tpu.engine.weights import init_or_load
-
-        if self.runner.params is None:
-            with jax.set_mesh(self.mesh):
-                self.runner.params = init_or_load(
-                    self.config.model, self.mesh, self.runner.rules,
-                    self.config.seed,
-                )
-        if self.runner.kv is None:
-            self.runner.kv = kvmod.init_kv_cache(
-                self.config.model, self.config.cache, self.mesh,
-                self.runner.rules, self.runner.num_blocks,
-            )
+        self.runner.restore_params()
+        self.runner.restore_kv()
         self.sleep_level = 0
 
     def embed(self, prompt_token_ids: list[int]) -> "np.ndarray":
         """Mean-pooled final hidden state — the /v1/embeddings surface (the
         reference proxies this to vLLM embedding models; a causal LM's
         pooled hidden is the standard fallback encoder)."""
-        import functools
-
-        import jax
-        import jax.numpy as jnp
         import numpy as np
 
-        from production_stack_tpu.models.registry import get_model
-
-        if getattr(self, "_embed_fn", None) is None:
-            model = get_model(self.config.model)
-
-            def _embed(cfg, params, tokens, mask):
-                def attend(q, k, v, caches, layer_idx):
-                    from production_stack_tpu.ops.attention import (
-                        dense_causal_attention,
-                    )
-
-                    return dense_causal_attention(q, k, v), caches
-
-                S = tokens.shape[1]
-                positions = jnp.broadcast_to(
-                    jnp.arange(S, dtype=jnp.int32), tokens.shape
-                )
-                hidden, _ = model.forward_tokens(
-                    cfg, params, tokens, positions, attend, None
-                )
-                m = mask[:, :, None].astype(jnp.float32)
-                pooled = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
-                return pooled / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-
-            self._embed_fn = jax.jit(
-                functools.partial(_embed, self.config.model)
-            )
         bucket = self._bucket(len(prompt_token_ids))
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, : len(prompt_token_ids)] = prompt_token_ids
         mask = np.zeros((1, bucket), np.int32)
         mask[0, : len(prompt_token_ids)] = 1
-        with jax.set_mesh(self.mesh):
-            out = self._embed_fn(self.runner.params, jnp.asarray(tokens),
-                                 jnp.asarray(mask))
-        return np.asarray(jax.device_get(out))[0]
+        return self.runner.pooled_embed(tokens, mask)[0]
 
     def warmup(self) -> None:
         """Pre-compile every serving shape variant so no live request pays a

@@ -369,6 +369,68 @@ class ModelRunner:
             self.token_counts = new_counts
         return np.asarray(jax.device_get(sampled))
 
+    # -- sleep mode hooks ----------------------------------------------------
+    def drop_kv(self) -> None:
+        self.kv = None
+
+    def restore_kv(self) -> None:
+        if self.kv is None:
+            self.kv = kvmod.init_kv_cache(
+                self.cfg, self.config.cache, self.mesh, self.rules,
+                self.num_blocks,
+            )
+
+    def drop_params(self) -> None:
+        self.params = None
+
+    def restore_params(self) -> None:
+        if self.params is None:
+            with jax.set_mesh(self.mesh):
+                self.params = init_or_load(
+                    self.cfg, self.mesh, self.rules, self.config.seed
+                )
+
+    @property
+    def params_alive(self) -> bool:
+        return self.params is not None
+
+    @property
+    def kv_alive(self) -> bool:
+        return self.kv is not None
+
+    # -- dense pooled embedding (the /v1/embeddings surface) ----------------
+    def pooled_embed(self, tokens: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        """Mean-pooled final hidden state over a dense causal forward."""
+        if getattr(self, "_pooled_fn", None) is None:
+            from production_stack_tpu.ops.attention import (
+                dense_causal_attention,
+            )
+
+            model = self.model
+            cfg = self.cfg
+
+            def _embed(params, tokens, mask):
+                def attend(q, k, v, caches, layer_idx):
+                    return dense_causal_attention(q, k, v), caches
+
+                S = tokens.shape[1]
+                positions = jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32), tokens.shape
+                )
+                hidden, _ = model.forward_tokens(
+                    cfg, params, tokens, positions, attend, None
+                )
+                m = mask[:, :, None].astype(jnp.float32)
+                pooled = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
+                return pooled / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+
+            self._pooled_fn = jax.jit(_embed)
+        with jax.set_mesh(self.mesh):
+            out = self._pooled_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(mask)
+            )
+        return np.asarray(jax.device_get(out))
+
     # -- multi-LoRA bank -----------------------------------------------------
     def register_lora(self, slot: int, bank_np: dict) -> None:
         """Write an adapter's stacked (A, B) pairs into bank slot ``slot``."""

@@ -505,12 +505,17 @@ class EngineServer:
         # prompt) | [[int, ...], ...] (a batch of tokenized prompts). Batched
         # prompts fan out into concurrent engine requests (one choice per
         # prompt x n).
+        def _is_token_list(p):
+            return (isinstance(p, list) and p
+                    and all(isinstance(t, int) for t in p))
+
         if isinstance(prompt, str):
             prompts = [prompt]
-        elif isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+        elif _is_token_list(prompt):
             prompts = [prompt]
         elif (isinstance(prompt, list) and prompt
-              and all(isinstance(p, (str, list)) for p in prompt)):
+              and all(isinstance(p, str) or _is_token_list(p)
+                      for p in prompt)):
             prompts = prompt
         else:
             return web.json_response(
@@ -669,15 +674,19 @@ class EngineServer:
         first_token_t = min(first_times) if first_times else None
         n_completion = sum(r[1] for r in results)
         self.metrics.observe_request(t_start, first_token_t, end, n_completion)
+        # cached tokens: all n choices of one prompt hit the same cached
+        # prefix (max per prompt), distinct prompts cache independently (sum
+        # across prompts)
+        n = max(1, int(sampling.n))
+        cached = sum(
+            max((r[4] for r in results[pi * n : (pi + 1) * n]), default=0)
+            for pi in range(len(results) // n)
+        )
         usage = {
             "prompt_tokens": n_prompt,
             "completion_tokens": n_completion,
             "total_tokens": n_prompt + n_completion,
-            # max, not sum: all n choices of one prompt hit the same cached
-            # prefix; summing would report cached > prompt_tokens
-            "prompt_tokens_details": {
-                "cached_tokens": max((r[4] for r in results), default=0)
-            },
+            "prompt_tokens_details": {"cached_tokens": cached},
         }
         choices = []
         for idx, (text, _n, finish_reason, _t, _c, _b) in enumerate(results):
@@ -855,6 +864,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-num-batched-tokens", type=int, default=None)
     p.add_argument("--prefill-buckets", default=None,
                    help="comma-separated token buckets, e.g. 128,512,2048")
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="pipeline stages (stage mesh axis; per-stage "
+                        "submeshes + KV pools). Parity with the reference's "
+                        "--pipeline-parallel-size passthrough.")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
     p.add_argument("--host-offload-blocks", type=int, default=0,
@@ -898,7 +911,8 @@ def config_from_args(args) -> EngineConfig:
     if args.remote_kv_url:
         cfg.cache.remote_kv_url = args.remote_kv_url
     cfg.mesh = MeshConfig(
-        data=args.data_parallel_size, tensor=args.tensor_parallel_size
+        data=args.data_parallel_size, stage=args.pipeline_parallel_size,
+        tensor=args.tensor_parallel_size,
     )
     cfg.seed = args.seed
     return cfg

@@ -245,18 +245,36 @@ def forward_tokens(
     kv_caches: Any = None,
     lora: Any = None,
 ) -> Tuple[jnp.ndarray, Any]:
-    """Run the decoder stack.
+    """Embed tokens then run the decoder stack (see forward_hidden)."""
+    x = params["embed"].astype(cfg.jax_dtype)[tokens]
+    return forward_hidden(cfg, params, x, positions, attend, kv_caches, lora)
 
-    tokens: (..., T) int32; positions: (..., T) int32.
-    kv_caches: the FULL cache pytree (leading layer axis) or None. It rides
-    the scan *carry*, not ys: while-loop carries alias in place under XLA,
-    so a donated multi-GiB HBM pool is updated without ever being copied
-    (scan ys would allocate a fresh stacked output every step — measured as
-    2× cache HLO-temp on v5e). ``attend`` receives the full cache plus the
-    layer index and returns the updated full cache.
+
+def forward_hidden(
+    cfg: ModelConfig,
+    params: dict,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    attend: AttendFn,
+    kv_caches: Any = None,
+    lora: Any = None,
+) -> Tuple[jnp.ndarray, Any]:
+    """Run the decoder stack from pre-embedded activations.
+
+    The hidden-in/hidden-out form is the pipeline-parallel unit: a stage
+    holds a slice of ``params["layers"]`` and its own KV pool, takes the
+    previous stage's activations, and hands its output to the next stage
+    (engine/pp_runner.py).
+
+    x: (..., T, E); positions: (..., T) int32.
+    kv_caches: this stage's cache pytree (leading layer axis) or None. It
+    rides the scan *carry*, not ys: while-loop carries alias in place under
+    XLA, so a donated multi-GiB HBM pool is updated without ever being
+    copied (scan ys would allocate a fresh stacked output every step —
+    measured as 2× cache HLO-temp on v5e). ``attend`` receives the cache
+    plus the LOCAL layer index and returns the updated cache.
     Returns (hidden (..., T, E), new_kv_caches).
     """
-    x = params["embed"].astype(cfg.jax_dtype)[tokens]
     onehot = None if lora is None else lora["onehot"].astype(cfg.jax_dtype)
 
     def layer_fn(carry, scanned):

@@ -1,0 +1,145 @@
+"""Pipeline-parallel serving: LLMEngine over a stage>1 mesh must produce
+token-identical output to the stage=1 engine (same weights, same greedy
+path), with per-stage KV pools doing the caching.
+
+Reference parity: the reference serves pipeline-parallel fleets via Ray +
+vLLM --pipeline-parallel-size (helm/templates/ray-cluster.yaml); here PP is
+the ``stage`` mesh axis with per-stage submeshes (engine/pp_runner.py).
+"""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def make_engine(stage: int, tensor: int = 1, model: str = "tiny-llama",
+                multi_step: int = 1) -> LLMEngine:
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained(model),
+        cache=CacheConfig(block_size=4, num_blocks=128),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64,
+            prefill_buckets=(16, 32), multi_step=multi_step,
+        ),
+        mesh=MeshConfig(data=1, stage=stage, tensor=tensor),
+    )
+    mesh = build_mesh(cfg.mesh)
+    return LLMEngine(cfg, mesh=mesh, num_blocks=128)
+
+
+def run_prompts(engine: LLMEngine, prompts, sampling=None) -> dict:
+    sampling = sampling or SamplingParams(
+        temperature=0.0, max_tokens=8, ignore_eos=True
+    )
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r{i}", prompt_token_ids=p, sampling=sampling)
+    outputs = {i: [] for i in range(len(prompts))}
+    steps = 0
+    while engine.has_unfinished() and steps < 64:
+        for out in engine.step():
+            idx = int(out.request_id[1:]) if out.request_id[0] == "r" else out.request_id
+            if isinstance(idx, int):
+                outputs[idx].extend(out.new_token_ids)
+        steps += 1
+    assert not engine.has_unfinished()
+    return {f"r{i}": v for i, v in outputs.items()}
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16, 17]]
+
+
+@pytest.fixture(scope="module")
+def ref_outputs():
+    return run_prompts(make_engine(stage=1), PROMPTS)
+
+
+def test_pp2_token_identical(ref_outputs):
+    got = run_prompts(make_engine(stage=2), PROMPTS)
+    assert got == ref_outputs
+
+
+def test_pp2_tp2_token_identical():
+    # compare at the SAME tensor width: TP reduction order shifts logits by
+    # float noise, so cross-width greedy identity would be flaky
+    ref = run_prompts(make_engine(stage=1, tensor=2), PROMPTS)
+    got = run_prompts(make_engine(stage=2, tensor=2), PROMPTS)
+    assert got == ref
+
+
+def test_pp2_multi_step_token_identical(ref_outputs):
+    got = run_prompts(make_engine(stage=2, multi_step=4), PROMPTS)
+    assert got == ref_outputs
+
+
+def test_pp2_sampled_seeded_matches_stage1():
+    sp = SamplingParams(temperature=0.8, top_k=40, seed=123, max_tokens=6,
+                       ignore_eos=True)
+    a = run_prompts(make_engine(stage=1), [PROMPTS[0]], sp)
+    b = run_prompts(make_engine(stage=2), [PROMPTS[0]], sp)
+    assert a == b
+
+
+def test_pp2_prefix_cache_and_decode():
+    engine = make_engine(stage=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    first = run_prompts(engine, [[1, 2, 3, 4, 5, 6, 7, 8, 9]], sp)
+    # same prompt again: prefix blocks are reused from the per-stage pools
+    engine.add_request("again", prompt_token_ids=[1, 2, 3, 4, 5, 6, 7, 8, 9],
+                       sampling=sp)
+    toks, cached = [], 0
+    steps = 0
+    while engine.has_unfinished() and steps < 32:
+        for out in engine.step():
+            if out.request_id == "again":
+                toks.extend(out.new_token_ids)
+                cached = max(cached, out.num_cached_tokens)
+        steps += 1
+    assert toks == first["r0"]
+    assert cached == 8  # two full blocks of the 9-token prompt
+
+
+def test_pp2_penalties_match_stage1():
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True,
+                       presence_penalty=1.5, frequency_penalty=0.5)
+    a = run_prompts(make_engine(stage=1), [PROMPTS[0]], sp)
+    b = run_prompts(make_engine(stage=2), [PROMPTS[0]], sp)
+    assert a == b
+
+
+def test_pp2_kv_export_import_roundtrip():
+    engine = make_engine(stage=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True)
+    run_prompts(engine, [[1, 2, 3, 4, 5, 6, 7, 8]], sp)
+    data = engine.export_kv([1, 2])
+    # layer axis re-assembled across stages: (L, n, bs, 2KH, D)
+    assert data.shape[0] == engine.config.model.num_layers
+    assert data.shape[1] == 2
+    cached = engine.import_kv(list(range(1, 10)), data)
+    assert cached == 8
+
+
+def test_pp2_sleep_wake():
+    engine = make_engine(stage=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True)
+    before = run_prompts(engine, [PROMPTS[0]], sp)
+    engine.sleep_mode(2)
+    assert not engine.runner.kv_alive and not engine.runner.params_alive
+    engine.wake_mode()
+    assert engine.runner.kv_alive and engine.runner.params_alive
+    after = run_prompts(engine, [PROMPTS[0]], sp)
+    assert before["r0"] == after["r0"]
+
+
+def test_pp2_pooled_embed_matches_stage1():
+    a = make_engine(stage=1).embed([1, 2, 3, 4])
+    b = make_engine(stage=2).embed([1, 2, 3, 4])
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
