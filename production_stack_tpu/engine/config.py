@@ -190,6 +190,11 @@ class SchedulerConfig:
     multi_step: int = 1
     # prefill chunks batched into one dispatch (padded to a fixed P)
     prefill_batch: int = 4
+    # prompts at least this long prefill via ring attention over the seq
+    # mesh axis (sequence parallelism; 0 = disabled). Takes effect only when
+    # the mesh has seq > 1 — the long-context path the reference lacks
+    # (SURVEY.md §5.7).
+    ring_prefill_threshold: int = 0
 
     def bucket_for(self, n: int, max_model_len: Optional[int] = None) -> int:
         """The padded token length a chunk of n tokens compiles at — the ONE

@@ -63,6 +63,12 @@ class LLMEngine:
 
         self.host_kv = maybe_make_store(config.cache)
         self.remote_kv = maybe_make_remote(config.cache)
+        from production_stack_tpu.parallel.mesh import AXIS_SEQ
+
+        if (self.mesh.shape[AXIS_SEQ] > 1
+                and config.scheduler.ring_prefill_threshold > 0
+                and getattr(self.runner, "seq_parallel", False)):
+            self.scheduler.ring_enabled = True
         if self.host_kv is not None or self.remote_kv is not None:
             self.scheduler.admission_hook = self._host_extend_seq
         B = config.scheduler.max_num_seqs
@@ -202,7 +208,53 @@ class LLMEngine:
     def _bucket(self, n: int) -> int:
         return self.config.scheduler.bucket_for(n, self.config.model.max_model_len)
 
+    def _run_prefill_ring(self, sp) -> list[RequestOutput]:
+        """Whole-prompt sequence-parallel prefill (ring attention over the
+        seq mesh axis) for one long fresh prompt; decode continues on the
+        normal paged path."""
+        from production_stack_tpu.parallel.mesh import AXIS_SEQ
+
+        bs = self.config.cache.block_size
+        seq = sp.seq
+        n = sp.chunk_len
+        n_seq = self.mesh.shape[AXIS_SEQ]
+        # pad to a power of two (one compile per size class), then up to a
+        # multiple of the seq axis so shard_map can split it
+        S = max(2 * n_seq, 1 << (n - 1).bit_length())
+        S = -(-S // n_seq) * n_seq
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, :n] = seq.token_ids[:n]
+        positions = np.broadcast_to(np.arange(S, dtype=np.int32), (1, S))
+        slot_mapping = np.full(S, -1, np.int32)
+        slot_mapping[:n] = slot_mapping_for(seq.block_ids, 0, n, bs)
+        s = seq.sampling
+        sampled = self.runner.prefill_ring(
+            tokens, positions, slot_mapping,
+            np.asarray([n - 1], np.int32),
+            np.asarray([s.temperature], np.float32),
+            np.asarray([s.top_p], np.float32),
+            np.asarray([s.top_k], np.int32),
+            np.asarray([s.seed or 0], np.uint32),
+            greedy_only=s.temperature <= 0.0,
+            adapter_ids=(np.asarray([seq.adapter_slot], np.int32)
+                         if seq.adapter_slot else None),
+        )
+        seq.num_computed_tokens = n
+        seq.status = SequenceStatus.RUNNING
+        self._slot_seq[seq.slot] = seq
+        if s.presence_penalty or s.frequency_penalty:
+            self._count_reset_slots.append(seq)
+        if seq.output_token_ids:
+            return []  # preemption-recompute: newest token still pending
+        token = int(sampled[0])
+        seq.first_token_time = time.monotonic()
+        seq.output_token_ids.append(token)
+        self.total_output_tokens += 1
+        return self._postprocess([seq], [[token]])
+
     def _run_prefill(self, prefills: list) -> list[RequestOutput]:
+        if prefills[0].ring:
+            return self._run_prefill_ring(prefills[0])
         bs = self.config.cache.block_size
         # two batch-dim variants only (1 and prefill_batch): a lone prompt
         # must not pay prefill_batch x bucket dense-transformer tokens
@@ -503,6 +555,19 @@ class LLMEngine:
                          sampling=sp)
         while self.has_unfinished():
             self.step()
+        # ring-prefill variants: each power-of-two size class from the
+        # threshold up to max_model_len, greedy + sampled
+        if self.scheduler.ring_enabled:
+            n = sched.ring_prefill_threshold
+            limit = self.config.model.max_model_len
+            sizes = []
+            while n < limit:
+                sizes.append(n)
+                n = (1 << n.bit_length())  # next power of two above
+            for size in sizes:
+                size = min(size, limit - max(sched.multi_step, 1) - 1)
+                run([rng.integers(1, vocab, size).tolist()], 0.0)
+                run([rng.integers(1, vocab, size).tolist()], 0.7)
 
     # -- convenience for tests / offline use ---------------------------------
     def generate(

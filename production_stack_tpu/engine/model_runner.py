@@ -102,6 +102,23 @@ class ModelRunner:
             static_argnames=("block_size", "greedy_only", "use_penalties"),
         )
         self._sample = jax.jit(sample_tokens)
+        from production_stack_tpu.parallel.mesh import AXIS_SEQ
+
+        self.seq_parallel = mesh.shape[AXIS_SEQ] > 1
+        if self.seq_parallel:
+            # long-prompt prefill via ring attention over the seq axis
+            from production_stack_tpu.parallel import shardings as ln
+
+            head_axis = (AXIS_TENSOR
+                         if self.rules.rules.get(ln.KV_HEADS) is not None
+                         else None)
+            self._prefill_ring = jax.jit(
+                functools.partial(
+                    _prefill_ring_step, self.cfg, mesh, head_axis, self.tp
+                ),
+                donate_argnums=(1,),
+                static_argnames=("greedy_only",),
+            )
         # per-slot output-token counts for presence/frequency penalties
         # ((B, V) int32; allocated on first penalised batch)
         self.token_counts = None
@@ -282,6 +299,33 @@ class ModelRunner:
                 jnp.asarray(slot_mapping), jnp.asarray(last_idx),
                 jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
                 jnp.asarray(seeds),
+                lora_bank=self.lora_bank if use_lora else None,
+                adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
+                             if use_lora else None),
+                greedy_only=greedy_only,
+            )
+        return np.asarray(jax.device_get(sampled))
+
+    def prefill_ring(self, tokens: np.ndarray, positions: np.ndarray,
+                     slot_mapping: np.ndarray, last_idx: np.ndarray,
+                     temps: np.ndarray, top_ps: np.ndarray,
+                     top_ks: np.ndarray, seeds: np.ndarray,
+                     greedy_only: bool = True,
+                     adapter_ids: Optional[np.ndarray] = None) -> np.ndarray:
+        """Whole-prompt prefill sharded over the seq axis (ring attention).
+
+        tokens/positions: (1, S) with S a multiple of the seq-axis size;
+        slot_mapping (S,) with -1 padding. Returns the sampled next token
+        (1,). Long-context path: attention never materialises the full
+        S x S score matrix on one device — K/V shards rotate the ring."""
+        use_lora = adapter_ids is not None and self.lora_bank is not None
+        with jax.set_mesh(self.mesh):
+            self.kv, sampled = self._prefill_ring(
+                self.params, self.kv,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(slot_mapping), jnp.asarray(last_idx),
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), jnp.asarray(seeds),
                 lora_bank=self.lora_bank if use_lora else None,
                 adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
                              if use_lora else None),
@@ -539,6 +583,49 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
         sampled = sample_tokens(
             logits, temps, top_ps, top_ks, seeds,
             jnp.zeros_like(last_idx),
+        )
+    return new_kv, sampled
+
+
+def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
+                       tokens, positions, slot_mapping, last_idx,
+                       temps, top_ps, top_ks, seeds,
+                       lora_bank=None, adapter_ids=None,
+                       greedy_only: bool = False):
+    """Whole-prompt ring-attention prefill + fused next-token sampling.
+
+    The prompt's activations are sequence-sharded end to end (GSPMD
+    propagates the ring shard_map's specs through QKV/MLP); each layer's
+    K/V are scattered into the paged pool so the subsequent paged decode
+    path sees exactly the same cache a chunked prefill would have built."""
+    from production_stack_tpu.engine.sampling import sample_tokens
+    from production_stack_tpu.models.registry import get_model
+    from production_stack_tpu.parallel.mesh import AXIS_SEQ
+    from production_stack_tpu.parallel.ring_attention import (
+        ring_causal_attention,
+    )
+
+    model = get_model(cfg)
+
+    def attend(q, k, v, caches, layer_idx):
+        out = ring_causal_attention(q, k, v, mesh, AXIS_SEQ,
+                                    head_axis=head_axis)
+        caches = write_kv(caches, layer_idx, k[0], v[0], slot_mapping, tp)
+        return out, caches
+
+    hidden, new_kv = model.forward_tokens(
+        cfg, params, tokens, positions, attend, kv,
+        lora=_make_lora(lora_bank, adapter_ids, tokens.shape[1]),
+    )
+    last_hidden = jnp.take_along_axis(
+        hidden, last_idx[:, None, None], axis=1
+    )[:, 0]  # (1, E)
+    logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    if greedy_only:
+        sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        sampled = sample_tokens(
+            logits, temps, top_ps, top_ks, seeds, jnp.zeros_like(last_idx)
         )
     return new_kv, sampled
 

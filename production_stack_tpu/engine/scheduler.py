@@ -33,6 +33,7 @@ class ScheduledPrefill:
     seq: Sequence
     chunk_start: int  # == seq.num_computed_tokens
     chunk_len: int
+    ring: bool = False  # whole-prompt ring-attention prefill (seq axis)
 
 
 @dataclasses.dataclass
@@ -59,6 +60,9 @@ class Scheduler:
         # invoked right after a sequence is admitted, before its first chunk
         # is scheduled (the host-KV tier extends cached prefixes here)
         self.admission_hook = None
+        # set by the engine when the mesh has a seq axis > 1: long fresh
+        # prompts prefill whole via ring attention instead of chunking
+        self.ring_enabled = False
 
     # -- queue management ---------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -136,6 +140,24 @@ class Scheduler:
     def schedule(self) -> SchedulerOutput:
         out = SchedulerOutput()
         self._try_admit()
+
+        # ring prefill: a long fresh prompt (no cached/computed prefix — the
+        # ring sees only in-flight tokens) goes through whole, alone, sharded
+        # over the seq axis; the token budget doesn't apply because the seq
+        # axis divides the work
+        if self.ring_enabled and self.config.ring_prefill_threshold > 0:
+            for seq in sorted(self.seqs.values(),
+                              key=lambda s: s.arrival_time):
+                if (seq.status is SequenceStatus.PREFILLING
+                        and not seq.prefill_done
+                        and seq.num_computed_tokens == 0
+                        and seq.prefill_target
+                        >= self.config.ring_prefill_threshold):
+                    out.prefills.append(
+                        ScheduledPrefill(seq, 0, seq.prefill_target,
+                                         ring=True)
+                    )
+                    return out
 
         # prefill priority: batch up to prefill_batch chunks per dispatch;
         # the first (FCFS) chunk picks the shape bucket, later chunks are

@@ -868,6 +868,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline stages (stage mesh axis; per-stage "
                         "submeshes + KV pools). Parity with the reference's "
                         "--pipeline-parallel-size passthrough.")
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="seq mesh axis size: long prompts prefill via ring "
+                        "attention sharded over this many devices")
+    p.add_argument("--ring-prefill-threshold", type=int, default=4096,
+                   help="prompt length at which prefill switches to the "
+                        "ring-attention sequence-parallel path (needs "
+                        "--sequence-parallel-size > 1)")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
     p.add_argument("--host-offload-blocks", type=int, default=0,
@@ -912,8 +919,10 @@ def config_from_args(args) -> EngineConfig:
         cfg.cache.remote_kv_url = args.remote_kv_url
     cfg.mesh = MeshConfig(
         data=args.data_parallel_size, stage=args.pipeline_parallel_size,
-        tensor=args.tensor_parallel_size,
+        seq=args.sequence_parallel_size, tensor=args.tensor_parallel_size,
     )
+    if args.sequence_parallel_size > 1:
+        cfg.scheduler.ring_prefill_threshold = args.ring_prefill_threshold
     cfg.seed = args.seed
     return cfg
 

@@ -84,10 +84,13 @@ def ring_causal_attention(
     v: jnp.ndarray,
     mesh: Mesh,
     axis_name: str = "seq",
+    head_axis: str | None = None,
 ) -> jnp.ndarray:
     """jit-level wrapper: shards the sequence dim over ``axis_name`` and runs
-    the ring. S must divide the axis size."""
-    spec = P(None, axis_name, None, None)
+    the ring. S must divide the axis size. ``head_axis`` additionally shards
+    the head dim (tensor parallelism composes: heads are independent, so the
+    ring only ever talks over ``axis_name``)."""
+    spec = P(None, axis_name, head_axis, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name),
         mesh=mesh,
