@@ -1,0 +1,652 @@
+// Gateway endpoint-picker server — the compiled data-plane component that
+// answers "which engine pod should serve this request" for a kgateway /
+// Envoy inference-extension deployment.
+//
+// The reference implements these pickers as Go plugins inside the
+// gateway-api-inference-extension EPP framework
+// (src/gateway_inference_extension/{roundrobin,prefix_aware,kv_aware}_picker.go).
+// This is the TPU stack's native equivalent: a self-contained C++ HTTP
+// server (no runtime deps) exposing the same three picking strategies and
+// the EPP header contract (`x-gateway-destination-endpoint`).
+//
+// Endpoints:
+//   POST /pick     {"model": m, "prompt": p, "endpoints": ["url", ...]}
+//                  -> {"endpoint": url, "picker": name, "matched": n, "matched_unit": u}
+//                  + x-gateway-destination-endpoint header
+//   POST /process  same body; returns an ext-proc style header-mutation
+//                  JSON envelope (what an EPP would stream back to Envoy)
+//   GET  /healthz  liveness
+//   GET  /metrics  Prometheus text (picker_picks_total{picker,endpoint})
+//
+// Pickers:
+//   roundrobin — sorted endpoint list, atomic cursor (reference:
+//                roundrobin_picker.go)
+//   prefix     — chunk-hash trie shared with native/hashtrie (reference:
+//                prefix_aware_picker.go:134-190); picks the endpoint with
+//                the longest matching prompt prefix, inserts after pick
+//   kvaware    — asks each engine POST /kv/lookup {"prompt"} for its
+//                matched_tokens (the engine answers from its paged-cache
+//                hash table); routes to the deepest match when the
+//                unmatched remainder <= threshold, else falls back to
+//                roundrobin (reference: kv_aware_picker.go:47-86)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <algorithm>
+#include <csignal>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+// C ABI from native/hashtrie/hashtrie.cpp (linked into this binary)
+extern "C" {
+void* ht_create(size_t chunk_size, size_t max_depth);
+void ht_destroy(void* handle);
+void ht_insert(void* handle, const char* text, size_t len,
+               const char* endpoint);
+size_t ht_match(void* handle, const char* text, size_t len,
+                const char* available_joined, char* out, size_t out_cap);
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// minimal JSON field extraction (flat request contract; tolerant of
+// whitespace and escaped characters inside strings)
+// ---------------------------------------------------------------------------
+
+std::string json_unescape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (size_t i = 0; i < s.size(); ++i) {
+        if (s[i] == '\\' && i + 1 < s.size()) {
+            char c = s[++i];
+            switch (c) {
+                case 'n': out += '\n'; break;
+                case 't': out += '\t'; break;
+                case 'r': out += '\r'; break;
+                case 'b': out += '\b'; break;
+                case 'f': out += '\f'; break;
+                case 'u':
+                    // keep the raw escape; hashing/forwarding only needs
+                    // determinism, not unicode decoding
+                    out += "\\u";
+                    break;
+                default: out += c;
+            }
+        } else {
+            out += s[i];
+        }
+    }
+    return out;
+}
+
+// scan a JSON string literal starting at s[i] == '"'; returns raw contents
+// and advances i past the closing quote
+bool scan_string(const std::string& s, size_t& i, std::string* out) {
+    if (i >= s.size() || s[i] != '"') return false;
+    std::string raw;
+    for (++i; i < s.size(); ++i) {
+        if (s[i] == '\\' && i + 1 < s.size()) {
+            raw += s[i];
+            raw += s[i + 1];
+            ++i;
+        } else if (s[i] == '"') {
+            ++i;
+            *out = json_unescape(raw);
+            return true;
+        } else {
+            raw += s[i];
+        }
+    }
+    return false;
+}
+
+// Structure-aware key lookup: walks the JSON skipping string literals and
+// nested containers so a key occurring INSIDE a string value (e.g. a prompt
+// containing the text '"endpoints": [...]') can never match — only real
+// top-level object keys do.
+size_t find_key(const std::string& body, const std::string& key) {
+    size_t i = 0;
+    while (i < body.size() && isspace((unsigned char)body[i])) ++i;
+    if (i >= body.size() || body[i] != '{') return std::string::npos;
+    ++i;
+    int depth = 1;
+    while (i < body.size() && depth > 0) {
+        char c = body[i];
+        if (c == '"') {
+            std::string s;
+            size_t start = i;
+            if (!scan_string(body, i, &s)) return std::string::npos;
+            if (depth == 1) {
+                // is this a key (followed by ':') at the top level?
+                size_t j = i;
+                while (j < body.size() && isspace((unsigned char)body[j]))
+                    ++j;
+                if (j < body.size() && body[j] == ':') {
+                    // compare against the RAW key text (keys in our
+                    // contract are plain identifiers, no escapes)
+                    if (body.compare(start + 1, i - start - 2, key) == 0)
+                        return j + 1;
+                }
+            }
+        } else if (c == '{' || c == '[') {
+            ++depth;
+            ++i;
+        } else if (c == '}' || c == ']') {
+            --depth;
+            ++i;
+        } else {
+            ++i;
+        }
+    }
+    return std::string::npos;
+}
+
+bool json_string_field(const std::string& body, const std::string& key,
+                       std::string* out) {
+    size_t i = find_key(body, key);
+    if (i == std::string::npos) return false;
+    while (i < body.size() && isspace((unsigned char)body[i])) ++i;
+    return scan_string(body, i, out);
+}
+
+bool json_string_array(const std::string& body, const std::string& key,
+                       std::vector<std::string>* out) {
+    size_t i = find_key(body, key);
+    if (i == std::string::npos) return false;
+    while (i < body.size() && isspace((unsigned char)body[i])) ++i;
+    if (i >= body.size() || body[i] != '[') return false;
+    ++i;
+    while (i < body.size()) {
+        while (i < body.size() &&
+               (isspace((unsigned char)body[i]) || body[i] == ','))
+            ++i;
+        if (i < body.size() && body[i] == ']') return true;
+        std::string item;
+        if (!scan_string(body, i, &item)) return false;
+        out->push_back(item);
+    }
+    return false;
+}
+
+bool json_int_field(const std::string& body, const std::string& key,
+                    long* out) {
+    size_t i = find_key(body, key);
+    if (i == std::string::npos) return false;
+    while (i < body.size() && isspace((unsigned char)body[i])) ++i;
+    char* end = nullptr;
+    long v = strtol(body.c_str() + i, &end, 10);
+    if (end == body.c_str() + i) return false;
+    *out = v;
+    return true;
+}
+
+// Endpoints flow into response headers, Prometheus labels, and the
+// '\n'-joined trie set — strip control chars, spaces, '"' and '\\' so a
+// hostile endpoint string can't inject headers / split labels / forge
+// trie entries.
+std::string sanitize_endpoint(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+        unsigned char u = (unsigned char)c;
+        if (u > 0x20 && u != 0x7f && c != '"' && c != '\\') out += c;
+    }
+    return out;
+}
+
+std::string json_escape(const std::string& s) {
+    std::string out;
+    for (char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if ((unsigned char)c < 0x20) {
+                    char buf[8];
+                    snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out += c;
+                }
+        }
+    }
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// tiny blocking HTTP/1.1 client (kv-aware lookups to engine pods)
+// ---------------------------------------------------------------------------
+
+bool parse_url(const std::string& url, std::string* host, int* port,
+               std::string* base_path) {
+    std::string rest = url;
+    const std::string http = "http://";
+    if (rest.rfind(http, 0) == 0) rest = rest.substr(http.size());
+    size_t slash = rest.find('/');
+    std::string hostport = slash == std::string::npos ? rest
+                                                      : rest.substr(0, slash);
+    *base_path = slash == std::string::npos ? "" : rest.substr(slash);
+    if (!base_path->empty() && base_path->back() == '/') base_path->pop_back();
+    size_t colon = hostport.rfind(':');
+    if (colon == std::string::npos) {
+        *host = hostport;
+        *port = 80;
+    } else {
+        *host = hostport.substr(0, colon);
+        *port = atoi(hostport.c_str() + colon + 1);
+    }
+    return !host->empty() && *port > 0;
+}
+
+bool http_post(const std::string& url, const std::string& path,
+               const std::string& body, int timeout_ms,
+               std::string* resp_body) {
+    std::string host, base;
+    int port;
+    if (!parse_url(url, &host, &port, &base)) return false;
+
+    struct addrinfo hints = {}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0)
+        return false;
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+        freeaddrinfo(res);
+        return false;
+    }
+    struct timeval tv = {timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    bool ok = connect(fd, res->ai_addr, res->ai_addrlen) == 0;
+    freeaddrinfo(res);
+    if (!ok) {
+        close(fd);
+        return false;
+    }
+    std::ostringstream req;
+    req << "POST " << base << path << " HTTP/1.1\r\n"
+        << "Host: " << host << ":" << port << "\r\n"
+        << "Content-Type: application/json\r\n"
+        << "Content-Length: " << body.size() << "\r\n"
+        << "Connection: close\r\n\r\n"
+        << body;
+    const std::string data = req.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+        ssize_t n = send(fd, data.data() + sent, data.size() - sent, 0);
+        if (n <= 0) {
+            close(fd);
+            return false;
+        }
+        sent += n;
+    }
+    std::string resp;
+    char buf[4096];
+    ssize_t n;
+    while ((n = recv(fd, buf, sizeof buf, 0)) > 0) resp.append(buf, n);
+    close(fd);
+    size_t hdr_end = resp.find("\r\n\r\n");
+    if (hdr_end == std::string::npos) return false;
+    if (resp.find("200") == std::string::npos ||
+        resp.find("200") > resp.find("\r\n"))
+        return false;
+    *resp_body = resp.substr(hdr_end + 4);
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// pickers
+// ---------------------------------------------------------------------------
+
+struct PickResult {
+    std::string endpoint;
+    long matched = 0;
+};
+
+class Picker {
+  public:
+    explicit Picker(const std::string& mode, long threshold,
+                    size_t chunk_size, int lookup_timeout_ms,
+                    uint64_t trie_max_prompts)
+        : mode_(mode),
+          threshold_(threshold),
+          lookup_timeout_ms_(lookup_timeout_ms),
+          chunk_size_(chunk_size),
+          trie_max_prompts_(trie_max_prompts),
+          trie_(ht_create(chunk_size, 1024)) {}
+
+    PickResult pick(const std::string& model, const std::string& prompt,
+                    std::vector<std::string> endpoints) {
+        for (auto& e : endpoints) e = sanitize_endpoint(e);
+        endpoints.erase(
+            std::remove_if(endpoints.begin(), endpoints.end(),
+                           [](const std::string& e) { return e.empty(); }),
+            endpoints.end());
+        std::sort(endpoints.begin(), endpoints.end());
+        if (endpoints.empty()) return {};
+        PickResult r;
+        if (mode_ == "prefix") {
+            r = pick_prefix(prompt, endpoints);
+        } else if (mode_ == "kvaware") {
+            r = pick_kvaware(model, prompt, endpoints);
+        } else {
+            r = pick_roundrobin(endpoints);
+        }
+        count(r.endpoint);
+        return r;
+    }
+
+    std::string metrics() {
+        std::lock_guard<std::mutex> lock(mu_);
+        std::ostringstream out;
+        out << "# TYPE picker_picks_total counter\n";
+        for (const auto& kv : picks_) {
+            out << "picker_picks_total{picker=\"" << mode_ << "\",endpoint=\""
+                << kv.first << "\"} " << kv.second << "\n";
+        }
+        return out.str();
+    }
+
+    const std::string& mode() const { return mode_; }
+
+  private:
+    PickResult pick_roundrobin(const std::vector<std::string>& endpoints) {
+        uint64_t i = cursor_.fetch_add(1);
+        return {endpoints[i % endpoints.size()], 0};
+    }
+
+    PickResult pick_prefix(const std::string& prompt,
+                           const std::vector<std::string>& endpoints) {
+        std::string avail;
+        for (const auto& e : endpoints) {
+            if (!avail.empty()) avail += '\n';
+            avail += e;
+        }
+        std::vector<char> out(avail.size() + 2);
+        size_t matched = ht_match(trie_, prompt.data(), prompt.size(),
+                                  avail.c_str(), out.data(), out.size());
+        std::string first(out.data());
+        size_t nl = first.find('\n');
+        if (nl != std::string::npos) first = first.substr(0, nl);
+        PickResult r;
+        if (matched > 0 && !first.empty()) {
+            r = {first, (long)matched};
+        } else {
+            r = pick_roundrobin(endpoints);
+        }
+        // bound trie memory: after max_prompts inserts, flush and rebuild
+        // (generation flush — the same coarse eviction prefix caches use)
+        if (++inserts_ > trie_max_prompts_) {
+            std::lock_guard<std::mutex> lock(mu_);
+            if (inserts_ > trie_max_prompts_) {
+                ht_destroy(trie_);
+                trie_ = ht_create(chunk_size_, 1024);
+                inserts_ = 0;
+            }
+        }
+        ht_insert(trie_, prompt.data(), prompt.size(), r.endpoint.c_str());
+        return r;
+    }
+
+    PickResult pick_kvaware(const std::string& model,
+                            const std::string& prompt,
+                            const std::vector<std::string>& endpoints) {
+        const std::string body = "{\"model\": \"" + json_escape(model) +
+                                 "\", \"prompt\": \"" + json_escape(prompt) +
+                                 "\"}";
+        // concurrent fan-out: one slow/dead pod must not serialise the
+        // whole pick (mirrors the Python router's asyncio.gather probe)
+        std::vector<long> matched_v(endpoints.size(), 0),
+            total_v(endpoints.size(), 0);
+        std::vector<std::thread> probes;
+        probes.reserve(endpoints.size());
+        for (size_t i = 0; i < endpoints.size(); ++i) {
+            probes.emplace_back([&, i]() {
+                std::string resp;
+                if (http_post(endpoints[i], "/kv/lookup", body,
+                              lookup_timeout_ms_, &resp)) {
+                    json_int_field(resp, "matched_tokens", &matched_v[i]);
+                    json_int_field(resp, "total_tokens", &total_v[i]);
+                }
+            });
+        }
+        for (auto& t : probes) t.join();
+        std::string best;
+        long best_matched = 0, best_total = 0;
+        for (size_t i = 0; i < endpoints.size(); ++i) {
+            if (matched_v[i] > best_matched) {
+                best = endpoints[i];
+                best_matched = matched_v[i];
+                best_total = total_v[i];
+            }
+        }
+        // deepest match wins when the unmatched remainder is small enough
+        // to be worth the locality (reference threshold gate,
+        // kv_aware_picker.go:58)
+        if (!best.empty() && best_total > 0 &&
+            best_total - best_matched <= threshold_) {
+            return {best, best_matched};
+        }
+        return pick_roundrobin(endpoints);
+    }
+
+    void count(const std::string& endpoint) {
+        std::lock_guard<std::mutex> lock(mu_);
+        picks_[endpoint]++;
+    }
+
+    std::string mode_;
+    long threshold_;
+    int lookup_timeout_ms_;
+    size_t chunk_size_;
+    uint64_t trie_max_prompts_;
+    void* trie_;
+    std::atomic<uint64_t> cursor_{0};
+    std::atomic<uint64_t> inserts_{0};
+    std::mutex mu_;
+    std::map<std::string, uint64_t> picks_;
+};
+
+// ---------------------------------------------------------------------------
+// HTTP server
+// ---------------------------------------------------------------------------
+
+struct Request {
+    std::string method, path, body;
+};
+
+constexpr size_t kMaxBody = 16u << 20;  // 16 MiB request cap
+
+bool read_request(int fd, Request* req) {
+    std::string data;
+    char buf[8192];
+    size_t hdr_end = std::string::npos;
+    while (hdr_end == std::string::npos) {
+        ssize_t n = recv(fd, buf, sizeof buf, 0);
+        if (n <= 0) return false;
+        data.append(buf, n);
+        hdr_end = data.find("\r\n\r\n");
+        if (data.size() > kMaxBody) return false;
+    }
+    size_t line_end = data.find("\r\n");
+    std::istringstream line(data.substr(0, line_end));
+    line >> req->method >> req->path;
+    size_t content_length = 0;
+    std::string lower = data.substr(0, hdr_end);
+    std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+    size_t cl = lower.find("content-length:");
+    if (cl != std::string::npos)
+        content_length = strtoul(lower.c_str() + cl + 15, nullptr, 10);
+    if (content_length > kMaxBody) return false;  // size cap on the body too
+    std::string body = data.substr(hdr_end + 4);
+    while (body.size() < content_length) {
+        ssize_t n = recv(fd, buf, sizeof buf, 0);
+        if (n <= 0) return false;
+        body.append(buf, n);
+    }
+    req->body = body.substr(0, content_length);
+    return true;
+}
+
+void respond(int fd, int status, const std::string& content_type,
+             const std::string& body,
+             const std::string& extra_headers = "") {
+    const char* reason = status == 200 ? "OK"
+                         : status == 400 ? "Bad Request"
+                                         : "Not Found";
+    std::ostringstream out;
+    out << "HTTP/1.1 " << status << " " << reason << "\r\n"
+        << "Content-Type: " << content_type << "\r\n"
+        << "Content-Length: " << body.size() << "\r\n"
+        << extra_headers << "Connection: close\r\n\r\n"
+        << body;
+    const std::string data = out.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+        ssize_t n = send(fd, data.data() + sent, data.size() - sent, 0);
+        if (n <= 0) return;
+        sent += n;
+    }
+}
+
+void handle(int fd, Picker* picker,
+            const std::vector<std::string>& static_endpoints) {
+    // idle-client guard: a connection that stops sending (slowloris) must
+    // release its thread, not pin it forever
+    struct timeval tv = {10, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    Request req;
+    if (!read_request(fd, &req)) {
+        close(fd);
+        return;
+    }
+    if (req.method == "GET" && req.path == "/healthz") {
+        respond(fd, 200, "application/json", "{\"status\": \"ok\"}");
+    } else if (req.method == "GET" && req.path == "/metrics") {
+        respond(fd, 200, "text/plain; version=0.0.4", picker->metrics());
+    } else if (req.method == "POST" &&
+               (req.path == "/pick" || req.path == "/process")) {
+        std::string model, prompt;
+        std::vector<std::string> endpoints;
+        json_string_field(req.body, "model", &model);
+        json_string_field(req.body, "prompt", &prompt);
+        if (!json_string_array(req.body, "endpoints", &endpoints))
+            endpoints = static_endpoints;
+        if (endpoints.empty()) {
+            respond(fd, 400, "application/json",
+                    "{\"error\": \"no endpoints\"}");
+        } else {
+            PickResult r = picker->pick(model, prompt, endpoints);
+            std::string hdr = "x-gateway-destination-endpoint: " +
+                              r.endpoint + "\r\n";
+            if (req.path == "/pick") {
+                // matched unit depends on the picker: chars for prefix
+                // (trie depth), tokens for kvaware (engine-reported)
+                std::ostringstream body;
+                body << "{\"endpoint\": \"" << json_escape(r.endpoint)
+                     << "\", \"picker\": \"" << picker->mode()
+                     << "\", \"matched\": " << r.matched
+                     << ", \"matched_unit\": \""
+                     << (picker->mode() == "kvaware" ? "tokens" : "chars")
+                     << "\"}";
+                respond(fd, 200, "application/json", body.str(), hdr);
+            } else {
+                // ext-proc style header mutation envelope (what the EPP
+                // streams back to Envoy to steer the request)
+                std::ostringstream body;
+                body << "{\"response\": {\"header_mutation\": {\"set_headers\""
+                     << ": [{\"header\": {\"key\": "
+                     << "\"x-gateway-destination-endpoint\", \"value\": \""
+                     << json_escape(r.endpoint) << "\"}}]}}}";
+                respond(fd, 200, "application/json", body.str(), hdr);
+            }
+        }
+    } else {
+        respond(fd, 404, "application/json", "{\"error\": \"not found\"}");
+    }
+    close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    int port = 9002;
+    std::string mode = "roundrobin";
+    long threshold = 16;
+    size_t chunk_size = 128;
+    int lookup_timeout_ms = 250;  // per-probe; probes run concurrently
+    uint64_t trie_max_prompts = 200000;
+    std::vector<std::string> static_endpoints;
+    for (int i = 1; i < argc; ++i) {
+        std::string a = argv[i];
+        auto next = [&]() -> std::string {
+            return i + 1 < argc ? argv[++i] : "";
+        };
+        if (a == "--port") port = atoi(next().c_str());
+        else if (a == "--picker") mode = next();
+        else if (a == "--threshold") threshold = atol(next().c_str());
+        else if (a == "--chunk-size") chunk_size = atol(next().c_str());
+        else if (a == "--lookup-timeout-ms")
+            lookup_timeout_ms = atoi(next().c_str());
+        else if (a == "--trie-max-prompts")
+            trie_max_prompts = strtoull(next().c_str(), nullptr, 10);
+        else if (a == "--endpoints") {
+            std::istringstream ss(next());
+            std::string item;
+            while (std::getline(ss, item, ','))
+                if (!item.empty()) static_endpoints.push_back(item);
+        } else {
+            fprintf(stderr,
+                    "usage: picker_server [--port N] "
+                    "[--picker roundrobin|prefix|kvaware] [--threshold N] "
+                    "[--chunk-size N] [--lookup-timeout-ms N] [--trie-max-prompts N] "
+                    "[--endpoints url1,url2]\n");
+            return 2;
+        }
+    }
+    signal(SIGPIPE, SIG_IGN);
+
+    Picker picker(mode, threshold, chunk_size, lookup_timeout_ms,
+                  trie_max_prompts);
+
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in addr = {};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(port);
+    if (bind(srv, (struct sockaddr*)&addr, sizeof addr) != 0) {
+        perror("bind");
+        return 1;
+    }
+    if (listen(srv, 128) != 0) {
+        perror("listen");
+        return 1;
+    }
+    fprintf(stderr, "picker_server: %s on :%d\n", mode.c_str(), port);
+    while (true) {
+        int fd = accept(srv, nullptr, nullptr);
+        if (fd < 0) continue;
+        std::thread(handle, fd, &picker, static_endpoints).detach();
+    }
+}
