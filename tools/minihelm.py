@@ -1,0 +1,547 @@
+"""minihelm: a dependency-free Go-template renderer for this chart.
+
+The reference validates its chart with helm-unittest in CI (22 files under
+helm/tests/). This environment has no helm binary, so chart tests here
+render templates for real with this module and assert on the parsed YAML
+objects — a Go-template syntax error or a wrong path fails the test suite
+instead of slipping through string greps.
+
+Supported subset (everything this chart uses):
+  actions     {{ .. }} with -trim markers, comments {{/* .. */}}
+  control     if / else if / else / end, range [$k,] [$v :=] expr, with,
+              define "name"
+  data        .Values/.Chart/.Release paths, $var, $ (root), dot
+  functions   include, tpl, toYaml, nindent, indent, default, quote,
+              squote, trunc, trimSuffix, printf, ternary, empty, dict,
+              list, eq, ne, and, or, not, lt, gt, int, toString, b64enc,
+              lower, upper, join, hasKey, required, fromYaml
+  pipelines   a | b | c (previous value appended as the LAST argument)
+
+CLI: python tools/minihelm.py <chartdir> [--set-file overrides.yaml]
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import re
+import sys
+from typing import Any, Optional
+
+import yaml
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+_COMMENT_RE = re.compile(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}", re.DOTALL)
+
+
+class TemplateError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# parsing: template text -> node tree
+# ---------------------------------------------------------------------------
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s):
+        self.s = s
+
+
+class Action(Node):
+    def __init__(self, expr):
+        self.expr = expr
+
+
+class Block(Node):
+    """if/range/with/define block: (kind, arg, body, else_body)."""
+
+    def __init__(self, kind, arg):
+        self.kind = kind
+        self.arg = arg
+        self.body: list[Node] = []
+        self.else_body: list[Node] = []
+
+
+def tokenize(src: str):
+    """Yield (kind, value) tokens with Go-template whitespace trimming."""
+    src = _COMMENT_RE.sub("", src)
+    out = []
+    pos = 0
+    for m in _ACTION_RE.finditer(src):
+        text = src[pos : m.start()]
+        if m.group(1) == "-":
+            text = text.rstrip()
+        out.append(("text", text))
+        out.append(("action", m.group(2).strip(), m.group(3) == "-"))
+        pos = m.end()
+    out.append(("text", src[pos:]))
+    # apply right-trim markers to the following text token
+    toks = []
+    trim_next = False
+    for t in out:
+        if t[0] == "text":
+            s = t[1]
+            if trim_next:
+                s = s.lstrip()
+            toks.append(("text", s))
+            trim_next = False
+        else:
+            toks.append(("action", t[1]))
+            trim_next = t[2]
+    return toks
+
+
+def parse(src: str) -> list[Node]:
+    toks = tokenize(src)
+    root: list[Node] = []
+    stack: list[tuple[list[Node], Optional[Block]]] = [(root, None)]
+    for tok in toks:
+        if tok[0] == "text":
+            if tok[1]:
+                stack[-1][0].append(Text(tok[1]))
+            continue
+        expr = tok[1]
+        if not expr:
+            continue
+        head = expr.split(None, 1)[0]
+        if head in ("if", "range", "with", "define", "block"):
+            blk = Block(head, expr.split(None, 1)[1] if " " in expr else "")
+            stack[-1][0].append(blk)
+            stack.append((blk.body, blk))
+        elif head == "else":
+            _, blk = stack.pop()
+            if blk is None:
+                raise TemplateError("else outside block")
+            rest = expr.split(None, 1)[1] if " " in expr else ""
+            if rest.startswith("if"):
+                inner = Block("if", rest.split(None, 1)[1])
+                blk.else_body.append(inner)
+                stack.append((inner.body, inner))
+                # mark so the matching `end` closes BOTH blocks
+                inner._chained_from = blk  # type: ignore
+            else:
+                stack.append((blk.else_body, blk))
+        elif head == "end":
+            _, blk = stack.pop()
+            # `else if` chains: one `end` closes the whole chain
+            while blk is not None and getattr(blk, "_chained_from", None):
+                blk = blk._chained_from  # type: ignore
+        else:
+            stack[-1][0].append(Action(expr))
+    if len(stack) != 1:
+        raise TemplateError("unclosed block")
+    return root
+
+
+# ---------------------------------------------------------------------------
+# expression evaluation
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    "(?:\\.|[^"\\])*"        # double-quoted string
+  | `[^`]*`                  # raw string
+  | \(|\)                    # parens
+  | \|                       # pipe
+  | [^\s()|]+                # bare word / path / number
+""",
+    re.VERBOSE,
+)
+
+
+def lex_expr(expr: str) -> list[str]:
+    return _TOKEN_RE.findall(expr)
+
+
+class Files:
+    """Subset of helm's .Files: Glob(pattern).AsConfig."""
+
+    def __init__(self, chart_dir):
+        self.chart_dir = chart_dir
+
+    def Glob(self, pattern):
+        import glob as globmod
+
+        matches = sorted(
+            globmod.glob(os.path.join(self.chart_dir, pattern))
+        )
+        return FileGlob({os.path.basename(p): open(p).read()
+                         for p in matches})
+
+
+class FileGlob:
+    def __init__(self, files: dict):
+        self.files = files
+
+    @property
+    def AsConfig(self):
+        return yaml.safe_dump(self.files, default_flow_style=False,
+                              sort_keys=True).rstrip()
+
+
+class Renderer:
+    def __init__(self, values, chart, release, defines=None,
+                 chart_dir=None):
+        self.root = {
+            "Values": values,
+            "Chart": chart,
+            "Release": release,
+            "Files": Files(chart_dir or "."),
+            "Template": {"Name": "", "BasePath": "templates"},
+        }
+        self.defines: dict[str, list[Node]] = defines if defines is not None else {}
+
+    # -- value resolution ---------------------------------------------------
+    def resolve_path(self, path: str, dot, vars_):
+        if path == ".":
+            return dot
+        if path == "$":
+            return self.root
+        base = dot
+        parts = path.split(".")
+        if path.startswith("$"):
+            name = parts[0]
+            base = self.root if name == "$" else vars_.get(name)
+            parts = parts[1:]
+        elif path.startswith("."):
+            parts = parts[1:]
+        else:
+            raise TemplateError(f"unknown token {path!r}")
+        for p in parts:
+            if p == "":
+                continue
+            if isinstance(base, dict):
+                base = base.get(p)
+            else:
+                base = getattr(base, p, None)
+            if base is None:
+                return None
+        return base
+
+    def eval_atom(self, tok: str, dot, vars_):
+        if tok.startswith('"'):
+            body = tok[1:-1]
+            return (body.replace('\\"', '"').replace("\\n", "\n")
+                        .replace("\\t", "\t").replace("\\\\", "\\"))
+        if tok.startswith("`"):
+            return tok[1:-1]
+        if tok in ("true", "false"):
+            return tok == "true"
+        if tok in ("nil", "null"):
+            return None
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if re.fullmatch(r"-?\d+\.\d+", tok):
+            return float(tok)
+        if tok.startswith(".") or tok.startswith("$"):
+            return self.resolve_path(tok, dot, vars_)
+        raise TemplateError(f"unknown atom {tok!r}")
+
+    def eval_expr(self, expr: str, dot, vars_):
+        toks = lex_expr(expr)
+        val, i = self.eval_pipeline(toks, 0, dot, vars_)
+        if i != len(toks):
+            raise TemplateError(f"trailing tokens in {expr!r}")
+        return val
+
+    def eval_pipeline(self, toks, i, dot, vars_):
+        val, i = self.eval_call(toks, i, dot, vars_, None)
+        while i < len(toks) and toks[i] == "|":
+            val, i = self.eval_call(toks, i + 1, dot, vars_, val)
+        return val, i
+
+    def eval_call(self, toks, i, dot, vars_, piped):
+        """One pipeline segment: fn arg arg ... (or a bare value)."""
+        if i >= len(toks):
+            raise TemplateError("empty expression")
+        if toks[i] == "(":
+            val, i = self.eval_pipeline(toks, i + 1, dot, vars_)
+            if i >= len(toks) or toks[i] != ")":
+                raise TemplateError("unbalanced parens")
+            i += 1
+            # postfix field access on a parenthesized value: (expr).Field
+            if i < len(toks) and toks[i].startswith("."):
+                for p in toks[i].split(".")[1:]:
+                    val = (val.get(p) if isinstance(val, dict)
+                           else getattr(val, p, None))
+                i += 1
+            if piped is not None:
+                raise TemplateError("cannot pipe into parenthesized value")
+            return val, i
+        head = toks[i]
+        if head in FUNCTIONS:
+            i += 1
+            args = []
+            while i < len(toks) and toks[i] not in ("|", ")"):
+                if toks[i] == "(":
+                    v, i = self.eval_pipeline(toks, i + 1, dot, vars_)
+                    if toks[i] != ")":
+                        raise TemplateError("unbalanced parens")
+                    i += 1
+                elif toks[i] in FUNCTIONS:
+                    # bare function name as an argument = zero-arg call
+                    # (Go template: `default dict .x`)
+                    v = FUNCTIONS[toks[i]](self, dot, vars_)
+                    i += 1
+                else:
+                    v = self.eval_atom(toks[i], dot, vars_)
+                    i += 1
+                args.append(v)
+            if piped is not None:
+                args.append(piped)
+            return FUNCTIONS[head](self, dot, vars_, *args), i
+        # bare value — or a method call (.Files.Glob "pattern")
+        val = self.eval_atom(head, dot, vars_)
+        i += 1
+        if callable(val):
+            args = []
+            while i < len(toks) and toks[i] not in ("|", ")"):
+                if toks[i] == "(":
+                    v, i = self.eval_pipeline(toks, i + 1, dot, vars_)
+                    if toks[i] != ")":
+                        raise TemplateError("unbalanced parens")
+                    i += 1
+                else:
+                    v = self.eval_atom(toks[i], dot, vars_)
+                    i += 1
+                args.append(v)
+            if piped is not None:
+                args.append(piped)
+            return val(*args), i
+        if piped is not None:
+            raise TemplateError(f"cannot pipe into {head!r}")
+        return val, i
+
+    # -- rendering ----------------------------------------------------------
+    def render_nodes(self, nodes, dot, vars_):
+        out = []
+        for node in nodes:
+            if isinstance(node, Text):
+                out.append(node.s)
+            elif isinstance(node, Action):
+                expr = node.expr
+                m = re.match(r"(\$[\w]*)\s*:?=\s*(.*)", expr)
+                if m:  # variable assignment
+                    vars_[m.group(1)] = self.eval_expr(m.group(2), dot, vars_)
+                    continue
+                val = self.eval_expr(expr, dot, vars_)
+                out.append(to_string(val))
+            elif isinstance(node, Block):
+                out.append(self.render_block(node, dot, vars_))
+        return "".join(out)
+
+    def render_block(self, blk: Block, dot, vars_):
+        if blk.kind == "define":
+            name = blk.arg.strip().strip('"')
+            self.defines[name] = blk.body
+            return ""
+        if blk.kind == "if":
+            cond = self.eval_expr(blk.arg, dot, vars_)
+            body = blk.body if truthy(cond) else blk.else_body
+            return self.render_nodes(body, dot, dict(vars_))
+        if blk.kind == "with":
+            val = self.eval_expr(blk.arg, dot, vars_)
+            if truthy(val):
+                return self.render_nodes(blk.body, val, dict(vars_))
+            return self.render_nodes(blk.else_body, dot, dict(vars_))
+        if blk.kind == "range":
+            m = re.match(r"((?:\$[\w]+\s*,\s*)?\$[\w]+)\s*:?=\s*(.*)",
+                         blk.arg)
+            var_names = []
+            expr = blk.arg
+            if m:
+                var_names = [v.strip() for v in m.group(1).split(",")]
+                expr = m.group(2)
+            coll = self.eval_expr(expr, dot, vars_)
+            if not coll:
+                return self.render_nodes(blk.else_body, dot, dict(vars_))
+            out = []
+            items = (list(coll.items()) if isinstance(coll, dict)
+                     else list(enumerate(coll)))
+            for k, v in items:
+                nv = dict(vars_)
+                if len(var_names) == 2:
+                    nv[var_names[0]], nv[var_names[1]] = k, v
+                elif len(var_names) == 1:
+                    nv[var_names[0]] = v
+                out.append(self.render_nodes(blk.body, v, nv))
+            return "".join(out)
+        raise TemplateError(f"unknown block {blk.kind}")
+
+    def include(self, name: str, ctx):
+        if name not in self.defines:
+            raise TemplateError(f"include of undefined template {name!r}")
+        return self.render_nodes(self.defines[name], ctx, {"$": self.root})
+
+
+# ---------------------------------------------------------------------------
+# functions
+# ---------------------------------------------------------------------------
+
+def truthy(v) -> bool:
+    return bool(v) and v != {} and v != []
+
+
+def to_string(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def _to_yaml(r, dot, vars_, v):
+    if v is None:
+        return ""
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip()
+
+
+def _indent(n, s):
+    pad = " " * int(n)
+    return "\n".join(pad + line if line else line
+                     for line in to_string(s).split("\n"))
+
+
+FUNCTIONS = {
+    "include": lambda r, d, v, name, ctx: r.include(name, ctx),
+    "tpl": lambda r, d, v, s, ctx: r.render_nodes(parse(to_string(s)), ctx,
+                                                  {"$": r.root}),
+    "toYaml": _to_yaml,
+    "fromYaml": lambda r, d, v, s: yaml.safe_load(s),
+    "nindent": lambda r, d, v, n, s: "\n" + _indent(n, s),
+    "indent": lambda r, d, v, n, s: _indent(n, s),
+    "default": lambda r, d, v, dflt, val=None: val if truthy(val) else dflt,
+    "quote": lambda r, d, v, s: '"' + to_string(s).replace('"', '\\"') + '"',
+    "squote": lambda r, d, v, s: "'" + to_string(s) + "'",
+    "trunc": lambda r, d, v, n, s: to_string(s)[: int(n)],
+    "trimSuffix": lambda r, d, v, suf, s:
+        to_string(s)[: -len(suf)] if to_string(s).endswith(suf) else to_string(s),
+    "printf": lambda r, d, v, fmt, *a: _printf(fmt, a),
+    "ternary": lambda r, d, v, t, f, cond: t if truthy(cond) else f,
+    "empty": lambda r, d, v, x: not truthy(x),
+    "dict": lambda r, d, v, *kv: {to_string(kv[i]): kv[i + 1]
+                                  for i in range(0, len(kv), 2)},
+    "list": lambda r, d, v, *a: list(a),
+    "eq": lambda r, d, v, a, b: a == b,
+    "ne": lambda r, d, v, a, b: a != b,
+    "and": lambda r, d, v, *a: a[-1] if all(truthy(x) for x in a) else
+        next(x for x in a if not truthy(x)),
+    "or": lambda r, d, v, *a: next((x for x in a if truthy(x)), a[-1]),
+    "not": lambda r, d, v, x: not truthy(x),
+    "lt": lambda r, d, v, a, b: a < b,
+    "gt": lambda r, d, v, a, b: a > b,
+    "int": lambda r, d, v, x: int(x or 0),
+    "toString": lambda r, d, v, x: to_string(x),
+    "toJson": lambda r, d, v, x: __import__("json").dumps(x),
+    "b64enc": lambda r, d, v, s:
+        base64.b64encode(to_string(s).encode()).decode(),
+    "lower": lambda r, d, v, s: to_string(s).lower(),
+    "upper": lambda r, d, v, s: to_string(s).upper(),
+    "join": lambda r, d, v, sep, xs: to_string(sep).join(
+        to_string(x) for x in (xs or [])),
+    "hasKey": lambda r, d, v, m, k: isinstance(m, dict) and k in m,
+    "required": lambda r, d, v, msg, val: _required(msg, val),
+}
+
+
+def _printf(fmt, args):
+    fmt = re.sub(r"%([#+\- 0-9.]*)[dv]", r"%\1s", fmt)
+    return fmt % tuple(to_string(a) for a in args)
+
+
+def _required(msg, val):
+    if not truthy(val):
+        raise TemplateError(msg)
+    return val
+
+
+# ---------------------------------------------------------------------------
+# chart-level API
+# ---------------------------------------------------------------------------
+
+def deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def render_chart(chart_dir: str, overrides: Optional[dict] = None,
+                 release_name: str = "test") -> dict[str, str]:
+    """Render every template; returns {filename: rendered text}."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    values = deep_merge(values, overrides or {})
+    chart = {"Name": chart_meta["name"], "Version": chart_meta["version"],
+             "AppVersion": chart_meta.get("appVersion", "")}
+    release = {"Name": release_name, "Namespace": "default",
+               "Service": "Helm"}
+
+    renderer = Renderer(values, chart, release, chart_dir=chart_dir)
+    tdir = os.path.join(chart_dir, "templates")
+    files = sorted(os.listdir(tdir))
+    # pass 1: collect defines from every file (helpers first is implicit —
+    # defines register before any template body renders below)
+    parsed = {}
+    for fn in files:
+        if not (fn.endswith(".yaml") or fn.endswith(".tpl")):
+            continue
+        with open(os.path.join(tdir, fn)) as f:
+            parsed[fn] = parse(f.read())
+    for fn, nodes in parsed.items():
+        for node in nodes:
+            if isinstance(node, Block) and node.kind == "define":
+                renderer.render_block(node, renderer.root, {})
+    out = {}
+    for fn, nodes in parsed.items():
+        if fn.endswith(".tpl"):
+            continue
+        out[fn] = renderer.render_nodes(nodes, renderer.root,
+                                        {"$": renderer.root})
+    return out
+
+
+def render_objects(chart_dir: str, overrides: Optional[dict] = None,
+                   release_name: str = "test") -> list[dict]:
+    """Render and parse all non-empty YAML documents."""
+    objs = []
+    for fn, text in render_chart(chart_dir, overrides, release_name).items():
+        try:
+            for doc in yaml.safe_load_all(text):
+                if doc:
+                    objs.append(doc)
+        except yaml.YAMLError as e:
+            raise TemplateError(f"{fn}: rendered invalid YAML: {e}") from e
+    return objs
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser("minihelm")
+    p.add_argument("chart")
+    p.add_argument("--values", "-f", default=None)
+    args = p.parse_args(argv)
+    overrides = None
+    if args.values:
+        with open(args.values) as f:
+            overrides = yaml.safe_load(f)
+    for fn, text in render_chart(args.chart, overrides).items():
+        body = text.strip()
+        if body:
+            print(f"---\n# Source: {fn}\n{body}")
+
+
+if __name__ == "__main__":
+    main()
