@@ -1,0 +1,238 @@
+// Compiled reconciler core — the drift-decision engine of the operator.
+//
+// The reference's operator is compiled Go (kubebuilder,
+// operator/internal/controller/vllmruntime_controller.go:934
+// deploymentNeedsUpdate); project rules ask the TPU stack's native
+// components to ship compiled too. This is the first compiled piece of the
+// operator: the pure decision logic "does this live object drift from the
+// desired manifest", independent of transport. controller.py calls it over
+// a C ABI via ctypes (native/hashtrie pattern) and falls back to the
+// equivalent Python when the .so isn't built.
+//
+// Semantics: SUBSET drift. Every key present in `desired` must exist in
+// `live` with a deeply-equal value (lists: same length, element-wise
+// subset). Keys only in `live` are ignored — the apiserver defaults dozens
+// of fields the operator doesn't manage. Numbers compare by value
+// (1 == 1.0); "1" != 1.
+//
+// C ABI:
+//   int rc_subset_drifted(const char* desired_json, const char* live_json)
+//     returns 1 = drift, 0 = no drift, -1 = parse error.
+
+#include <cctype>
+#include <cmath>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// minimal recursive-descent JSON parser
+// ---------------------------------------------------------------------------
+
+struct Value;
+using ValuePtr = std::unique_ptr<Value>;
+
+struct Value {
+    enum Kind { Null, Bool, Num, Str, Arr, Obj } kind = Null;
+    bool b = false;
+    double num = 0;
+    std::string str;
+    std::vector<ValuePtr> arr;
+    std::map<std::string, ValuePtr> obj;
+};
+
+struct Parser {
+    const char* p;
+    const char* end;
+    bool ok = true;
+
+    explicit Parser(const char* s) : p(s), end(s + strlen(s)) {}
+
+    void skip() {
+        while (p < end && isspace((unsigned char)*p)) ++p;
+    }
+
+    bool consume(char c) {
+        skip();
+        if (p < end && *p == c) {
+            ++p;
+            return true;
+        }
+        return false;
+    }
+
+    ValuePtr parse() {
+        skip();
+        auto v = std::make_unique<Value>();
+        if (p >= end) {
+            ok = false;
+            return v;
+        }
+        char c = *p;
+        if (c == '{') return parse_obj();
+        if (c == '[') return parse_arr();
+        if (c == '"') {
+            v->kind = Value::Str;
+            v->str = parse_string();
+            return v;
+        }
+        if (c == 't' || c == 'f') {
+            v->kind = Value::Bool;
+            if (strncmp(p, "true", 4) == 0) {
+                v->b = true;
+                p += 4;
+            } else if (strncmp(p, "false", 5) == 0) {
+                v->b = false;
+                p += 5;
+            } else {
+                ok = false;
+            }
+            return v;
+        }
+        if (c == 'n') {
+            if (strncmp(p, "null", 4) == 0)
+                p += 4;
+            else
+                ok = false;
+            return v;  // Null
+        }
+        // number
+        char* np = nullptr;
+        v->kind = Value::Num;
+        v->num = strtod(p, &np);
+        if (np == p) ok = false;
+        p = np;
+        return v;
+    }
+
+    std::string parse_string() {
+        std::string out;
+        if (!consume('"')) {
+            ok = false;
+            return out;
+        }
+        while (p < end && *p != '"') {
+            if (*p == '\\' && p + 1 < end) {
+                char c = p[1];
+                switch (c) {
+                    case 'n': out += '\n'; break;
+                    case 't': out += '\t'; break;
+                    case 'r': out += '\r'; break;
+                    case 'b': out += '\b'; break;
+                    case 'f': out += '\f'; break;
+                    case 'u':
+                        // keep the escape VERBATIM (digits included) — we
+                        // only need equality, not decoding, but dropping
+                        // the digits would make distinct strings equal
+                        out += "\\u";
+                        if (end - p >= 6) {
+                            out.append(p + 2, 4);
+                            p += 4;
+                        }
+                        break;
+                    default: out += c;
+                }
+                p += 2;
+            } else {
+                out += *p++;
+            }
+        }
+        if (p >= end) {
+            ok = false;
+            return out;
+        }
+        ++p;  // closing quote
+        return out;
+    }
+
+    ValuePtr parse_obj() {
+        auto v = std::make_unique<Value>();
+        v->kind = Value::Obj;
+        consume('{');
+        skip();
+        if (consume('}')) return v;
+        while (ok) {
+            skip();
+            std::string key = parse_string();
+            if (!ok || !consume(':')) {
+                ok = false;
+                break;
+            }
+            v->obj[key] = parse();
+            skip();
+            if (consume(',')) continue;
+            if (consume('}')) break;
+            ok = false;
+        }
+        return v;
+    }
+
+    ValuePtr parse_arr() {
+        auto v = std::make_unique<Value>();
+        v->kind = Value::Arr;
+        consume('[');
+        skip();
+        if (consume(']')) return v;
+        while (ok) {
+            v->arr.push_back(parse());
+            skip();
+            if (consume(',')) continue;
+            if (consume(']')) break;
+            ok = false;
+        }
+        return v;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// subset drift
+// ---------------------------------------------------------------------------
+
+bool drifted(const Value& desired, const Value& live) {
+    if (desired.kind == Value::Obj) {
+        if (live.kind != Value::Obj) return true;
+        for (const auto& kv : desired.obj) {
+            auto it = live.obj.find(kv.first);
+            if (it == live.obj.end()) return true;
+            if (drifted(*kv.second, *it->second)) return true;
+        }
+        return false;
+    }
+    if (desired.kind == Value::Arr) {
+        if (live.kind != Value::Arr) return true;
+        if (desired.arr.size() != live.arr.size()) return true;
+        for (size_t i = 0; i < desired.arr.size(); ++i) {
+            if (drifted(*desired.arr[i], *live.arr[i])) return true;
+        }
+        return false;
+    }
+    if (desired.kind == Value::Num) {
+        return live.kind != Value::Num ||
+               std::fabs(desired.num - live.num) > 1e-9;
+    }
+    if (desired.kind == Value::Str) {
+        return live.kind != Value::Str || desired.str != live.str;
+    }
+    if (desired.kind == Value::Bool) {
+        return live.kind != Value::Bool || desired.b != live.b;
+    }
+    return live.kind != Value::Null;  // desired null: live must be null
+}
+
+}  // namespace
+
+extern "C" {
+
+int rc_subset_drifted(const char* desired_json, const char* live_json) {
+    Parser pd(desired_json), pl(live_json);
+    ValuePtr d = pd.parse();
+    ValuePtr l = pl.parse();
+    if (!pd.ok || !pl.ok) return -1;
+    return drifted(*d, *l) ? 1 : 0;
+}
+
+}  // extern "C"

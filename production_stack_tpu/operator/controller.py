@@ -248,14 +248,102 @@ def build_cache_server_deployment(cr: dict, image: str) -> dict:
 # ---------------------------------------------------------------------------
 
 def _deploy_drifted(live: dict, desired: dict) -> bool:
-    ls, ds = live.get("spec", {}), desired.get("spec", {})
-    lc = ls.get("template", {}).get("spec", {}).get("containers", [{}])[0]
-    dc = ds.get("template", {}).get("spec", {}).get("containers", [{}])[0]
-    return (
-        ls.get("replicas") != ds.get("replicas")
-        or lc.get("image") != dc.get("image")
-        or lc.get("args") != dc.get("args")
-    )
+    """Deep drift: the WHOLE desired spec is compared subset-wise against
+    the live object (reference deploymentNeedsUpdate compares replicas,
+    model URL, port, image, resources, env — vllmruntime_controller.go:934;
+    subset drift covers all of those plus args/nodeSelector/volumes).
+    Decision core is compiled C++ (operator/drift.py)."""
+    from production_stack_tpu.operator.drift import subset_drifted
+
+    return subset_drifted(desired.get("spec", {}), live.get("spec", {}))
+
+
+def build_scaled_object(cr: dict) -> dict:
+    """KEDA ScaledObject from the CR's autoscaling block (reference:
+    reconcileScaledObject, vllmruntime_controller.go:1136). Targets the
+    CR's scale subresource so KEDA drives .spec.replicas and the runtime
+    reconciler rolls the Deployment."""
+    spec = cr.get("spec", {})
+    au = spec.get("autoscaling", {})
+    name = cr["metadata"]["name"]
+    served = spec.get("servedModelName") or spec.get("model", "")
+    up = au.get("scaleUp", {})
+    down = au.get("scaleDown", {})
+    metric = au.get("metric", "vllm:num_requests_waiting")
+    query = (f'sum({metric}{{namespace="{cr["metadata"]["namespace"]}", '
+             f'model="{served}"}})')
+    return {
+        "apiVersion": "keda.sh/v1alpha1",
+        "kind": "ScaledObject",
+        "metadata": {
+            "name": f"{name}-scaledobject",
+            "namespace": cr["metadata"]["namespace"],
+            "ownerReferences": [_owner_ref(cr)],
+        },
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": f"{GROUP}/{VERSION}",
+                "kind": "TPURuntime",
+                "name": name,
+            },
+            "minReplicaCount": au.get("minReplicas", 1),
+            "maxReplicaCount": au.get("maxReplicas", 8),
+            "pollingInterval": au.get("pollingInterval", 15),
+            "cooldownPeriod": au.get("cooldownPeriod", 300),
+            "advanced": {
+                "horizontalPodAutoscalerConfig": {
+                    "behavior": {
+                        "scaleUp": {
+                            "stabilizationWindowSeconds":
+                                up.get("stabilizationWindowSeconds", 0),
+                            "policies": [{
+                                "type": "Pods",
+                                "value": up.get("podValue", 4),
+                                "periodSeconds": up.get("periodSeconds", 15),
+                            }],
+                        },
+                        "scaleDown": {
+                            "stabilizationWindowSeconds":
+                                down.get("stabilizationWindowSeconds", 300),
+                            "policies": [{
+                                "type": "Pods",
+                                "value": down.get("podValue", 1),
+                                "periodSeconds": down.get("periodSeconds", 60),
+                            }],
+                        },
+                    },
+                },
+            },
+            "triggers": [{
+                "type": "prometheus",
+                "metricType": "Value",
+                "metadata": {
+                    "serverAddress": au.get(
+                        "prometheusAddress",
+                        "http://prometheus-operated.monitoring.svc:9090"),
+                    "metricName": metric.replace(":", "_"),
+                    "query": query,
+                    "threshold": str(au.get("threshold", "8")),
+                },
+            }],
+        },
+    }
+
+
+def _model_status(dep: Optional[dict], want_replicas: int) -> str:
+    """Ready/Updating/NotReady/Unknown mapping (reference status logic,
+    vllmruntime_controller.go:1110-1121)."""
+    st = (dep or {}).get("status", {})
+    avail = st.get("availableReplicas", 0)
+    unavail = st.get("unavailableReplicas", 0)
+    updated = st.get("updatedReplicas", 0)
+    if avail == want_replicas and not unavail:
+        return "Ready"
+    if updated > 0 and (avail != want_replicas or unavail > 0):
+        return "Updating"  # rollout in progress (incl. surge: avail==want)
+    if unavail > 0:
+        return "NotReady"
+    return "Unknown"
 
 
 class Operator:
@@ -307,7 +395,8 @@ class Operator:
         if live is None:
             await self.client.create(path_base, desired)
             logger.info("created %s %s", desired["kind"], name)
-        elif desired["kind"] == "Deployment" and _deploy_drifted(live, desired):
+        elif (desired["kind"] in ("Deployment", "ScaledObject")
+              and _deploy_drifted(live, desired)):
             desired["metadata"]["resourceVersion"] = live["metadata"].get(
                 "resourceVersion", "")
             await self.client.replace(f"{path_base}/{name}", desired)
@@ -336,14 +425,34 @@ class Operator:
         await self._ensure(services, build_engine_service(cr))
         if cr["spec"].get("pvcStorage"):
             await self._ensure(pvcs, build_pvc(cr))
+        autoscaling = cr["spec"].get("autoscaling") or {}
+        scaled = f"/apis/keda.sh/v1alpha1/namespaces/{self.ns}/scaledobjects"
+        if autoscaling and autoscaling.get("enabled", True):
+            await self._ensure(scaled, build_scaled_object(cr))
+        else:
+            # autoscaling turned off: a leftover ScaledObject would keep
+            # overwriting manually pinned replicas — remove it
+            if await self.client.get(f"{scaled}/{name}-scaledobject"):
+                try:
+                    await self.client.delete(f"{scaled}/{name}-scaledobject")
+                    logger.info("deleted ScaledObject %s-scaledobject "
+                                "(autoscaling disabled)", name)
+                except Exception as e:
+                    logger.warning("delete ScaledObject failed: %s", e)
         live = await self.client.get(f"{deploys}/{name}-engine")
+        want = cr["spec"].get("replicas", 1)
         await self._set_status(
             "tpuruntimes", name,
             {
-                "replicas": cr["spec"].get("replicas", 1),
+                "replicas": want,
                 "availableReplicas": (live or {}).get("status", {}).get(
                     "availableReplicas", 0),
+                "updatedReplicas": (live or {}).get("status", {}).get(
+                    "updatedReplicas", 0),
+                "unavailableReplicas": (live or {}).get("status", {}).get(
+                    "unavailableReplicas", 0),
                 "selector": f"{GROUP}/model={name}",
+                "modelStatus": _model_status(live, want),
                 "state": "Reconciled",
             },
         )
@@ -468,13 +577,32 @@ def main(argv=None) -> None:
     p.add_argument("--api-server", default=None)
     p.add_argument("--engine-image", default=DEFAULT_ENGINE_IMAGE)
     p.add_argument("--router-image", default=DEFAULT_ROUTER_IMAGE)
+    p.add_argument("--leader-elect", action="store_true",
+                   help="coordinate replicas through a coordination.k8s.io "
+                        "Lease; only the holder reconciles")
+    p.add_argument("--lease-name", default="tpu-serving-operator")
+    p.add_argument("--lease-seconds", type=int, default=15)
     args = p.parse_args(argv)
 
     async def run():
-        op = Operator(
-            K8sClient(api_server=args.api_server), namespace=args.namespace,
-            engine_image=args.engine_image, router_image=args.router_image,
-        )
+        client = K8sClient(api_server=args.api_server)
+        op = Operator(client, namespace=args.namespace,
+                      engine_image=args.engine_image,
+                      router_image=args.router_image)
+        if args.leader_elect:
+            from production_stack_tpu.operator.leader import LeaderElector
+
+            elector = LeaderElector(client, args.namespace,
+                                    lease_name=args.lease_name,
+                                    lease_seconds=args.lease_seconds)
+            await elector.acquire()
+            await op.start()
+            await elector.renew_loop()  # returns only on loss
+            # losing the lease: stop reconciling and exit non-zero so the
+            # Deployment restarts us into the candidate pool
+            # (controller-runtime behaviour)
+            await op.stop()
+            raise SystemExit(1)
         await op.start()
         await asyncio.gather(*op._tasks)
 

@@ -1,0 +1,77 @@
+"""Drift detection for reconcilers: subset comparison desired-vs-live.
+
+The decision core is compiled C++ (native/reconciler/reconcile_core.cpp —
+the first compiled piece of the operator, mirroring the reference's Go
+deploymentNeedsUpdate, vllmruntime_controller.go:934). Loaded over ctypes
+like native/hashtrie; a behaviour-identical Python fallback runs when the
+.so isn't built.
+
+Subset semantics: every key in ``desired`` must exist in ``live`` with a
+deeply-equal value; keys only in ``live`` are ignored (the apiserver
+defaults dozens of fields the operator doesn't manage). Lists compare
+element-wise at equal length.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Any, Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "reconciler",
+        "libreconcile.so",
+    )
+    try:
+        lib = ctypes.CDLL(os.path.abspath(so))
+        lib.rc_subset_drifted.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.rc_subset_drifted.restype = ctypes.c_int
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def _py_subset_drifted(desired: Any, live: Any) -> bool:
+    if isinstance(desired, dict):
+        if not isinstance(live, dict):
+            return True
+        return any(
+            k not in live or _py_subset_drifted(v, live[k])
+            for k, v in desired.items()
+        )
+    if isinstance(desired, list):
+        if not isinstance(live, list) or len(desired) != len(live):
+            return True
+        return any(_py_subset_drifted(d, l) for d, l in zip(desired, live))
+    if isinstance(desired, bool) or isinstance(live, bool):
+        return type(desired) is not type(live) or desired != live
+    if isinstance(desired, (int, float)) and isinstance(live, (int, float)):
+        return abs(desired - live) > 1e-9
+    return desired != live
+
+
+def subset_drifted(desired: Any, live: Any) -> bool:
+    """True when ``live`` does not carry everything ``desired`` specifies."""
+    lib = _load()
+    if lib is not None:
+        rc = lib.rc_subset_drifted(
+            json.dumps(desired).encode(), json.dumps(live).encode()
+        )
+        if rc >= 0:
+            return bool(rc)
+    return _py_subset_drifted(desired, live)
+
+
+def using_native() -> bool:
+    return _load() is not None

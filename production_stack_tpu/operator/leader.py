@@ -1,0 +1,181 @@
+"""Lease-based leader election for the operator.
+
+Parity with the reference's controller-runtime manager
+(operator/cmd/main.go: LeaderElection + LeaderElectionID there): exactly
+one operator replica reconciles at a time, coordinated through a
+coordination.k8s.io/v1 Lease. A replica acquires the lease when it is
+absent, expired, or already its own; renews at a third of the lease
+duration; and, on losing the lease (apiserver partition, faster peer),
+signals the caller so it can stop reconciling — controller-runtime's
+behaviour is to exit the process and let the Deployment restart it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import uuid
+from typing import Optional
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def _now() -> str:
+    return (datetime.datetime.now(datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z")
+
+
+def _parse(ts: str) -> Optional[datetime.datetime]:
+    try:
+        return datetime.datetime.strptime(
+            ts.rstrip("Z"), "%Y-%m-%dT%H:%M:%S.%f"
+        ).replace(tzinfo=datetime.timezone.utc)
+    except (ValueError, AttributeError):
+        return None
+
+
+class LeaderElector:
+    def __init__(self, client, namespace: str,
+                 lease_name: str = "tpu-serving-operator",
+                 identity: Optional[str] = None,
+                 lease_seconds: int = 15):
+        self.client = client
+        self.ns = namespace
+        self.lease_name = lease_name
+        self.identity = identity or f"operator-{uuid.uuid4().hex[:8]}"
+        self.lease_seconds = lease_seconds
+        self.is_leader = False
+        self.lost = asyncio.Event()
+        # (holder, renewTime) last observed + local monotonic time of the
+        # observation: expiry is timed on OUR clock from when we saw the
+        # record last change, never by comparing the holder's timestamp to
+        # our wall clock (clock skew between replicas must not elect two
+        # leaders — controller-runtime does the same)
+        self._observed: Optional[tuple] = None
+        self._observed_at: float = 0.0
+
+    @property
+    def _path(self) -> str:
+        return (f"/apis/coordination.k8s.io/v1/namespaces/{self.ns}"
+                f"/leases/{self.lease_name}")
+
+    def _lease_body(self, prev: Optional[dict]) -> dict:
+        transitions = 0
+        if prev is not None:
+            spec = prev.get("spec", {})
+            transitions = spec.get("leaseTransitions", 0)
+            if spec.get("holderIdentity") != self.identity:
+                transitions += 1
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name, "namespace": self.ns},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_seconds,
+                "renewTime": _now(),
+                "acquireTime": (prev or {}).get("spec", {}).get(
+                    "acquireTime", _now()),
+                "leaseTransitions": transitions,
+            },
+        }
+        if prev is not None and prev.get("metadata", {}).get("resourceVersion"):
+            body["metadata"]["resourceVersion"] = \
+                prev["metadata"]["resourceVersion"]
+        return body
+
+    def _expired(self, lease: dict) -> bool:
+        """Expired = the record has not CHANGED for a full lease duration,
+        timed on the local monotonic clock from our first observation."""
+        import time
+
+        spec = lease.get("spec", {})
+        record = (spec.get("holderIdentity"), spec.get("renewTime"))
+        now = time.monotonic()
+        if record != self._observed:
+            self._observed = record
+            self._observed_at = now
+            return spec.get("renewTime") is None
+        duration = spec.get("leaseDurationSeconds", self.lease_seconds)
+        return now - self._observed_at > duration
+
+    async def acquire(self) -> None:
+        """Block until this replica holds the lease."""
+        base = self._path.rsplit("/", 1)[0]
+        while True:
+            lease = await self.client.get(self._path)
+            if lease is None:
+                try:
+                    await self.client.create(base, self._lease_body(None))
+                    self.is_leader = True
+                    logger.info("leader election: %s acquired (new lease)",
+                                self.identity)
+                    return
+                except Exception:
+                    pass  # raced another replica; re-read
+            else:
+                holder = lease.get("spec", {}).get("holderIdentity")
+                if holder == self.identity or self._expired(lease):
+                    try:
+                        await self.client.replace(
+                            self._path, self._lease_body(lease)
+                        )
+                        self.is_leader = True
+                        logger.info(
+                            "leader election: %s acquired (from %s)",
+                            self.identity, holder,
+                        )
+                        return
+                    except Exception:
+                        pass  # conflict; retry
+            await asyncio.sleep(self.lease_seconds / 3)
+
+    async def renew_loop(self) -> None:
+        """Renew until cancelled; on loss, set ``lost`` and return.
+
+        Transient API errors are retried until a full lease duration has
+        passed without a successful renewal (controller-runtime's
+        RenewDeadline behaviour) — a single apiserver blip must not dethrone
+        a healthy leader. Loss is immediate only when another holder owns a
+        live lease."""
+        import time
+
+        last_renewed = time.monotonic()
+        while True:
+            await asyncio.sleep(self.lease_seconds / 3)
+            try:
+                lease = await self.client.get(self._path)
+                holder = (lease or {}).get("spec", {}).get("holderIdentity")
+                if (lease is not None and holder != self.identity
+                        and not self._expired(lease)):
+                    logger.warning(
+                        "leader election: %s lost the lease to %s",
+                        self.identity, holder,
+                    )
+                    break
+                if lease is None:
+                    await self.client.create(
+                        self._path.rsplit("/", 1)[0], self._lease_body(None)
+                    )
+                else:
+                    await self.client.replace(self._path,
+                                              self._lease_body(lease))
+                last_renewed = time.monotonic()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if time.monotonic() - last_renewed <= self.lease_seconds:
+                    logger.warning(
+                        "leader election: renew attempt failed (%s); "
+                        "retrying", e,
+                    )
+                    continue
+                logger.warning(
+                    "leader election: %s renewal deadline exceeded (%s)",
+                    self.identity, e,
+                )
+                break
+        self.is_leader = False
+        self.lost.set()
