@@ -256,11 +256,13 @@ class LLMEngine:
         if prefills[0].ring:
             return self._run_prefill_ring(prefills[0])
         bs = self.config.cache.block_size
-        # two batch-dim variants only (1 and prefill_batch): a lone prompt
-        # must not pay prefill_batch x bucket dense-transformer tokens
-        # (inactive rows skip attention but not QKV/MLP), while finer
-        # power-of-two steps would multiply compile variants
-        P = 1 if len(prefills) == 1 else self.config.scheduler.prefill_batch
+        # batch-dim padded to the next power of two: inactive rows skip
+        # attention but still pay QKV/MLP, so padding 2 live 512-token
+        # chunks to P=8 would burn 4x the prefill FLOPs (measured: the
+        # long-context phase ran at 1/3 of the raw prefill rate). Pow-2
+        # classes keep the compile-variant count logarithmic.
+        P = 1 << (len(prefills) - 1).bit_length()
+        P = min(P, self.config.scheduler.prefill_batch)
         M = self.runner.max_blocks_per_seq
         bucket = self._bucket(max(sp.chunk_len for sp in prefills))
 
@@ -541,11 +543,28 @@ class LLMEngine:
             if self._bucket(n) != b:
                 continue  # budget caps chunks below this bucket: never used
             run([rng.integers(1, vocab, n).tolist()], 0.0)
-        # P=prefill_batch variant + the general (non-greedy) sampler
-        small = min(buckets[0], 64)
-        batch = [rng.integers(1, vocab, small).tolist()
-                 for _ in range(max(sched.prefill_batch, 2))]
-        run(batch, 0.7)
+        # every reachable (pow-2 rows, bucket) prefill variant, greedy and
+        # sampled: rows pad to the next power of two of the live chunk
+        # count (capped at prefill_batch — the cap itself is a class when
+        # prefill_batch isn't a power of two), and a bucket-b step can
+        # carry at most budget//(b/2+1)+1 chunks
+        budget = sched.max_num_batched_tokens
+        row_classes = sorted({
+            min(1 << i, sched.prefill_batch)
+            for i in range(1, max((sched.prefill_batch - 1).bit_length(), 0)
+                           + 1)
+        })
+        for b in buckets:
+            lo = b // 2 + 1 if b > buckets[0] else 1
+            max_rows = min(sched.prefill_batch, budget // lo + 1)
+            for p in row_classes:
+                if p > max_rows:
+                    break
+                n = min(lo + 1, b)
+                batch = [rng.integers(1, vocab, n).tolist()
+                         for _ in range(p)]
+                run(batch, 0.0)
+                run(batch, 0.7)
         # penalised decode variant (static use_penalties flag)
         sp = SamplingParams(temperature=0.0, presence_penalty=0.5,
                             max_tokens=max(sched.multi_step, 1) + 1,

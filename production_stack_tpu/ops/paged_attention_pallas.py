@@ -48,95 +48,153 @@ def _decode_kernel(
     cl_ref,  # (B,) SMEM
     layer_ref,  # (1,) SMEM
     # inputs
-    q_ref,  # (1, KH, G, D) VMEM
+    q_ref,  # (SPB, KH, G, D) VMEM — SPB sequences per grid cell
     kv_hbm,  # (L, N, bs, 2KH, D) ANY
     # outputs
-    o_ref,  # (1, KH, G, D) VMEM
+    o_ref,  # (SPB, KH, G, D) VMEM
     # scratch
-    buf,  # (2, W, bs, 2KH, D) VMEM
-    sems,  # (2, W) DMA sems
+    buf,  # (2, SPB, W, bs, 2KH, D) VMEM
+    sems,  # (2, SPB, W) DMA sems
     *,
     block_size: int,
     windows: int,
+    seqs_per_cell: int,
     scale: float,
 ):
-    b = pl.program_id(0)
+    """Batched paged decode attention.
+
+    Grid cells run SEQUENTIALLY on a TensorCore (measured: per-cell
+    overhead dominates at one sequence per cell — 192 seqs x 28 layers x 16
+    fused steps ≈ 86k cell executions per dispatch). Each cell therefore
+    handles SPB sequences: their window DMAs are all in flight together
+    (SPB x W parallel copies) and the QK^T / PV matmuls batch over the
+    sequence dim — batch dims at position 0 on both operands, the layout
+    Mosaic's batched matmul requires."""
+    cell = pl.program_id(0)
     layer = layer_ref[0]
-    ctx = cl_ref[b]
+    SPB = seqs_per_cell
     W = windows
     bs = block_size
     KH, G, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     win_tokens = W * bs
-    nwin = pl.cdiv(ctx, win_tokens)
+    base = cell * SPB
+    # per-cell window count: the longest context in the cell (shorter
+    # sequences mask the tail; dead slots carry ctx 0)
+    nwin = pl.cdiv(cl_ref[base], win_tokens)
+    for s in range(1, SPB):
+        nwin = jnp.maximum(nwin, pl.cdiv(cl_ref[base + s], win_tokens))
 
-    def dma(slot, w, j):
-        bid = bt_ref[b, w * W + j]
+    def dma(slot, s, w, j):
+        bid = bt_ref[base + s, w * W + j]
         return pltpu.make_async_copy(
-            kv_hbm.at[layer, bid], buf.at[slot, j], sems.at[slot, j]
+            kv_hbm.at[layer, bid], buf.at[slot, s, j], sems.at[slot, s, j]
         )
 
+    # per-sequence predication: a short or dead slot grouped with a long
+    # context must not stream masked-out garbage for the group's extra
+    # windows — this kernel is HBM-bound, the skipped traffic is pure win.
+    # wait() uses the same predicate so waits match issues exactly.
+    def seq_active(s, w):
+        return w * win_tokens < cl_ref[base + s]
+
     def issue(slot, w):
-        for j in range(W):
-            dma(slot, w, j).start()
+        for s in range(SPB):
+            @pl.when(seq_active(s, w))
+            def _():
+                for j in range(W):
+                    dma(slot, s, w, j).start()
 
     @pl.when(nwin > 0)
     def _():
         issue(0, 0)
 
-    q = q_ref[0].astype(jnp.float32)  # (KH, G, D)
-
+    # per-seq tensors stay <=3D throughout (Mosaic's layout inference
+    # rejects middle-dim squeezes/merges on 4D); the flash state is a flat
+    # tuple of per-seq (m, l, acc) triples on the fori carry
     def body(w, carry):
-        m, l, acc = carry
         slot = jax.lax.rem(w, 2)
 
         @pl.when(w + 1 < nwin)
         def _():
             issue(jax.lax.rem(w + 1, 2), w + 1)
 
-        for j in range(W):
-            dma(slot, w, j).wait()
+        for s in range(SPB):
+            @pl.when(seq_active(s, w))
+            def _():
+                for j in range(W):
+                    dma(slot, s, w, j).wait()
 
-        kv = buf[slot].reshape(win_tokens, 2 * KH, D)  # (T, 2KH, D)
-        # per-head static loop: Mosaic's batched matmul needs batch dims at
-        # position 0 on both operands, which this layout can't provide
-        s_heads = []
-        for h in range(KH):
-            k_h = kv[:, h, :].astype(jnp.float32)  # (T, D)
-            s_heads.append(
-                jax.lax.dot_general(
-                    q[h], k_h, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )  # (G, T)
-        s = jnp.stack(s_heads) * scale  # (KH, G, T)
         kvpos = w * win_tokens + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, win_tokens), 2
         )
-        s = jnp.where(kvpos < ctx, s, NEG_INF)
+        out = []
+        for s in range(SPB):
+            m, l, acc = carry[3 * s : 3 * s + 3]
+            ctx = cl_ref[base + s]
+            q = q_ref[s].astype(jnp.float32)  # (KH, G, D)
+            kv = jnp.concatenate(
+                [buf[slot, s, j] for j in range(W)], axis=0
+            )  # (T, 2KH, D)
+            s_heads = []
+            for h in range(KH):
+                k_h = kv[:, h, :].astype(jnp.float32)  # (T, D)
+                s_heads.append(
+                    jax.lax.dot_general(
+                        q[h], k_h, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )  # (G, T)
+            sc = jnp.stack(s_heads) * scale  # (KH, G, T)
+            sc = jnp.where(kvpos < ctx, sc, NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_heads = []
-        for h in range(KH):
-            v_h = kv[:, KH + h, :].astype(jnp.float32)  # (T, D)
-            acc_heads.append(
-                jax.lax.dot_general(
-                    p[h], v_h, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )  # (G, D)
-        acc_new = acc * alpha + jnp.stack(acc_heads)
-        return m_new, l_new, acc_new
+            m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(sc - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_heads = []
+            for h in range(KH):
+                v_h = kv[:, KH + h, :].astype(jnp.float32)  # (T, D)
+                acc_heads.append(
+                    jax.lax.dot_general(
+                        p[h], v_h, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )  # (G, D)
+            acc_new = acc * alpha + jnp.stack(acc_heads)
+            # a seq inactive this window skipped its DMAs: buf holds
+            # unwritten bits that can be NaN/Inf, and 0 x NaN = NaN — keep
+            # the old carry instead of trusting masked math
+            act = seq_active(s, w)
+            out += [
+                jnp.where(act, m_new, m),
+                jnp.where(act, l_new, l),
+                jnp.where(act, acc_new, acc),
+            ]
+        return tuple(out)
 
-    init = (
-        jnp.full((KH, G, 1), NEG_INF, jnp.float32),
-        jnp.zeros((KH, G, 1), jnp.float32),
-        jnp.zeros((KH, G, D), jnp.float32),
-    )
-    m, l, acc = jax.lax.fori_loop(0, nwin, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    init = []
+    for _ in range(SPB):
+        init += [
+            jnp.full((KH, G, 1), NEG_INF, jnp.float32),
+            jnp.zeros((KH, G, 1), jnp.float32),
+            jnp.zeros((KH, G, D), jnp.float32),
+        ]
+    final = jax.lax.fori_loop(0, nwin, body, tuple(init))
+    for s in range(SPB):
+        l, acc = final[3 * s + 1], final[3 * s + 2]
+        o_ref[s] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pick_seqs_per_cell(B: int, bs: int, KH2: int, D: int, windows: int,
+                        itemsize: int) -> int:
+    """Largest SPB dividing B whose double-buffered window scratch fits a
+    VMEM budget (~8 MB, half the scoped limit)."""
+    budget = 8 * 1024 * 1024
+    per_seq = 2 * windows * bs * KH2 * D * itemsize
+    spb = max(budget // per_seq, 1)
+    while spb > 1 and B % spb:
+        spb -= 1
+    return int(min(spb, B))
 
 
 def paged_decode_attention_pallas(
@@ -156,23 +214,26 @@ def paged_decode_attention_pallas(
     # heads ordered [h0..h_{KH-1}] matching [K_0..K_{KH-1}] halves
     q4 = q.reshape(B, KH, G, D)
     layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    spb = _pick_seqs_per_cell(B, bs, KH2, D, windows,
+                              jnp.dtype(kv_cache.dtype).itemsize)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B,),
+        grid=(B // spb,),
         in_specs=[
-            pl.BlockSpec((1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+            pl.BlockSpec((spb, KH, G, D), lambda b, *_: (b, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+        out_specs=pl.BlockSpec((spb, KH, G, D), lambda b, *_: (b, 0, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, windows, bs, KH2, D), kv_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, windows)),
+            pltpu.VMEM((2, spb, windows, bs, KH2, D), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, spb, windows)),
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, block_size=bs, windows=windows, scale=D**-0.5
+        _decode_kernel, block_size=bs, windows=windows, seqs_per_cell=spb,
+        scale=D**-0.5,
     )
     out = pl.pallas_call(
         kernel,
