@@ -34,10 +34,14 @@ model-label: {{ .spec.modelLabel }}
 {{- define "stack.tpuResources" -}}
 resources:
   requests:
-    {{- toYaml (.spec.resources.requests | default dict) | nindent 4 }}
+    {{- with ((.spec.resources | default dict).requests) }}
+    {{- toYaml . | nindent 4 }}
+    {{- end }}
     google.com/tpu: {{ .spec.tpu.chips | quote }}
   limits:
-    {{- toYaml (.spec.resources.limits | default dict) | nindent 4 }}
+    {{- with ((.spec.resources | default dict).limits) }}
+    {{- toYaml . | nindent 4 }}
+    {{- end }}
     google.com/tpu: {{ .spec.tpu.chips | quote }}
 {{- end -}}
 

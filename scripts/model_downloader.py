@@ -1,0 +1,191 @@
+"""Model downloader sidecar / init container.
+
+The reference ships an HF-downloader sidecar
+(scripts/huggingface_downloader.py:14-30 there: a FastAPI service wrapping
+huggingface_hub.snapshot_download into a shared volume). This is the TPU
+stack's equivalent, supporting the sources its engines load:
+
+  hf://org/model     Hugging Face snapshot (huggingface_hub; HF_TOKEN env
+                     or request token for gated models)
+  gs://bucket/path   GCS (gsutil if present, else gcsfs) — typically an
+                     Orbax checkpoint the engine restores sharded
+  file:///path, /path local copy (tests, pre-staged NFS)
+
+Two modes:
+  one-shot (init container):  python scripts/model_downloader.py \
+      --uri hf://meta-llama/Llama-3.1-8B --dest /models/llama3-8b
+    Exits 0 after writing <dest>/.ready (idempotent: a present marker
+    skips the download), so the engine container starts only with weights
+    in place.
+  service (sidecar):  python scripts/model_downloader.py --serve --port 8200
+    POST /model/download {"uri": ..., "local_dir": ..., "token": ...}
+    (the reference's contract, model_id accepted as an alias for uri).
+
+Dependency-light: aiohttp only; huggingface_hub/gsutil are used when the
+URI needs them and fail with a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+
+class DownloadError(RuntimeError):
+    pass
+
+
+def _ready_marker(dest: str) -> str:
+    return os.path.join(dest, ".ready")
+
+
+def download(uri: str, dest: str, token: str | None = None,
+             force: bool = False) -> str:
+    """Fetch ``uri`` into ``dest``; idempotent via a .ready marker."""
+    dest = os.path.abspath(dest)
+    if os.path.isfile(_ready_marker(dest)) and not force:
+        return dest
+    os.makedirs(dest, exist_ok=True)
+
+    if uri.startswith("hf://"):
+        _download_hf(uri[len("hf://"):], dest, token)
+    elif uri.startswith("gs://"):
+        _download_gcs(uri, dest)
+    elif uri.startswith("file://"):
+        _copy_local(uri[len("file://"):], dest)
+    elif uri.startswith("/") or os.path.exists(uri):
+        _copy_local(uri, dest)
+    else:
+        # bare "org/model" is an HF repo id (reference contract)
+        _download_hf(uri, dest, token)
+
+    with open(_ready_marker(dest), "w") as f:
+        f.write(uri + "\n")
+    return dest
+
+
+def _download_hf(repo_id: str, dest: str, token: str | None) -> None:
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:
+        raise DownloadError(
+            "huggingface_hub is not installed in this image; bake it into "
+            "the sidecar image or pre-stage the weights"
+        ) from e
+    snapshot_download(
+        repo_id, local_dir=dest,
+        token=token or os.environ.get("HF_TOKEN") or None,
+    )
+
+
+def _download_gcs(uri: str, dest: str) -> None:
+    gsutil = shutil.which("gsutil")
+    if gsutil:
+        proc = subprocess.run(
+            [gsutil, "-m", "rsync", "-r", uri.rstrip("/"), dest],
+            capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise DownloadError(f"gsutil rsync failed: {proc.stderr[-500:]}")
+        return
+    try:
+        import gcsfs
+    except ImportError as e:
+        raise DownloadError(
+            "neither gsutil nor gcsfs available for gs:// downloads"
+        ) from e
+    fs = gcsfs.GCSFileSystem()
+    fs.get(uri.rstrip("/") + "/", dest, recursive=True)
+
+
+def _copy_local(src: str, dest: str) -> None:
+    if not os.path.exists(src):
+        raise DownloadError(f"source path {src} does not exist")
+    if os.path.isfile(src):
+        shutil.copy2(src, dest)
+        return
+    shutil.copytree(src, dest, dirs_exist_ok=True)
+
+
+# ---------------------------------------------------------------------------
+# sidecar HTTP service (reference: POST /model/download)
+# ---------------------------------------------------------------------------
+
+def build_app(base_dir: str):
+    from aiohttp import web
+
+    base_dir = os.path.abspath(base_dir)
+
+    async def handle(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        uri = body.get("uri") or body.get("model_id")
+        local_dir = body.get("local_dir")
+        if not uri or not local_dir:
+            return web.json_response(
+                {"error": "'uri' (or 'model_id') and 'local_dir' required"},
+                status=400,
+            )
+        target = os.path.abspath(os.path.join(base_dir, local_dir))
+        # sibling dirs like /models-evil must not pass a bare prefix check
+        if target != base_dir and not target.startswith(base_dir + os.sep):
+            return web.json_response(
+                {"error": "invalid 'local_dir'"}, status=400
+            )
+        import asyncio
+
+        try:
+            path = await asyncio.to_thread(
+                download, uri, target, body.get("token"),
+                bool(body.get("force")),
+            )
+        except DownloadError as e:
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"message": f"downloaded {uri}",
+                                  "path": path})
+
+    async def health(request) -> "web.Response":
+        return web.json_response({"status": "healthy"})
+
+    app = web.Application()
+    app.router.add_post("/model/download", handle)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("model-downloader")
+    p.add_argument("--uri", help="one-shot: source URI")
+    p.add_argument("--dest", help="one-shot: destination directory")
+    p.add_argument("--token", default=None)
+    p.add_argument("--force", action="store_true")
+    p.add_argument("--serve", action="store_true", help="run as a sidecar")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--base-dir", default="/models")
+    args = p.parse_args(argv)
+
+    if args.serve:
+        from aiohttp import web
+
+        web.run_app(build_app(args.base_dir), port=args.port,
+                    access_log=None)
+        return 0
+
+    if not args.uri or not args.dest:
+        p.error("--uri and --dest are required in one-shot mode")
+    try:
+        path = download(args.uri, args.dest, args.token, args.force)
+    except DownloadError as e:
+        print(f"download failed: {e}", file=sys.stderr)
+        return 1
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,8 @@ Supported subset (everything this chart uses):
   functions   include, tpl, toYaml, nindent, indent, default, quote,
               squote, trunc, trimSuffix, printf, ternary, empty, dict,
               list, eq, ne, and, or, not, lt, gt, int, toString, b64enc,
-              lower, upper, join, hasKey, required, fromYaml
+              lower, upper, join, hasKey, hasPrefix, hasSuffix,
+              required, fromYaml
   pipelines   a | b | c (previous value appended as the LAST argument)
 
 CLI: python tools/minihelm.py <chartdir> [--set-file overrides.yaml]
@@ -36,6 +37,9 @@ _COMMENT_RE = re.compile(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}", re.DOTALL)
 
 class TemplateError(Exception):
     pass
+
+
+_NO_PIPE = object()  # sentinel: "this call segment has nothing piped in"
 
 
 # ---------------------------------------------------------------------------
@@ -249,8 +253,10 @@ class Renderer:
         return val
 
     def eval_pipeline(self, toks, i, dot, vars_):
-        val, i = self.eval_call(toks, i, dot, vars_, None)
+        val, i = self.eval_call(toks, i, dot, vars_, _NO_PIPE)
         while i < len(toks) and toks[i] == "|":
+            # a piped value may legitimately be None — sentinel, not None,
+            # distinguishes "nothing piped"
             val, i = self.eval_call(toks, i + 1, dot, vars_, val)
         return val, i
 
@@ -269,7 +275,7 @@ class Renderer:
                     val = (val.get(p) if isinstance(val, dict)
                            else getattr(val, p, None))
                 i += 1
-            if piped is not None:
+            if piped is not _NO_PIPE:
                 raise TemplateError("cannot pipe into parenthesized value")
             return val, i
         head = toks[i]
@@ -291,7 +297,7 @@ class Renderer:
                     v = self.eval_atom(toks[i], dot, vars_)
                     i += 1
                 args.append(v)
-            if piped is not None:
+            if piped is not _NO_PIPE:
                 args.append(piped)
             return FUNCTIONS[head](self, dot, vars_, *args), i
         # bare value — or a method call (.Files.Glob "pattern")
@@ -309,10 +315,10 @@ class Renderer:
                     v = self.eval_atom(toks[i], dot, vars_)
                     i += 1
                 args.append(v)
-            if piped is not None:
+            if piped is not _NO_PIPE:
                 args.append(piped)
             return val(*args), i
-        if piped is not None:
+        if piped is not _NO_PIPE:
             raise TemplateError(f"cannot pipe into {head!r}")
         return val, i
 
@@ -446,6 +452,10 @@ FUNCTIONS = {
     "join": lambda r, d, v, sep, xs: to_string(sep).join(
         to_string(x) for x in (xs or [])),
     "hasKey": lambda r, d, v, m, k: isinstance(m, dict) and k in m,
+    "hasPrefix": lambda r, d, v, pre, s: to_string(s).startswith(
+        to_string(pre)),
+    "hasSuffix": lambda r, d, v, suf, s: to_string(s).endswith(
+        to_string(suf)),
     "required": lambda r, d, v, msg, val: _required(msg, val),
 }
 
