@@ -425,19 +425,47 @@ class LLMEngine:
 
     def import_kv(self, prompt_token_ids: list[int], data) -> int:
         """Write transferred blocks into the pool and register their content
-        hashes so admission prefix-hits them. Returns tokens now cached."""
-        bs = self.config.cache.block_size
-        n_full = min(int(data.shape[1]), (len(prompt_token_ids) - 1) // bs)
-        if n_full <= 0:
+        hashes so admission prefix-hits them. Returns tokens now cached.
+        (Monolithic variant of the streamed begin/range/finish flow.)"""
+        got = self.begin_kv_import(prompt_token_ids, int(data.shape[1]))
+        if got is None:
             return 0
-        alloc = self.scheduler.allocator
-        local = alloc.take_free_blocks(n_full)
-        if local is None:
-            return 0
+        local, n_full = got
         self.runner.import_blocks(local, data[:, :n_full])
-        alloc.commit_full_blocks(prompt_token_ids[: n_full * bs], local)
-        alloc.free_blocks(local)  # refcount 0 → stays cached + matchable
-        return n_full * bs
+        return self.finish_kv_import(prompt_token_ids, local)
+
+    # -- streaming KV import (chunked layer-group transfer; see
+    #    engine/kv_transfer.py for the overlap pipeline) --------------------
+    def begin_kv_import(self, prompt_token_ids: list[int],
+                        n_remote_blocks: int):
+        """Reserve local blocks for an incoming streamed transfer. Returns
+        (local_block_ids, n_full_blocks) or None if the pool is full."""
+        bs = self.config.cache.block_size
+        n_full = min(n_remote_blocks, (len(prompt_token_ids) - 1) // bs)
+        if n_full <= 0:
+            return None
+        local = self.scheduler.allocator.take_free_blocks(n_full)
+        if local is None:
+            return None
+        return local, n_full
+
+    def import_kv_range(self, local_blocks: list[int], layer_lo: int,
+                        data) -> None:
+        self.runner.import_blocks_range(local_blocks, layer_lo, data)
+
+    def finish_kv_import(self, prompt_token_ids: list[int],
+                         local_blocks: list[int]) -> int:
+        """Commit the streamed blocks as prefix-cache content."""
+        bs = self.config.cache.block_size
+        alloc = self.scheduler.allocator
+        alloc.commit_full_blocks(
+            prompt_token_ids[: len(local_blocks) * bs], local_blocks
+        )
+        alloc.free_blocks(local_blocks)  # refcount 0 → cached + matchable
+        return len(local_blocks) * bs
+
+    def abort_kv_import(self, local_blocks: list[int]) -> None:
+        self.scheduler.allocator.free_blocks(local_blocks)
 
     def _check_stop(self, seq: Sequence, token: int) -> Optional[SequenceStatus]:
         s = seq.sampling

@@ -250,6 +250,13 @@ class PrefixCachingBlockAllocator:
                 blk.content_hash = prev
                 self.hash_to_block[prev] = blk.block_id
 
+    def pin_blocks(self, block_ids: Sequence[int]) -> None:
+        """Take a reference on blocks (e.g. for the duration of a streamed
+        KV export) so eviction/reallocation can't tear the data mid-use.
+        Release with free_blocks."""
+        for bid in block_ids:
+            self._take_cached(bid)
+
     def free_blocks(self, block_ids: Sequence[int]) -> None:
         for bid in block_ids:
             blk = self.blocks[bid]

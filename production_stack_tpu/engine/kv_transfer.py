@@ -1,0 +1,122 @@
+"""Chunked, overlapped KV transfer between engines (disagg P→D over DCN).
+
+The reference moves KV bytes between prefill and decode pods with
+NIXL/UCX side-channels (deployment-vllm-multi.yaml:304-335 there). The
+TPU-native constraint is different: KV lives in HBM behind a host, so a
+cross-slice transfer is device-gather → network → device-scatter. Round 1
+did that as one monolithic (L, n, bs, 2KH, D) blob, which serialises the
+three legs. This module streams LAYER GROUPS instead, so at steady state
+the producer's device gather of group i+1, the network send of group i,
+and the consumer's device scatter of group i-1 all run concurrently —
+the classic pipelined bulk transfer, sized so each leg's latency (incl.
+the dev tunnel's ~66 ms/dispatch) is hidden by the others.
+
+Wire format (HTTP chunked body, producer → consumer):
+  header (response headers): X-KV-Shape (full L,n,bs,2KH,D), X-KV-Dtype,
+  X-KV-Group-Layers
+  body: frames of [8-byte little-endian payload length][payload bytes],
+  one frame per layer group, in layer order. A zero length ends the
+  stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import AsyncIterator, Callable
+
+import numpy as np
+
+FRAME_HEADER = struct.Struct("<Q")
+
+
+def default_group(num_layers: int) -> int:
+    """Half the stack (two frames): measured on v5e behind the dev tunnel
+    (docs/roofline.md), each extra frame costs a full dispatch round trip
+    (59 MB / 32 blocks: 1 frame 1.6 s, 7 frames 4.9 s), while one frame
+    forfeits the consumer-side scatter/read overlap. Two frames keeps the
+    pipeline with negligible dispatch overhead; deployments with slow DCN
+    between slices should lower ``group_layers`` per request so the
+    network leg hides behind more gather/scatter chunks."""
+    return max(num_layers // 2, 1)
+
+
+def layer_groups(num_layers: int, group: int):
+    lo = 0
+    while lo < num_layers:
+        yield lo, min(group, num_layers - lo)
+        lo += group
+
+
+async def produce_frames(
+    run_on_engine: Callable,
+    blocks: list[int],
+    num_layers: int,
+    group: int | None = None,
+) -> AsyncIterator[bytes]:
+    """Yield length-prefixed layer-group frames; the NEXT group's device
+    gather runs while the current frame is being consumed (sent)."""
+
+    group = group or default_group(num_layers)
+
+    def fetch(lo: int, n: int):
+        return run_on_engine(
+            lambda eng: eng.runner.export_blocks_range(blocks, lo, n)
+        )
+
+    groups = list(layer_groups(num_layers, group))
+    pending = asyncio.ensure_future(fetch(*groups[0]))
+    for nxt in groups[1:]:
+        data = await pending
+        pending = asyncio.ensure_future(fetch(*nxt))  # overlap with send
+        payload = np.ascontiguousarray(data).tobytes()
+        yield FRAME_HEADER.pack(len(payload)) + payload
+    data = await pending
+    payload = np.ascontiguousarray(data).tobytes()
+    yield FRAME_HEADER.pack(len(payload)) + payload
+    yield FRAME_HEADER.pack(0)
+
+
+async def consume_frames(
+    content,
+    run_on_engine: Callable,
+    local_blocks: list[int],
+    shape: tuple,
+    dtype: str,
+    group: int,
+) -> None:
+    """Read frames from an aiohttp response ``content`` stream and scatter
+    each group; the scatter of group i overlaps the network read of group
+    i+1 (one import in flight at a time — the pool is donated through the
+    scatter, so imports serialise on the engine thread anyway)."""
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        np_dtype = jnp.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    L = shape[0]
+    per_group_shape = lambda n: (n, *shape[1:])  # noqa: E731
+    pending_import = None
+    lo = 0
+    while True:
+        head = await content.readexactly(FRAME_HEADER.size)
+        (nbytes,) = FRAME_HEADER.unpack(head)
+        if nbytes == 0:
+            break
+        payload = await content.readexactly(nbytes)
+        n = min(group, L - lo)
+        data = np.frombuffer(payload, np_dtype).reshape(per_group_shape(n))
+        if pending_import is not None:
+            await pending_import
+        this_lo = lo
+
+        def do_import(eng, data=data, this_lo=this_lo):
+            eng.import_kv_range(local_blocks, this_lo, data)
+
+        pending_import = asyncio.ensure_future(run_on_engine(do_import))
+        lo += n
+    if pending_import is not None:
+        await pending_import
+    if lo != L:
+        raise ValueError(f"short KV stream: got {lo}/{L} layers")

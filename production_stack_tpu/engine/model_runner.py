@@ -514,6 +514,41 @@ class ModelRunner:
             data = jax.jit(lambda kv, i: kv[:, i])(self.kv, idx)
         return np.asarray(jax.device_get(data))
 
+    def _range_fns(self, n_layers: int):
+        """Jitted export/import for one group size, cached on self — a
+        fresh jax.jit wrapper per frame would retrace every dispatch."""
+        cache = getattr(self, "_range_fn_cache", None)
+        if cache is None:
+            cache = self._range_fn_cache = {}
+        if n_layers not in cache:
+            def _slice(kv, i, lo):
+                grp = jax.lax.dynamic_slice_in_dim(kv, lo, n_layers, axis=0)
+                return grp[:, i]
+
+            def _scatter(kv, i, d, lo):
+                cur = jax.lax.dynamic_slice_in_dim(kv, lo, n_layers, axis=0)
+                cur = cur.at[:, i].set(d.astype(kv.dtype))
+                return jax.lax.dynamic_update_slice_in_dim(kv, cur, lo,
+                                                           axis=0)
+
+            cache[n_layers] = (
+                jax.jit(_slice),
+                jax.jit(_scatter, donate_argnums=(0,)),
+            )
+        return cache[n_layers]
+
+    def export_blocks_range(self, block_ids: list[int], layer_lo: int,
+                            n_layers: int) -> np.ndarray:
+        """Gather one layer GROUP of the requested blocks — the unit of the
+        chunked streaming transfer (kv_transfer.py): fetching layer groups
+        lets device gather, network send, and remote scatter overlap
+        instead of serialising a full-pool device_get."""
+        idx = jnp.asarray(block_ids, jnp.int32)
+        slice_fn, _ = self._range_fns(n_layers)
+        with jax.set_mesh(self.mesh):
+            data = slice_fn(self.kv, idx, jnp.asarray(layer_lo, jnp.int32))
+        return np.asarray(jax.device_get(data))
+
     def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
         """Scatter transferred blocks into this engine's pool (donated)."""
         idx = jnp.asarray(block_ids, jnp.int32)
@@ -524,6 +559,17 @@ class ModelRunner:
         with jax.set_mesh(self.mesh):
             self.kv = jax.jit(_scatter, donate_argnums=(0,))(
                 self.kv, idx, jnp.asarray(data)
+            )
+
+    def import_blocks_range(self, block_ids: list[int], layer_lo: int,
+                            data: np.ndarray) -> None:
+        """Scatter one streamed layer group into the pool (donated)."""
+        idx = jnp.asarray(block_ids, jnp.int32)
+        _, scatter_fn = self._range_fns(int(data.shape[0]))
+        with jax.set_mesh(self.mesh):
+            self.kv = scatter_fn(
+                self.kv, idx, jnp.asarray(data),
+                jnp.asarray(layer_lo, jnp.int32),
             )
 
     def sample(self, logits, temps, top_ps, top_ks, seeds, steps) -> np.ndarray:

@@ -315,6 +315,33 @@ class StagedModelRunner:
         for s, runner in enumerate(self.stages):
             runner.import_blocks(block_ids, data[s * Lps : (s + 1) * Lps])
 
+    def export_blocks_range(self, block_ids: list[int], layer_lo: int,
+                            n_layers: int) -> np.ndarray:
+        Lps = self.layers_per_stage
+        parts = []
+        for s, runner in enumerate(self.stages):
+            lo = max(layer_lo, s * Lps)
+            hi = min(layer_lo + n_layers, (s + 1) * Lps)
+            if lo < hi:
+                parts.append(
+                    runner.export_blocks_range(block_ids, lo - s * Lps,
+                                               hi - lo)
+                )
+        return np.concatenate(parts, axis=0)
+
+    def import_blocks_range(self, block_ids: list[int], layer_lo: int,
+                            data: np.ndarray) -> None:
+        Lps = self.layers_per_stage
+        off = 0
+        for s, runner in enumerate(self.stages):
+            lo = max(layer_lo, s * Lps)
+            hi = min(layer_lo + data.shape[0], (s + 1) * Lps)
+            if lo < hi:
+                runner.import_blocks_range(
+                    block_ids, lo - s * Lps, data[off : off + hi - lo]
+                )
+                off += hi - lo
+
     # -- sleep mode hooks ---------------------------------------------------
     def drop_kv(self) -> None:
         for r in self.stages:

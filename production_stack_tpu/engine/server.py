@@ -394,6 +394,49 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "invalid block ids"}}, status=400
             )
+        if body.get("stream"):
+            # chunked layer-group stream: device gather of group i+1
+            # overlaps the network send of group i (kv_transfer.py)
+            from production_stack_tpu.engine.kv_transfer import (
+                default_group,
+                produce_frames,
+            )
+
+            cfg = self.config
+            group = max(1, min(
+                int(body.get("group_layers")
+                    or default_group(cfg.model.num_layers)),
+                cfg.model.num_layers,
+            ))
+            shape = (cfg.model.num_layers, len(blocks),
+                     cfg.cache.block_size, 2 * cfg.model.num_kv_heads,
+                     cfg.model.head_dim)
+            resp = web.StreamResponse(headers={
+                "Content-Type": "application/octet-stream",
+                "X-KV-Shape": ",".join(map(str, shape)),
+                "X-KV-Dtype": str(cfg.model.dtype),
+                "X-KV-Group-Layers": str(group),
+            })
+            # pin for the stream's duration: layer groups are gathered in
+            # separate engine ops with serving steps interleaved — an
+            # eviction mid-stream would hand the consumer a torn,
+            # layer-inconsistent export it then commits as cache content
+            await self.async_engine.run_on_engine(
+                lambda eng: eng.scheduler.allocator.pin_blocks(blocks)
+            )
+            try:
+                await resp.prepare(request)
+                async for frame in produce_frames(
+                    self.async_engine.run_on_engine, blocks,
+                    cfg.model.num_layers, group,
+                ):
+                    await resp.write(frame)
+                await resp.write_eof()
+            finally:
+                await self.async_engine.run_on_engine(
+                    lambda eng: eng.scheduler.allocator.free_blocks(blocks)
+                )
+            return resp
         data = await self.async_engine.run_on_engine(
             lambda eng: eng.export_kv(blocks)
         )
@@ -416,30 +459,40 @@ class EngineServer:
         if not host or not blocks:
             return
         import aiohttp
-        import numpy as np
 
+        from production_stack_tpu.engine.kv_transfer import consume_frames
+
+        local = None
         try:
+            # reserve local blocks up front so scatters stream straight in
+            got = await self.async_engine.run_on_engine(
+                lambda eng: eng.begin_kv_import(list(prompt_ids),
+                                                len(blocks))
+            )
+            if got is None:
+                return
+            local, n_full = got
             async with aiohttp.ClientSession() as s:
                 async with s.post(
-                    f"{host}/kv/export", json={"blocks": blocks},
-                    timeout=aiohttp.ClientTimeout(total=30),
+                    f"{host}/kv/export",
+                    json={"blocks": blocks[:n_full], "stream": True},
+                    timeout=aiohttp.ClientTimeout(total=120),
                 ) as resp:
                     if resp.status != 200:
-                        return
+                        raise RuntimeError(f"export HTTP {resp.status}")
                     shape = tuple(
                         int(x) for x in resp.headers["X-KV-Shape"].split(",")
                     )
                     dtype = resp.headers["X-KV-Dtype"]
-                    raw = await resp.read()
-            if dtype == "bfloat16":
-                import jax.numpy as jnp_
-
-                data = np.frombuffer(raw, jnp_.bfloat16).reshape(shape)
-            else:
-                data = np.frombuffer(raw, np.dtype(dtype)).reshape(shape)
+                    group = int(resp.headers["X-KV-Group-Layers"])
+                    await consume_frames(
+                        resp.content, self.async_engine.run_on_engine,
+                        local, shape, dtype, group,
+                    )
             cached = await self.async_engine.run_on_engine(
-                lambda eng: eng.import_kv(list(prompt_ids), data)
+                lambda eng: eng.finish_kv_import(list(prompt_ids), local)
             )
+            local = None  # committed
             if cached:
                 body.setdefault("_kv_imported_tokens", cached)
         except Exception as e:
@@ -447,6 +500,10 @@ class EngineServer:
             import logging
 
             logging.getLogger(__name__).warning("kv import failed: %s", e)
+            if local is not None:
+                await self.async_engine.run_on_engine(
+                    lambda eng: eng.abort_kv_import(local)
+                )
 
     async def detokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
