@@ -279,3 +279,37 @@ def test_templates_have_no_cuda_remnants():
     rendered = re.sub(r"{{/\*.*?\*/}}", "", all_text, flags=re.DOTALL)
     assert "nvidia.com/gpu" not in rendered
     assert "cuda" not in rendered.lower()
+
+
+def test_ci_values_render_cpu_schedulable():
+    """helm/values-ci.yaml (the kind CI tier) must produce pods with no
+    TPU selectors/resources and the CPU JAX backend."""
+    with open(os.path.join(HELM, "values-ci.yaml")) as f:
+        ci = yaml.safe_load(f)
+    objs = render_objects(HELM, ci)
+    eng = [d for d in by_kind(objs, "Deployment")
+           if d["metadata"]["labels"].get("app.kubernetes.io/component")
+           == "serving-engine"][0]
+    pod = eng["spec"]["template"]["spec"]
+    assert "nodeSelector" not in pod
+    c = pod["containers"][0]
+    assert {"name": "JAX_PLATFORMS", "value": "cpu"} in c["env"]
+    assert "google.com/tpu" not in str(c.get("resources"))
+    assert "--skip-warmup" in c["args"]
+
+
+def test_router_selector_follows_release_name():
+    """The default k8s label selector must track the release name, or a
+    differently-named install (kind CI's ci-stack) discovers zero pods."""
+    objs = render_objects(HELM, release_name="ci-stack")
+    router = named(by_kind(objs, "Deployment"), "-router")[0]
+    args = container_args(router)
+    sel = args[args.index("--k8s-label-selector") + 1]
+    assert sel == "environment=serving,release=ci-stack"
+    # and engine pods actually carry those labels
+    eng = [d for d in by_kind(objs, "Deployment")
+           if d["metadata"]["labels"].get("app.kubernetes.io/component")
+           == "serving-engine"][0]
+    labels = eng["spec"]["template"]["metadata"]["labels"]
+    assert labels["environment"] == "serving"
+    assert labels["release"] == "ci-stack"

@@ -1,0 +1,64 @@
+"""Tutorial drift guard: every CLI flag a tutorial shows must exist in
+some component's parser, and the numbered set must stay at/above the
+capability-matrix size the round targets."""
+
+import glob
+import os
+import re
+
+TUTORIALS = os.path.join(os.path.dirname(__file__), "..", "tutorials")
+
+
+def all_known_flags():
+    from production_stack_tpu.engine.server import build_parser as eng
+    from production_stack_tpu.router.app import build_parser as rtr
+
+    known = set()
+    for parser in (eng(), rtr()):
+        for action in parser._actions:
+            known.update(action.option_strings)
+    import inspect
+
+    import production_stack_tpu.kv_server as kv
+    import production_stack_tpu.operator.controller as op
+    import scripts.model_downloader as dl
+
+    for mod in (kv, op, dl):
+        known.update(re.findall(r'"(--[a-z0-9-]+)"', inspect.getsource(mod)))
+    return known
+
+
+def test_tutorial_flags_exist():
+    known = all_known_flags()
+    for path in glob.glob(os.path.join(TUTORIALS, "*.md")):
+        with open(path) as f:
+            text = f.read()
+        # flags inside fenced code blocks only
+        for block in re.findall(r"```(?:bash|sh)?\n(.*?)```", text,
+                                re.DOTALL):
+            if "production_stack_tpu" not in block and \
+                    "picker_server" not in block:
+                continue
+            for flag in re.findall(r"(--[a-z][a-z0-9-]+)", block):
+                if flag in ("--picker", "--threshold", "--port",
+                            "--chunk-size"):  # picker flags (C++)
+                    continue
+                assert flag in known, (
+                    f"{os.path.basename(path)} shows unknown flag {flag}"
+                )
+
+
+def test_tutorial_count_meets_round_target():
+    docs = [p for p in glob.glob(os.path.join(TUTORIALS, "*.md"))
+            if os.path.basename(p) != "README.md"]
+    assert len(docs) >= 14, f"only {len(docs)} tutorials"
+
+
+def test_ci_workflow_exists_and_installs_chart():
+    wf = os.path.join(os.path.dirname(__file__), "..", ".github",
+                      "workflows", "chart-install.yml")
+    with open(wf) as f:
+        text = f.read()
+    assert "kind" in text and "helm install" in text
+    assert "values-ci.yaml" in text
+    assert "/v1/completions" in text  # drives a real completion
