@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tear down: helm release then the terraform infra.
+set -euo pipefail
+PROJECT=${1:?project id}
+REGION=${2:?region}
+helm uninstall tpu-stack || true
+terraform -chdir=terraform destroy -var project_id="$PROJECT" -var region="$REGION"

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Install the stack onto the terraform-provisioned GKE cluster.
+# Usage: ./entry_point.sh <project-id> <region> [cluster-name]
+set -euo pipefail
+PROJECT=${1:?project id}
+REGION=${2:?region}
+CLUSTER=${3:-tpu-serving-stack}
+
+gcloud container clusters get-credentials "$CLUSTER" \
+  --region "$REGION" --project "$PROJECT"
+
+# CRDs for the operator + the chart
+kubectl apply -f ../../production_stack_tpu/operator/crds.yaml
+helm upgrade --install tpu-stack ../../helm -f production_stack_values.yaml
+
+kubectl rollout status deployment -l app.kubernetes.io/component=router \
+  --timeout=300s
+echo "router: kubectl port-forward svc/tpu-stack-tpu-serving-stack-router 8001:80"

@@ -1,0 +1,82 @@
+# GKE cluster + TPU v5e node pool for the TPU serving stack.
+# (Reference analogue: deployment_on_cloud/gcp — GPU node pools there.)
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+variable "project_id" { type = string }
+variable "region" {
+  type    = string
+  default = "us-central1"
+}
+variable "cluster_name" {
+  type    = string
+  default = "tpu-serving-stack"
+}
+# v5e slice shape: 2x4 = 8 chips per node (one engine pod per node with
+# tpu.chips: 8 in the chart)
+variable "tpu_topology" {
+  type    = string
+  default = "2x4"
+}
+variable "tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-8t"
+}
+variable "tpu_node_count" {
+  type    = number
+  default = 2
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+resource "google_container_cluster" "stack" {
+  name                     = var.cluster_name
+  location                 = var.region
+  remove_default_node_pool = true
+  initial_node_count       = 1
+  release_channel {
+    channel = "REGULAR"
+  }
+}
+
+# CPU pool: router, operator, gateway picker, cache server, monitoring
+resource "google_container_node_pool" "cpu" {
+  name       = "cpu-pool"
+  cluster    = google_container_cluster.stack.name
+  location   = var.region
+  node_count = 2
+  node_config {
+    machine_type = "e2-standard-8"
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# TPU v5e pool: engine pods (google.com/tpu requests land here; GKE sets
+# the gke-tpu-accelerator/topology labels the chart's nodeSelector uses)
+resource "google_container_node_pool" "tpu" {
+  name       = "tpu-v5e-pool"
+  cluster    = google_container_cluster.stack.name
+  location   = var.region
+  node_count = var.tpu_node_count
+  node_config {
+    machine_type = var.tpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}
+
+output "cluster_name" { value = google_container_cluster.stack.name }
+output "region" { value = var.region }

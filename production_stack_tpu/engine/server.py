@@ -110,6 +110,8 @@ class EngineServer:
         app.router.add_post("/v1/messages", self.messages)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
+        app.router.add_post("/debug/profile", self.profile)
+        app.router.add_get("/debug/memory", self.memory_profile)
         app.router.add_post("/sleep", self.sleep)
         app.router.add_post("/wake_up", self.wake_up)
         app.router.add_get("/is_sleeping", self.is_sleeping)
@@ -508,6 +510,92 @@ class EngineServer:
     async def detokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
         return web.json_response({"prompt": self.engine.tokenizer.decode(body.get("tokens") or [])})
+
+    # -- profiling ------------------------------------------------------------
+    async def profile(self, request: web.Request) -> web.Response:
+        """Capture a JAX profiler trace (XPlane protos + trace-viewer JSON,
+        the TensorBoard-loadable format) for ``duration_ms`` while serving
+        continues, and return it as a tar.gz. This is the TPU equivalent of
+        vLLM's torch-profiler start/stop endpoints (SURVEY.md §5.1): the
+        trace shows per-kernel device time, HBM traffic, and host gaps —
+        the evidence behind docs/roofline.md."""
+        import io
+        import shutil
+        import tarfile
+        import tempfile
+
+        import jax
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        duration_ms = min(int(body.get("duration_ms") or 2000), 60_000)
+        if getattr(self, "_profiling", False):
+            return web.json_response(
+                {"error": {"message": "a profile capture is already running"}},
+                status=409,
+            )
+        self._profiling = True
+        tmp = tempfile.mkdtemp(prefix="jaxprof-")
+        started = False
+        try:
+            jax.profiler.start_trace(tmp)
+            started = True
+            await asyncio.sleep(duration_ms / 1000.0)
+            # stop + tar off the event loop: a trace under load is large
+            # and serialising it inline would stall every stream
+
+            def _finish() -> bytes:
+                jax.profiler.stop_trace()
+                buf = io.BytesIO()
+                with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                    tar.add(tmp, arcname="trace")
+                return buf.getvalue()
+
+            body_bytes = await asyncio.get_running_loop().run_in_executor(
+                None, _finish
+            )
+            started = False
+            return web.Response(
+                body=body_bytes,
+                content_type="application/gzip",
+                headers={"Content-Disposition":
+                         'attachment; filename="jax-trace.tar.gz"'},
+            )
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"profile capture failed: {e}"}},
+                status=500,
+            )
+        finally:
+            if started:
+                # cancellation (client disconnect) skipped _finish: the
+                # profiler must not be left running or the endpoint is
+                # dead until restart
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+            self._profiling = False
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    async def memory_profile(self, request: web.Request) -> web.Response:
+        """Device memory profile (pprof proto) — what holds HBM right now."""
+        import jax
+
+        try:
+            data = jax.profiler.device_memory_profile()
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"memory profile failed: {e}"}},
+                status=500,
+            )
+        return web.Response(
+            body=data, content_type="application/octet-stream",
+            headers={"Content-Disposition":
+                     'attachment; filename="memory.pprof"'},
+        )
 
     # -- sleep family ---------------------------------------------------------
     async def sleep(self, request: web.Request) -> web.Response:

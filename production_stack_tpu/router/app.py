@@ -109,7 +109,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Feature=bool[,Feature=bool...]")
     p.add_argument("--callbacks", default=None,
                    help="module.attribute of a custom callback handler")
-    p.add_argument("--semantic-cache-threshold", type=float, default=0.92)
+    p.add_argument("--semantic-cache-threshold", type=float, default=0.75)
     p.add_argument("--otel-endpoint", default=None,
                    help="OTLP gRPC endpoint; W3C propagation is always on")
     p.add_argument("--otel-service-name", default="tpu-router")

@@ -21,6 +21,34 @@ PATTERNS = {
     "CREDIT_CARD": re.compile(r"\b(?:\d[ -]?){13,16}\b"),
     "IP_ADDRESS": re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
     "API_KEY": re.compile(r"\b(?:sk|pk|rk)[-_][A-Za-z0-9]{16,}\b"),
+    "AWS_ACCESS_KEY": re.compile(r"\b(?:AKIA|ASIA)[0-9A-Z]{16}\b"),
+    "JWT": re.compile(
+        r"\beyJ[A-Za-z0-9_-]{8,}\.[A-Za-z0-9_-]{8,}\.[A-Za-z0-9_-]{8,}\b"
+    ),
+    # country code + check digits + 10-30 BBAN chars, spaces optional at
+    # any position (compact DE/GB/FR forms aren't 4-groupable)
+    "IBAN": re.compile(r"\b[A-Z]{2}\d{2}(?: ?[A-Z0-9]){10,30}\b"),
+}
+
+
+def _luhn_ok(digits: str) -> bool:
+    ds = [int(c) for c in digits if c.isdigit()]
+    if not 13 <= len(ds) <= 16:
+        return False
+    total = 0
+    for i, d in enumerate(reversed(ds)):
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+    return total % 10 == 0
+
+
+_VALIDATORS = {
+    # Luhn checksum kills most false positives on arbitrary digit runs
+    # (order numbers, timestamps) while keeping every real card number
+    "CREDIT_CARD": _luhn_ok,
 }
 
 
@@ -37,14 +65,66 @@ class RegexAnalyzer:
     def analyze(self, text: str) -> list[PIIMatch]:
         out = []
         for kind in self.kinds:
+            validator = _VALIDATORS.get(kind)
             for match in PATTERNS[kind].finditer(text):
+                if validator and not validator(match.group()):
+                    continue
                 out.append(PIIMatch(kind, match.group()))
         return out
 
     def redact(self, text: str) -> str:
         for kind in self.kinds:
-            text = PATTERNS[kind].sub(f"[{kind}]", text)
+            validator = _VALIDATORS.get(kind)
+
+            def _sub(m, kind=kind, validator=validator):
+                if validator and not validator(m.group()):
+                    return m.group()
+                return f"[{kind}]"
+
+            text = PATTERNS[kind].sub(_sub, text)
         return text
+
+
+class NERAnalyzer:
+    """Presidio-class NER backend (reference:
+    experimental/pii/analyzers/presidio.py). Activated when presidio is
+    baked into the router image; the regex analyzer remains the
+    dependency-free default."""
+
+    def __init__(self, kinds: Optional[set[str]] = None):
+        try:
+            from presidio_analyzer import AnalyzerEngine  # optional dep
+        except ImportError as e:
+            raise RuntimeError(
+                "NERAnalyzer needs presidio-analyzer in the router image; "
+                "use RegexAnalyzer (default) otherwise"
+            ) from e
+        self.engine = AnalyzerEngine()
+        self.kinds = kinds
+
+    def analyze(self, text: str) -> list[PIIMatch]:
+        results = self.engine.analyze(text=text, language="en",
+                                      entities=sorted(self.kinds)
+                                      if self.kinds else None)
+        return [PIIMatch(r.entity_type, text[r.start:r.end])
+                for r in results]
+
+    def redact(self, text: str) -> str:
+        # replace by presidio's span offsets right-to-left: a global
+        # str.replace would corrupt words containing an entity substring
+        results = self.engine.analyze(text=text, language="en",
+                                      entities=sorted(self.kinds)
+                                      if self.kinds else None)
+        for r in sorted(results, key=lambda r: -r.start):
+            text = text[:r.start] + f"[{r.entity_type}]" + text[r.end:]
+        return text
+
+
+def make_analyzer(name: str = "regex",
+                  kinds: Optional[set[str]] = None):
+    if name == "ner":
+        return NERAnalyzer(kinds)
+    return RegexAnalyzer(kinds)
 
 
 class PIIMiddleware:

@@ -1,11 +1,21 @@
-"""Semantic response cache (dependency-free).
+"""Semantic response cache with pluggable encoders.
 
 The reference uses sentence-transformers + FAISS
-(src/vllm_router/experimental/semantic_cache/semantic_cache.py:16-346); in a
-zero-egress TPU image we embed with hashed character n-grams (TF-IDF-ish,
-L2-normalised, no model download) and brute-force cosine over numpy — exact
-for the cache sizes a router holds, and trivially swappable for a real
-encoder when one is mounted.
+(src/vllm_router/experimental/semantic_cache/semantic_cache.py:16-346 and
+db_adapters/faiss_adapter.py). Here the encoder is a protocol:
+
+- ``HashedNgramEncoder`` (default): hashed char-3-grams + word 1/2-grams,
+  L2-normalised — no model download (zero-egress TPU image), robust to
+  punctuation/casing/word-order surface variation. Its quality is pinned
+  by a paraphrase hit/miss evaluation in tests/test_semantic_cache.py.
+- ``SentenceTransformerEncoder``: a real embedding model when one is
+  mounted in the image (path via ``SEMANTIC_CACHE_MODEL_PATH``); same
+  interface, drop-in.
+
+Similarity search is exact brute-force cosine over a normalised numpy
+matrix — for the few-thousand-entry caches a router holds this is faster
+than an ANN index and has no recall loss (the reference's FAISS adapter
+uses IndexFlatL2, also exact).
 
 Checked pre-route for /v1/chat/completions; non-streaming responses are
 stored post-response via the request service's post_response hook.
@@ -14,8 +24,10 @@ stored post-response via the request service's post_response hook.
 from __future__ import annotations
 
 import json
+import os
+import re
 import time
-from typing import Optional
+from typing import Optional, Protocol, Sequence
 
 import numpy as np
 import xxhash
@@ -25,24 +37,88 @@ from production_stack_tpu.router.log import init_logger
 
 logger = init_logger(__name__)
 
-_DIM = 1024
+_DIM = 4096
+_WORD_RE = re.compile(r"[a-z0-9]+")
 
 
-def embed(text: str, n: int = 3) -> np.ndarray:
-    vec = np.zeros(_DIM, np.float32)
-    t = text.lower()
-    for i in range(max(len(t) - n + 1, 1)):
-        h = xxhash.xxh64(t[i : i + n]).intdigest()
-        vec[h % _DIM] += 1.0
-    norm = np.linalg.norm(vec)
-    return vec / norm if norm > 0 else vec
+class Encoder(Protocol):
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        """(len(texts), dim) float32, L2-normalised rows."""
+        ...
+
+
+class HashedNgramEncoder:
+    """Char-3-gram + word-1/2-gram hashed bag, L2-normalised."""
+
+    dim = _DIM
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), _DIM), np.float32)
+        for row, text in enumerate(texts):
+            t = text.lower()
+            vec = out[row]
+            for i in range(max(len(t) - 2, 1)):
+                vec[xxhash.xxh64(t[i : i + 3]).intdigest() % _DIM] += 1.0
+            words = _WORD_RE.findall(t)
+            for w in words:
+                # word features weighted up: word overlap survives
+                # reordering/punctuation far better than char runs
+                vec[xxhash.xxh64("w:" + w).intdigest() % _DIM] += 4.0
+            for a, b in zip(words, words[1:]):
+                vec[xxhash.xxh64(f"b:{a}:{b}").intdigest() % _DIM] += 2.0
+            norm = np.linalg.norm(vec)
+            if norm > 0:
+                vec /= norm
+        return out
+
+
+class SentenceTransformerEncoder:
+    """Real encoder backend (reference parity) for images that mount a
+    model; activate with SEMANTIC_CACHE_MODEL_PATH=/models/encoder."""
+
+    def __init__(self, model_path: str):
+        from sentence_transformers import SentenceTransformer  # optional
+
+        self.model = SentenceTransformer(model_path)
+        self.dim = self.model.get_sentence_embedding_dimension()
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        vecs = np.asarray(self.model.encode(list(texts)), np.float32)
+        norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+        return vecs / np.maximum(norms, 1e-9)
+
+
+def make_encoder() -> Encoder:
+    path = os.environ.get("SEMANTIC_CACHE_MODEL_PATH")
+    if path:
+        try:
+            enc = SentenceTransformerEncoder(path)
+            logger.info("semantic cache: sentence-transformers encoder %s",
+                        path)
+            return enc
+        except Exception as e:
+            logger.warning(
+                "semantic cache: falling back to hashed n-grams "
+                "(encoder %s unavailable: %s)", path, e,
+            )
+    return HashedNgramEncoder()
+
+
+def embed(text: str) -> np.ndarray:
+    """Single-text convenience over the default encoder (tests)."""
+    return HashedNgramEncoder().encode([text])[0]
 
 
 class SemanticCache:
-    def __init__(self, threshold: float = 0.92, max_entries: int = 4096):
+    def __init__(self, threshold: float = 0.75, max_entries: int = 4096,
+                 ttl_seconds: Optional[float] = None,
+                 encoder: Optional[Encoder] = None):
         self.threshold = threshold
         self.max_entries = max_entries
-        self.vectors = np.zeros((0, _DIM), np.float32)
+        self.ttl = ttl_seconds
+        self.encoder = encoder or make_encoder()
+        dim = getattr(self.encoder, "dim", _DIM)
+        self.vectors = np.zeros((0, dim), np.float32)
         self.entries: list[dict] = []
         self.hits = 0
         self.misses = 0
@@ -52,6 +128,15 @@ class SemanticCache:
         msgs = body.get("messages") or []
         return "\n".join(str(m.get("content", "")) for m in msgs)
 
+    def _evict_expired(self) -> None:
+        if self.ttl is None or not self.entries:
+            return
+        cutoff = time.time() - self.ttl
+        keep = [i for i, e in enumerate(self.entries) if e["ts"] >= cutoff]
+        if len(keep) != len(self.entries):
+            self.entries = [self.entries[i] for i in keep]
+            self.vectors = self.vectors[keep]
+
     async def lookup(self, request: web.Request) -> Optional[web.Response]:
         try:
             body = await request.json()
@@ -60,13 +145,19 @@ class SemanticCache:
         if body.get("stream"):
             return None
         prompt = self._prompt_of(body)
+        self._evict_expired()
         if not prompt or not self.entries:
             self.misses += 1
             return None
-        q = embed(prompt)
+        q = self.encoder.encode([prompt])[0]
         sims = self.vectors @ q
+        # mask to the requested model BEFORE argmax: another model's entry
+        # being the single global best must not shadow a valid hit
+        model = body.get("model")
+        mask = np.asarray([e["model"] == model for e in self.entries])
+        sims = np.where(mask, sims, -1.0)
         best = int(np.argmax(sims))
-        if sims[best] >= self.threshold and self.entries[best]["model"] == body.get("model"):
+        if sims[best] >= self.threshold:
             self.hits += 1
             cached = dict(self.entries[best]["response"])
             cached["cached"] = True
@@ -86,7 +177,7 @@ class SemanticCache:
             return
         if "choices" not in response:
             return
-        vec = embed(prompt)
+        vec = self.encoder.encode([prompt])[0]
         self.entries.append(
             {"model": body.get("model"), "response": response, "ts": time.time()}
         )

@@ -332,3 +332,36 @@ def test_stop_string(server):
         assert "\x00" not in data["choices"][0]["text"]
 
     run(with_client(server, fn))
+
+
+def test_profile_capture_endpoints(server):
+    """JAX trace capture returns a TensorBoard-loadable archive while the
+    engine keeps serving (SURVEY §5.1 — the torch-profiler-endpoint
+    equivalent); /debug/memory returns a pprof device-memory profile."""
+    import io
+    import tarfile
+
+    async def fn(client):
+        async def traffic():
+            await client.post(
+                "/v1/completions",
+                json={"prompt": "profile me", "max_tokens": 8,
+                      "temperature": 0, "ignore_eos": True},
+            )
+
+        import asyncio as aio
+
+        t = aio.ensure_future(traffic())
+        r = await client.post("/debug/profile", json={"duration_ms": 300})
+        assert r.status == 200
+        body = await r.read()
+        with tarfile.open(fileobj=io.BytesIO(body), mode="r:gz") as tar:
+            names = tar.getnames()
+        assert any("trace" in n for n in names)
+        await t
+
+        r = await client.get("/debug/memory")
+        assert r.status == 200
+        assert len(await r.read()) > 0
+
+    run(with_client(server, fn))

@@ -62,3 +62,35 @@ def test_ci_workflow_exists_and_installs_chart():
     assert "kind" in text and "helm install" in text
     assert "values-ci.yaml" in text
     assert "/v1/completions" in text  # drives a real completion
+
+
+def test_cloud_deploy_values_render():
+    """deployment_on_cloud/gcp values must render against the chart, and
+    the shell scripts must at least parse."""
+    import subprocess
+    import sys
+
+    import yaml
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from minihelm import render_objects
+
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "deployment_on_cloud", "gcp")
+    with open(os.path.join(root, "production_stack_values.yaml")) as f:
+        values = yaml.safe_load(f)
+    helm = os.path.join(os.path.dirname(__file__), "..", "helm")
+    objs = render_objects(helm, values)
+    eng = [o for o in objs if o.get("kind") == "Deployment"
+           and o["metadata"]["labels"].get("app.kubernetes.io/component")
+           == "serving-engine"][0]
+    pod = eng["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    args = pod["containers"][0]["args"]
+    # gs:// modelURI passes straight through (Orbax sharded restore)
+    assert args[args.index("--model") + 1].startswith("gs://")
+    assert [o for o in objs if o.get("kind") == "ScaledObject"]
+
+    for sh in ("entry_point.sh", "clean_up.sh"):
+        subprocess.run(["bash", "-n", os.path.join(root, sh)], check=True)
