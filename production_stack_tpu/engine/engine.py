@@ -15,6 +15,7 @@ import os
 import time
 from typing import Optional, Sequence as Seq
 
+import jax
 import numpy as np
 from jax.sharding import Mesh
 
@@ -89,6 +90,12 @@ class LLMEngine:
         self._adapter_ids = np.zeros(B, np.int32)
         self._count_reset_slots: list[Sequence] = []
         self._slot_seq: dict[int, Sequence] = {}
+        # deferred prefill resolution: (prefills, device sampled array).
+        # The fetch of step i's sampled tokens is delayed until step i+1 has
+        # been DISPATCHED, so device compute + the result round trip overlap
+        # the host's next-step work (prefill dispatches don't consume the
+        # previous step's samples — only finished prompts' postprocess does)
+        self._pending_prefill = None
         # metrics
         self.total_prompt_tokens = 0
         self.total_output_tokens = 0
@@ -142,10 +149,28 @@ class LLMEngine:
     def step(self) -> list[RequestOutput]:
         out = self.scheduler.schedule()
         if out.is_empty:
-            return []
+            return self._resolve_pending_prefill()
         if out.prefills:
             return self._run_prefill(out.prefills)
-        return self._run_decode(out.decodes)
+        # decode consumes the first sampled token: the deferred prefill
+        # must land before decode inputs are built — and resolving may
+        # FINISH sequences (max_tokens=1) the scheduler already put in
+        # this step's decode batch
+        outputs = self._resolve_pending_prefill()
+        decodes = [s for s in out.decodes
+                   if s.status is SequenceStatus.RUNNING]
+        if decodes:
+            outputs.extend(self._run_decode(decodes))
+        return outputs
+
+    def _resolve_pending_prefill(self) -> list[RequestOutput]:
+        """Fetch + postprocess the previous prefill dispatch (if any)."""
+        if self._pending_prefill is None:
+            return []
+        prefills, sampled_dev = self._pending_prefill
+        self._pending_prefill = None
+        sampled = np.asarray(jax.device_get(sampled_dev))
+        return self._finish_prefill(prefills, sampled)
 
     # -- host-DRAM KV tier (see engine/kv_offload.py) ------------------------
     def _host_extend_seq(self, seq: Sequence) -> None:
@@ -254,7 +279,9 @@ class LLMEngine:
 
     def _run_prefill(self, prefills: list) -> list[RequestOutput]:
         if prefills[0].ring:
-            return self._run_prefill_ring(prefills[0])
+            outputs = self._resolve_pending_prefill()
+            outputs.extend(self._run_prefill_ring(prefills[0]))
+            return outputs
         bs = self.config.cache.block_size
         # batch-dim padded to the next power of two: inactive rows skip
         # attention but still pay QKV/MLP, so padding 2 live 512-token
@@ -301,13 +328,18 @@ class LLMEngine:
 
         greedy_only = all(sp.seq.sampling.temperature <= 0.0 for sp in prefills)
         use_lora = any(sp.seq.adapter_slot for sp in prefills)
-        sampled = self.runner.prefill(
+        sampled_dev = self.runner.prefill(
             tokens, positions, tables, context_lens, slot_mapping.reshape(-1),
             last_idx, temps, top_ps, top_ks, seeds, greedy_only=greedy_only,
             adapter_ids=adapter_ids if use_lora else None,
+            fetch=False,
         )
 
-        finished_prompts, first_tokens = [], []
+        # scheduler-visible state advances NOW (the next step's scheduling
+        # depends on it); the sampled tokens are fetched one step LATER so
+        # this dispatch's device time + result round trip overlap the
+        # host's next-step work (see _resolve_pending_prefill)
+        resolve_list = []
         for i, sp in enumerate(prefills):
             seq = sp.seq
             seq.num_computed_tokens = sp.chunk_start + sp.chunk_len
@@ -317,13 +349,23 @@ class LLMEngine:
             self._slot_seq[seq.slot] = seq
             s = seq.sampling
             if s.presence_penalty or s.frequency_penalty:
-                # fresh prompt: the prefill-sampled token below must count;
+                # fresh prompt: the prefill-sampled token must count;
                 # recompute: restore the full output history
                 self._count_reset_slots.append(seq)
             if seq.output_token_ids:
                 # preemption-recompute: context rebuilt, newest token still
                 # the pending decode input — nothing sampled this step
                 continue
+            resolve_list.append((i, seq))
+        outputs = self._resolve_pending_prefill()
+        self._pending_prefill = (resolve_list, sampled_dev)
+        return outputs
+
+    def _finish_prefill(self, resolve_list, sampled) -> list[RequestOutput]:
+        finished_prompts, first_tokens = [], []
+        for i, seq in resolve_list:
+            if seq.status.is_finished:
+                continue  # aborted while the dispatch was in flight
             token = int(sampled[i])
             seq.first_token_time = time.monotonic()
             seq.output_token_ids.append(token)

@@ -286,10 +286,15 @@ class ModelRunner:
                 slot_mapping: np.ndarray, last_idx: np.ndarray,
                 temps: np.ndarray, top_ps: np.ndarray, top_ks: np.ndarray,
                 seeds: np.ndarray, greedy_only: bool = True,
-                adapter_ids: Optional[np.ndarray] = None) -> np.ndarray:
+                adapter_ids: Optional[np.ndarray] = None,
+                fetch: bool = True):
         """A batch of prefill chunks (shapes padded: tokens (P, S), tables
         (P, M), slot_mapping (P*S,)). Each chunk's next token is sampled in
-        the same dispatch; returns (P,) host tokens."""
+        the same dispatch; returns (P,) host tokens — or, with
+        ``fetch=False``, the un-fetched device array so the caller can
+        overlap the next dispatch with this one's compute + result fetch
+        (JAX dispatch is async; the engine defers the device_get one step,
+        hiding the per-dispatch round trip — docs/roofline.md)."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
         with jax.set_mesh(self.mesh):
             self.kv, sampled = self._prefill(
@@ -304,6 +309,8 @@ class ModelRunner:
                              if use_lora else None),
                 greedy_only=greedy_only,
             )
+        if not fetch:
+            return sampled
         return np.asarray(jax.device_get(sampled))
 
     def prefill_ring(self, tokens: np.ndarray, positions: np.ndarray,

@@ -169,7 +169,8 @@ class StagedModelRunner:
     # -- public step API (ModelRunner-compatible) --------------------------
     def prefill(self, tokens, positions, block_tables, context_lens,
                 slot_mapping, last_idx, temps, top_ps, top_ks, seeds,
-                greedy_only: bool = True, adapter_ids=None) -> np.ndarray:
+                greedy_only: bool = True, adapter_ids=None,
+                fetch: bool = True):
         x = jnp.asarray(tokens)  # stage 0 consumes token ids
         common = (
             jnp.asarray(positions), jnp.asarray(block_tables),
@@ -192,7 +193,9 @@ class StagedModelRunner:
                                  if use_lora else None),
                     greedy_only=greedy_only,
                 )
-        return np.asarray(jax.device_get(x))  # last stage returned sampled
+        if not fetch:
+            return x  # last stage's sampled tokens, un-fetched
+        return np.asarray(jax.device_get(x))
 
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,

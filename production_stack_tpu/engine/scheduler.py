@@ -111,6 +111,14 @@ class Scheduler:
         multi-round-QA KV-reuse win the reference gets from LMCache)."""
         self.allocator.commit_full_blocks(seq.token_ids, seq.block_ids)
         self._release(seq)
+        try:
+            # a seq can finish while PREEMPTED (its deferred prefill token
+            # hit a stop after the scheduler re-queued it) — it must leave
+            # the waiting deque or _try_admit would resurrect a finished
+            # request and generate it again
+            self.waiting.remove(seq)
+        except ValueError:
+            pass
         seq.status = status
 
     def _preempt(self, victim: Sequence) -> None:
