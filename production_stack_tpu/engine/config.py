@@ -195,6 +195,13 @@ class SchedulerConfig:
     # the mesh has seq > 1 — the long-context path the reference lacks
     # (SURVEY.md §5.7).
     ring_prefill_threshold: int = 0
+    # chain decode dispatches through device-resident tokens with the
+    # sample fetch deferred one dispatch. Default OFF: measured on the
+    # tunneled dev chip it LOSES (the backend serialises unfetched dispatch
+    # chains — 4573 -> 2895 tok/s); on directly-attached hardware it
+    # removes one host round trip per multi-step dispatch. Re-measure
+    # before enabling (docs/roofline.md).
+    chain_decode: bool = False
 
     def bucket_for(self, n: int, max_model_len: Optional[int] = None) -> int:
         """The padded token length a chunk of n tokens compiles at — the ONE

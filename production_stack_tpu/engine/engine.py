@@ -55,7 +55,8 @@ class LLMEngine:
         else:
             self.runner = ModelRunner(config, self.mesh, params, num_blocks)
         self.scheduler = Scheduler(
-            config.scheduler, config.cache, self.runner.num_blocks
+            config.scheduler, config.cache, self.runner.num_blocks,
+            max_model_len=config.model.max_model_len,
         )
         from production_stack_tpu.engine.kv_offload import (
             maybe_make_remote,
@@ -96,6 +97,16 @@ class LLMEngine:
         # the host's next-step work (prefill dispatches don't consume the
         # previous step's samples — only finished prompts' postprocess does)
         self._pending_prefill = None
+        # deferred decode resolution: consecutive decode dispatches with
+        # identical slot membership chain their input tokens DEVICE-side
+        # (the last sampled row feeds the next dispatch un-fetched), and the
+        # (K, B) sample fetch lags one dispatch. Stop checks therefore lag
+        # one dispatch too: the surplus tokens a finished sequence generates
+        # land only in its own uncommitted tail blocks (prefix hashes cover
+        # full blocks of host-side token_ids), and any dispatch issued after
+        # the blocks are released executes later in device program order —
+        # so deferred stops can't corrupt reused or cached blocks.
+        self._pending_decode = None
         # metrics
         self.total_prompt_tokens = 0
         self.total_output_tokens = 0
@@ -149,9 +160,15 @@ class LLMEngine:
     def step(self) -> list[RequestOutput]:
         out = self.scheduler.schedule()
         if out.is_empty:
-            return self._resolve_pending_prefill()
+            outputs = self._resolve_pending_prefill()
+            outputs.extend(self._resolve_pending_decode())
+            return outputs
         if out.prefills:
-            return self._run_prefill(out.prefills)
+            # stream out any decode tokens still in flight before the
+            # prefill phase takes over the device
+            outputs = self._resolve_pending_decode()
+            outputs.extend(self._run_prefill(out.prefills))
+            return outputs
         # decode consumes the first sampled token: the deferred prefill
         # must land before decode inputs are built — and resolving may
         # FINISH sequences (max_tokens=1) the scheduler already put in
@@ -161,6 +178,8 @@ class LLMEngine:
                    if s.status is SequenceStatus.RUNNING]
         if decodes:
             outputs.extend(self._run_decode(decodes))
+        else:
+            outputs.extend(self._resolve_pending_decode())
         return outputs
 
     def _resolve_pending_prefill(self) -> list[RequestOutput]:
@@ -376,12 +395,33 @@ class LLMEngine:
 
     def _run_decode(self, decodes: list[Sequence]) -> list[RequestOutput]:
         bs = self.config.cache.block_size
+        outputs: list[RequestOutput] = []
+        can_chain = (self.config.scheduler.chain_decode
+                     and getattr(self.runner, "supports_chaining", False))
+        pending = self._pending_decode
+        if pending is not None:
+            # identity check on request ids, not slots: a freed slot can
+            # be reused by a different sequence within one step window
+            same = (can_chain
+                    and [s.request_id for s in decodes] == pending["rids"]
+                    and self._pending_prefill is None)
+            if not same:
+                # membership changed: land the in-flight tokens, then
+                # rebuild from post-resolution state
+                outputs.extend(self._resolve_pending_decode())
+                decodes = [s for s in decodes
+                           if s.status is SequenceStatus.RUNNING]
+                if not decodes:
+                    return outputs
+                pending = None
+        chain = pending is not None
         self._context_lens[:] = 0
         self._slot_mapping[:] = -1
         for seq in decodes:
             i = seq.slot
             pos = seq.num_computed_tokens  # index of the incoming token
-            self._tokens[i] = seq.token_ids[pos]
+            if not chain:
+                self._tokens[i] = seq.token_ids[pos]
             self._positions[i] = pos
             n = len(seq.block_ids)
             self._block_tables[i, :n] = seq.block_ids
@@ -392,7 +432,9 @@ class LLMEngine:
             self._top_ps[i] = s.top_p
             self._top_ks[i] = s.top_k
             self._seeds[i] = s.seed or 0
-            self._steps[i] = len(seq.output_token_ids)
+            # fold counter = tokens sampled so far; under deferral the
+            # output list lags, so derive it from num_computed
+            self._steps[i] = pos - seq.num_prompt_tokens + 1
             self._presence[i] = s.presence_penalty
             self._frequency[i] = s.frequency_penalty
             self._adapter_ids[i] = seq.adapter_slot
@@ -410,7 +452,7 @@ class LLMEngine:
                 if seq.slot >= 0:
                     self.runner.set_count_row(seq.slot, seq.output_token_ids)
             self._count_reset_slots.clear()
-        sampled = self.runner.decode_multi(
+        result = self.runner.decode_multi(
             self._tokens, self._positions, self._block_tables,
             self._context_lens, self._slot_mapping,
             self._temps, self._top_ps, self._top_ks, self._seeds, self._steps,
@@ -418,20 +460,69 @@ class LLMEngine:
             presence=self._presence if use_penalties else None,
             frequency=self._frequency if use_penalties else None,
             adapter_ids=self._adapter_ids if use_lora else None,
+            tokens_dev=(pending["next_tok"] if chain else None),
+            fetch=not can_chain,
         )
+        if can_chain:
+            sampled, next_tok = result
+            # defer: speculative num_computed advance (the scheduler's
+            # block growth needs it NOW); tokens append at resolution
+            K = max(self.config.scheduler.multi_step, 1)
+            for seq in decodes:
+                seq.num_computed_tokens += K
+            self._pending_decode = {
+                "decodes": list(decodes),
+                "slots": [s.slot for s in decodes],
+                "rids": [s.request_id for s in decodes],
+                "sampled": sampled,
+                "next_tok": next_tok,
+            }
+            if chain:
+                # the previous dispatch's results are fetchable now that
+                # this one is in flight
+                outputs.extend(self._finish_decode(pending))
+            return outputs
+        outputs.extend(self._finish_decode(
+            {"decodes": decodes, "slots": [s.slot for s in decodes],
+             "sampled": result},
+            fetched=True, advance=True,
+        ))
+        return outputs
+
+    def _resolve_pending_decode(self) -> list[RequestOutput]:
+        if self._pending_decode is None:
+            return []
+        pending = self._pending_decode
+        self._pending_decode = None
+        return self._finish_decode(pending)
+
+    def _finish_decode(self, pending, fetched: bool = False,
+                       advance: bool = False) -> list[RequestOutput]:
+        """Fetch (unless already host-side) + append + stop-check one decode
+        dispatch's sampled tokens. ``advance`` replays the legacy behaviour
+        for non-chaining runners where num_computed wasn't advanced at
+        dispatch."""
+        sampled = pending["sampled"]
+        if not fetched:
+            sampled = np.asarray(jax.device_get(sampled))
         token_lists = []
-        for seq in decodes:
+        live = []
+        for seq, slot in zip(pending["decodes"], pending["slots"]):
+            if seq.status.is_finished:
+                continue  # aborted while in flight; surplus tokens dropped
             new_toks = []
             for k in range(sampled.shape[0]):
-                t = int(sampled[k, seq.slot])
-                seq.num_computed_tokens += 1
+                t = int(sampled[k, slot])
+                if advance:
+                    seq.num_computed_tokens += 1
                 seq.output_token_ids.append(t)
                 new_toks.append(t)
                 self.total_output_tokens += 1
                 if self._check_stop(seq, t) is not None:
                     break
+            live.append(seq)
             token_lists.append(new_toks)
-        return self._postprocess(decodes, token_lists)
+        return self._postprocess(live, token_lists)
 
     def _postprocess(
         self, seqs: list[Sequence], token_lists: list[list[int]]

@@ -381,16 +381,39 @@ class ModelRunner:
                 jnp.asarray(row),
             )
 
+    supports_chaining = True  # device-resident token chaining across
+    # dispatches (the staged PP runner relays through the host instead)
+
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
                      greedy_only: bool = False,
                      presence=None, frequency=None,
-                     adapter_ids=None) -> np.ndarray:
+                     adapter_ids=None, tokens_dev=None, fetch: bool = True):
         """multi_step fused decode+sample iterations; returns sampled tokens
-        (num_steps, B) on host. ``greedy_only`` selects the argmax-only
-        compiled variant; presence/frequency arrays activate the penalised
-        variant (counts tracked on device)."""
+        (num_steps, B) on host — or the un-fetched device array with
+        ``fetch=False`` so the next dispatch overlaps this one's compute
+        and result round trip. ``tokens_dev`` feeds the batch's input
+        tokens straight from the previous dispatch's device-resident
+        samples (no host round trip between chained dispatches).
+        ``greedy_only`` selects the argmax-only compiled variant;
+        presence/frequency arrays activate the penalised variant (counts
+        tracked on device)."""
         use_penalties = presence is not None
+        if not fetch:
+            # the engine rewrites these host buffers in place each step;
+            # with the fetch deferred the computation may still be pending
+            # when that happens, and jax.Array can ALIAS numpy memory (CPU
+            # zero-copy) — snapshot every mutable input
+            (tokens, positions, block_tables, context_lens, slot_mapping,
+             temps, top_ps, top_ks, seeds, steps) = (
+                np.array(x) for x in (
+                    tokens, positions, block_tables, context_lens,
+                    slot_mapping, temps, top_ps, top_ks, seeds, steps)
+            )
+            presence = None if presence is None else np.array(presence)
+            frequency = None if frequency is None else np.array(frequency)
+            adapter_ids = (None if adapter_ids is None
+                           else np.array(adapter_ids))
         if use_penalties:
             self._ensure_counts()
             counts = self.token_counts
@@ -401,10 +424,14 @@ class ModelRunner:
             pres = jnp.zeros(tokens.shape[0], jnp.float32)
             freq = pres
         use_lora = adapter_ids is not None and self.lora_bank is not None
+        # tokens_dev is the (B, 1) next-token output of the previous
+        # dispatch's program — already shaped, no eager ops on the hot path
+        tok_in = (tokens_dev if tokens_dev is not None
+                  else jnp.asarray(tokens[:, None]))
         with jax.set_mesh(self.mesh):
-            (self.kv, new_counts), sampled = self._decode_multi(
+            (self.kv, new_counts), (sampled, next_tok) = self._decode_multi(
                 self.params, self.kv,
-                jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+                tok_in, jnp.asarray(positions[:, None]),
                 jnp.asarray(block_tables), jnp.asarray(context_lens),
                 jnp.asarray(slot_mapping),
                 jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
@@ -418,6 +445,8 @@ class ModelRunner:
             )
         if use_penalties:
             self.token_counts = new_counts
+        if not fetch:
+            return sampled, next_tok
         return np.asarray(jax.device_get(sampled))
 
     # -- sleep mode hooks ----------------------------------------------------
@@ -766,4 +795,8 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
     (kv, _, _, _, _, _, counts), sampled = jax.lax.scan(
         body, init, None, length=num_steps
     )
-    return (kv, counts), sampled  # (num_steps, B)
+    # next_tok comes out of the SAME program: an eager slice on the result
+    # would cost extra dispatches (each one a full round trip on a
+    # tunneled device) on the chained-decode hot path
+    next_tok = sampled[-1][:, None]  # (B, 1) input for a chained dispatch
+    return (kv, counts), (sampled, next_tok)  # sampled: (num_steps, B)

@@ -48,9 +48,11 @@ class SchedulerOutput:
 
 
 class Scheduler:
-    def __init__(self, sched: SchedulerConfig, cache: CacheConfig, num_blocks: int):
+    def __init__(self, sched: SchedulerConfig, cache: CacheConfig,
+                 num_blocks: int, max_model_len: int = 1 << 30):
         self.config = sched
         self.cache_config = cache
+        self.max_model_len = max_model_len
         self.allocator = PrefixCachingBlockAllocator(
             num_blocks, cache.block_size, cache.enable_prefix_caching
         )
@@ -92,6 +94,13 @@ class Scheduler:
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.seqs)
+
+    def _decode_exhausted(self, seq: Sequence) -> bool:
+        bound = min(
+            seq.num_prompt_tokens + seq.sampling.max_tokens,
+            self.max_model_len,
+        )
+        return seq.num_computed_tokens >= bound
 
     # -- internals ------------------------------------------------------------
     def _release(self, seq: Sequence) -> None:
@@ -196,9 +205,14 @@ class Scheduler:
 
         # decode all running sequences; grow block tables first so every
         # sequence has capacity for the next multi_step tokens (positions
-        # num_computed .. num_computed + multi_step - 1)
+        # num_computed .. num_computed + multi_step - 1). A sequence whose
+        # already-dispatched tokens cover its completion bound is excluded:
+        # under deferred resolution its finish is still in flight, and a
+        # further dispatch would run past max_model_len's block table.
         decodes = sorted(
-            (s for s in self.seqs.values() if s.status is SequenceStatus.RUNNING),
+            (s for s in self.seqs.values()
+             if s.status is SequenceStatus.RUNNING
+             and not self._decode_exhausted(s)),
             key=lambda s: s.slot,
         )
         bs = self.cache_config.block_size

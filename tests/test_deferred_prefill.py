@@ -114,3 +114,48 @@ def test_empty_schedule_flushes_pending():
     outs = engine.step()  # schedule sees RUNNING seq -> resolves + finishes
     assert engine._pending_prefill is None
     assert any(o.finished for o in outs)
+
+
+def test_chained_decode_token_identical():
+    """chain_decode=true (off by default: the tunneled dev chip serialises
+    unfetched dispatch chains) must produce identical tokens, including
+    seeded sampling and mid-stream membership changes."""
+    from production_stack_tpu.engine.config import SchedulerConfig
+
+    def make(chain):
+        cfg = EngineConfig(
+            model=ModelConfig.from_pretrained("tiny-llama"),
+            cache=CacheConfig(block_size=4, num_blocks=128),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_num_batched_tokens=64,
+                prefill_buckets=(16, 32), multi_step=2,
+                chain_decode=chain,
+            ),
+            mesh=MeshConfig(data=1, tensor=1),
+        )
+        return LLMEngine(cfg, mesh=build_mesh(cfg.mesh), num_blocks=128)
+
+    sp = SamplingParams(temperature=0.8, top_k=30, seed=7, max_tokens=9,
+                       ignore_eos=True)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+
+    def run(engine):
+        for i, p in enumerate(prompts):
+            # staggered max_tokens force a mid-stream membership change
+            spi = SamplingParams(**{**sp.__dict__,
+                                    "max_tokens": sp.max_tokens - 4 * i})
+            engine.add_request(f"r{i}", prompt_token_ids=p, sampling=spi)
+        toks = {f"r{i}": [] for i in range(len(prompts))}
+        steps = 0
+        while engine.has_unfinished() and steps < 64:
+            for o in engine.step():
+                if o.request_id in toks:
+                    toks[o.request_id].extend(o.new_token_ids)
+            steps += 1
+        return toks
+
+    ref = run(make(False))
+    got = run(make(True))
+    assert got == ref
+    for i in range(len(prompts)):
+        assert len(ref[f"r{i}"]) == sp.max_tokens - 4 * i
