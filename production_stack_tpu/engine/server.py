@@ -95,7 +95,26 @@ class EngineServer:
 
     # -- app assembly --------------------------------------------------------
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        import os
+
+        middlewares = []
+        spec = os.environ.get("FAULT_INJECTION", "")
+        if spec:
+            from production_stack_tpu.testing.faults import (
+                FaultSpec,
+                fault_middleware,
+            )
+
+            parsed = FaultSpec.parse(spec)
+            if parsed.active:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "FAULT INJECTION ACTIVE: %s", parsed
+                )
+                middlewares.append(fault_middleware(parsed))
+        app = web.Application(client_max_size=64 * 1024 * 1024,
+                              middlewares=middlewares)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_get("/v1/models", self.models)
@@ -1020,6 +1039,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prompt length at which prefill switches to the "
                         "ring-attention sequence-parallel path (needs "
                         "--sequence-parallel-size > 1)")
+    p.add_argument("--fault-injection", default=None,
+                   help="inject faults on the OpenAI surface for "
+                        "resilience drills, e.g. "
+                        "error_rate=0.3,latency_ms=100 (testing/faults.py)")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
     p.add_argument("--host-offload-blocks", type=int, default=0,
@@ -1073,7 +1096,11 @@ def config_from_args(args) -> EngineConfig:
 
 
 def main(argv=None) -> None:
+    import os
+
     args = build_parser().parse_args(argv)
+    if args.fault_injection:
+        os.environ["FAULT_INJECTION"] = args.fault_injection
     config = config_from_args(args)
     server = EngineServer(config, warmup_on_start=not args.skip_warmup)
     web.run_app(server.build_app(), host=args.host, port=args.port,

@@ -1,0 +1,100 @@
+"""Fault injection for resilience testing.
+
+The reference stack has no fault-injection facility (SURVEY.md §5.3
+called that a gap to beat): its failover paths are only exercised by
+killing pods. This module injects controlled faults into a live engine's
+OpenAI surface so failover, retry, and alerting paths can be driven
+deterministically — in tests, in CI, or on a canary pod.
+
+Spec string (flag ``--fault-injection`` or env ``FAULT_INJECTION``;
+the flag wins when both are set):
+
+    error_rate=0.3,latency_ms=250,drop_rate=0.05,seed=7
+
+  error_rate   probability a request returns 500 before reaching the engine
+  latency_ms   added latency per request (before any error/drop decision)
+  drop_rate    probability the connection is closed before any response
+               byte (a connect-class failure: abrupt reset instead of a
+               clean 500 — exercises the client-error failover branch)
+  seed         deterministic PRNG seed (omit for nondeterministic)
+
+error_rate + drop_rate must not exceed 1 (they partition one roll).
+
+Faults apply to POST /v1/* only: health, metrics, and discovery endpoints
+stay truthful, mirroring a sick-but-alive backend — the hardest failure
+mode for a router (a dead pod is easy; a flaky one must be failed over
+per request).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from aiohttp import web
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    error_rate: float = 0.0
+    latency_ms: float = 0.0
+    drop_rate: float = 0.0
+    seed: Optional[int] = None
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultSpec":
+        kwargs = {}
+        for item in (spec or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, _, value = item.partition("=")
+            key = key.strip()
+            if key not in ("error_rate", "latency_ms", "drop_rate", "seed"):
+                raise ValueError(f"unknown fault key {key!r}")
+            kwargs[key] = int(value) if key == "seed" else float(value)
+        spec_obj = cls(**kwargs)
+        if not 0 <= spec_obj.error_rate <= 1 or not 0 <= spec_obj.drop_rate <= 1:
+            raise ValueError("rates must be in [0, 1]")
+        if spec_obj.error_rate + spec_obj.drop_rate > 1:
+            raise ValueError("error_rate + drop_rate must not exceed 1 "
+                             "(they partition one roll)")
+        if spec_obj.latency_ms < 0:
+            raise ValueError("latency_ms must be >= 0")
+        return spec_obj
+
+    @property
+    def active(self) -> bool:
+        return bool(self.error_rate or self.latency_ms or self.drop_rate)
+
+
+def fault_middleware(spec: FaultSpec):
+    """aiohttp middleware injecting the spec's faults on POST /v1/*."""
+    rng = random.Random(spec.seed)
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.method != "POST" or not request.path.startswith("/v1/"):
+            return await handler(request)
+        if spec.latency_ms:
+            import asyncio
+
+            await asyncio.sleep(spec.latency_ms / 1000.0)
+        roll = rng.random()
+        if roll < spec.error_rate:
+            return web.json_response(
+                {"error": {"message": "injected fault",
+                           "type": "fault_injection"}},
+                status=500,
+            )
+        if roll < spec.error_rate + spec.drop_rate:
+            # abrupt reset before any response byte: the client sees a
+            # connection error (not a clean 500), driving the router's
+            # connect-failure failover branch
+            if request.transport is not None:
+                request.transport.close()
+            raise web.HTTPInternalServerError(text="injected drop")
+        return await handler(request)
+
+    return middleware
