@@ -126,6 +126,9 @@ class EngineServer:
         app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/kv/export", self.kv_export)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/score", self.score)
+        app.router.add_post("/v1/rerank", self.rerank)
+        app.router.add_post("/rerank", self.rerank)  # Jina-style alias
         app.router.add_post("/v1/messages", self.messages)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
@@ -303,6 +306,140 @@ class EngineServer:
                       "output_tokens": len(token_ids)},
         })
 
+    def _encode_ids(self, text) -> list[int]:
+        """Shared encoder-input pipeline for embeddings/score/rerank:
+        str -> tokenize; list of ints -> pre-tokenized; anything else is
+        the caller's validation problem. Truncated to max_model_len - 1."""
+        tk = self.engine.tokenizer
+        ids = tk.encode(text) if isinstance(text, str) else list(text)
+        return ids[: self.config.model.max_model_len - 1]
+
+    async def _pair_scores(self, query, documents):
+        """Cosine similarity of pooled hidden states (the causal-LM
+        fallback scorer, matching the /v1/embeddings encoder). Returns
+        (scores, total_tokens)."""
+        import numpy as np
+
+        total = 0
+
+        async def vec(text):
+            nonlocal total
+            ids = self._encode_ids(text)
+            total += len(ids)
+            return await self.async_engine.run_on_engine(
+                lambda eng, ids=ids: eng.embed(ids)
+            )
+
+        q = np.asarray(await vec(query), np.float32)
+        qn = q / max(float(np.linalg.norm(q)), 1e-9)
+        out = []
+        for doc in documents:
+            d = np.asarray(await vec(doc), np.float32)
+            dn = d / max(float(np.linalg.norm(d)), 1e-9)
+            out.append(float(qn @ dn))
+        return out, total
+
+    async def score(self, request: web.Request) -> web.Response:
+        """vLLM-style /v1/score: similarity of text_1 against each text_2
+        (the reference router proxies this endpoint; here it's native)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        t1 = body.get("text_1")
+        t2 = body.get("text_2")
+        if t1 is None or t2 is None:
+            return web.json_response(
+                {"error": {"message": "'text_1' and 'text_2' are required"}},
+                status=400,
+            )
+        # vLLM accepts str-or-list on both sides; lists of strings are
+        # queries, not token ids
+        queries = t1 if isinstance(t1, list) else [t1]
+        docs = t2 if isinstance(t2, list) else [t2]
+        if not all(isinstance(x, str) for x in queries + docs):
+            return web.json_response(
+                {"error": {"message": "text_1/text_2 must be strings or "
+                           "lists of strings"}},
+                status=400,
+            )
+        if len(queries) == 1:
+            scores, total = await self._pair_scores(queries[0], docs)
+        elif len(queries) == len(docs):  # pairwise form
+            scores, total = [], 0
+            for q, d in zip(queries, docs):
+                s, t = await self._pair_scores(q, [d])
+                scores.append(s[0])
+                total += t
+        else:
+            return web.json_response(
+                {"error": {"message": "text_1 list must have length 1 or "
+                           "match text_2"}},
+                status=400,
+            )
+        return web.json_response({
+            "id": f"score-{uuid.uuid4().hex[:16]}",
+            "object": "list",
+            "model": body.get("model", self.model_name),
+            "data": [{"object": "score", "index": i, "score": s}
+                     for i, s in enumerate(scores)],
+            "usage": {"total_tokens": total},
+        })
+
+    async def rerank(self, request: web.Request) -> web.Response:
+        """Jina/Cohere-style rerank: order documents by relevance to the
+        query (served natively; reference: /rerank and /v1/rerank in its
+        proxy list, main_router.py)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        query = body.get("query")
+        documents = body.get("documents")
+        if not query or not isinstance(documents, list) or not documents:
+            return web.json_response(
+                {"error": {"message":
+                           "'query' and a non-empty 'documents' list are "
+                           "required"}},
+                status=400,
+            )
+        # Cohere/Jina allow documents as strings OR {"text": ...} objects
+        texts = [d.get("text") if isinstance(d, dict) else d
+                 for d in documents]
+        if not all(isinstance(t, str) for t in texts):
+            return web.json_response(
+                {"error": {"message": "documents must be strings or "
+                           "objects with a 'text' field"}},
+                status=400,
+            )
+        try:
+            top_n = int(body.get("top_n") or len(texts))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "'top_n' must be an integer"}},
+                status=400,
+            )
+        if top_n < 1:
+            return web.json_response(
+                {"error": {"message": "'top_n' must be >= 1"}}, status=400
+            )
+        scores, total = await self._pair_scores(query, texts)
+        order = sorted(range(len(texts)), key=lambda i: -scores[i])
+        results = [
+            {"index": i, "relevance_score": scores[i],
+             **({"document": {"text": texts[i]}}
+                if body.get("return_documents", True) else {})}
+            for i in order[:top_n]
+        ]
+        return web.json_response({
+            "id": f"rerank-{uuid.uuid4().hex[:16]}",
+            "model": body.get("model", self.model_name),
+            "results": results,
+            "usage": {"total_tokens": total},
+        })
+
     async def embeddings(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -322,8 +459,7 @@ class EngineServer:
         data = []
         total_tokens = 0
         for i, text in enumerate(inputs):
-            ids = tk.encode(text) if isinstance(text, str) else list(text)
-            ids = ids[: self.config.model.max_model_len - 1]
+            ids = self._encode_ids(text)
             total_tokens += len(ids)
             vec = await self.async_engine.run_on_engine(
                 lambda eng, ids=ids: eng.embed(ids)

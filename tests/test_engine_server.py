@@ -365,3 +365,65 @@ def test_profile_capture_endpoints(server):
         assert len(await r.read()) > 0
 
     run(with_client(server, fn))
+
+
+def test_score_and_rerank_native(server):
+    """/v1/score and /v1/rerank served natively (the reference only
+    proxies them): identical texts score ~1.0 and rank first."""
+
+    async def fn(client):
+        r = await client.post(
+            "/v1/score",
+            json={"text_1": "the quick brown fox",
+                  "text_2": ["the quick brown fox", "zzz qqq 123"]},
+        )
+        assert r.status == 200
+        data = (await r.json())["data"]
+        assert data[0]["score"] > 0.99
+        assert data[0]["score"] > data[1]["score"]
+
+        r = await client.post(
+            "/v1/rerank",
+            json={"query": "the quick brown fox",
+                  "documents": ["zzz qqq 123", "the quick brown fox",
+                                "something else"],
+                  "top_n": 2},
+        )
+        assert r.status == 200
+        results = (await r.json())["results"]
+        assert len(results) == 2
+        assert results[0]["index"] == 1  # the identical document wins
+        assert results[0]["relevance_score"] >= results[1]["relevance_score"]
+        assert results[0]["document"]["text"] == "the quick brown fox"
+
+        r = await client.post("/rerank", json={"query": "q",
+                                               "documents": ["a"]})
+        assert r.status == 200  # Jina-style alias
+
+        # Cohere/Jina document objects + usage accounting + validation
+        r = await client.post(
+            "/v1/rerank",
+            json={"query": "q", "documents": [{"text": "alpha"},
+                                              {"text": "q"}]},
+        )
+        body = await r.json()
+        assert r.status == 200 and body["usage"]["total_tokens"] > 0
+        assert body["results"][0]["document"]["text"] == "q"
+        r = await client.post("/v1/rerank",
+                              json={"query": "q", "documents": ["a"],
+                                    "top_n": "abc"})
+        assert r.status == 400
+        r = await client.post("/v1/rerank",
+                              json={"query": "q", "documents": ["a"],
+                                    "top_n": -1})
+        assert r.status == 400
+        # vLLM list forms of text_1
+        r = await client.post("/v1/score",
+                              json={"text_1": ["q1", "q2"],
+                                    "text_2": ["d1", "d2"]})
+        assert r.status == 200
+        assert len((await r.json())["data"]) == 2
+        r = await client.post("/v1/score", json={"text_1": "x"})
+        assert r.status == 400
+
+    run(with_client(server, fn))
