@@ -177,9 +177,27 @@ def main(argv=None):
                    help="optional wall-clock cap in seconds")
     p.add_argument("--request-timeout", type=float, default=300.0)
     p.add_argument("--output", default=None, help="write summary JSON here")
+    p.add_argument("--qps-sweep", default=None,
+                   help="comma-separated QPS values to sweep (the "
+                        "reference's run.sh methodology: same workload at "
+                        "each arrival rate, one summary per point; "
+                        "overrides --qps)")
     args = p.parse_args(argv)
-    summary = asyncio.run(run(args))
-    print(json.dumps(summary))
+    if args.qps_sweep:
+        # parse EVERYTHING up front: a malformed token must fail before
+        # any (potentially hours-long) point runs, not mid-sweep
+        sweep_values = [float(x) for x in args.qps_sweep.split(",") if x.strip()]
+        points = []
+        for qps in sweep_values:
+            args.qps = qps
+            point = asyncio.run(run(args))
+            point["qps_target"] = qps
+            points.append(point)
+            print(json.dumps(point))
+        summary = {"sweep": points}
+    else:
+        summary = asyncio.run(run(args))
+        print(json.dumps(summary))
     if args.output:
         with open(args.output, "w") as f:
             json.dump(summary, f, indent=2)
