@@ -187,6 +187,8 @@ def main(argv=None):
         # parse EVERYTHING up front: a malformed token must fail before
         # any (potentially hours-long) point runs, not mid-sweep
         sweep_values = [float(x) for x in args.qps_sweep.split(",") if x.strip()]
+        if not sweep_values:
+            p.error("--qps-sweep has no values")
         points = []
         for qps in sweep_values:
             args.qps = qps

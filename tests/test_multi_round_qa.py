@@ -8,6 +8,33 @@ import threading
 from production_stack_tpu.testing.fake_engine import FakeEngine
 
 
+def start_fake_engine_thread(fe):
+    """Serve a FakeEngine on a daemon thread; returns (port, loop)."""
+    from aiohttp import web
+
+    holder = {}
+
+    def serve():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(fe.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = runner.addresses[0][1]
+        holder["loop"] = loop
+        loop.run_forever()
+
+    threading.Thread(target=serve, daemon=True).start()
+    import time
+
+    for _ in range(200):
+        if "port" in holder:
+            break
+        time.sleep(0.05)
+    return holder["port"], holder["loop"]
+
+
 def test_harness_against_fake_engine():
     from aiohttp import web
 
@@ -54,36 +81,15 @@ def test_qps_sweep_mode(tmp_path):
     """--qps-sweep runs the same workload at each arrival rate and writes
     one summary per point (the reference run.sh's 0.1->4.1 sweep shape)."""
     import json
-    import time
-
-    from aiohttp import web
 
     fe = FakeEngine(model="tiny-llama", tokens_per_second=5000, ttft=0.001)
-    holder = {}
-
-    def serve():
-        loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(loop)
-        runner = web.AppRunner(fe.build_app())
-        loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        loop.run_until_complete(site.start())
-        holder["port"] = runner.addresses[0][1]
-        holder["loop"] = loop
-        loop.run_forever()
-
-    t = threading.Thread(target=serve, daemon=True)
-    t.start()
-    for _ in range(100):
-        if "port" in holder:
-            break
-        time.sleep(0.05)
+    port, loop = start_fake_engine_thread(fe)
 
     from benchmarks.multi_round_qa import main
 
     out = tmp_path / "sweep.json"
     summary = main([
-        "--base-url", f"http://127.0.0.1:{holder['port']}",
+        "--base-url", f"http://127.0.0.1:{port}",
         "--model", "tiny-llama",
         "--num-users", "2", "--num-rounds", "1",
         "--system-prompt-len", "16", "--user-history-len", "8",
@@ -95,4 +101,4 @@ def test_qps_sweep_mode(tmp_path):
     assert [p["qps_target"] for p in summary["sweep"]] == [4.0, 8.0]
     assert all(p["requests"] > 0 for p in summary["sweep"])
     assert json.loads(out.read_text())["sweep"]
-    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+    loop.call_soon_threadsafe(loop.stop)

@@ -1,0 +1,156 @@
+"""Sanitizer builds of the native components (SURVEY §5.2 — the C++
+equivalent of the reference's `go test -race`): the concurrent hashtrie
+smoke runs under BOTH ASan and TSan, the picker binary under TSan with
+concurrent HTTP clients, and the reconciler (single-threaded decision
+core) under ASan — zero reports everywhere. Marked slow-ish (three compiler invocations)."""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+NATIVE = os.path.join(ROOT, "native")
+
+
+def build(component: str, target: str) -> str:
+    r = subprocess.run(["make", "-C", os.path.join(NATIVE, component),
+                        target], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-800:]
+    return os.path.join(NATIVE, component)
+
+
+@pytest.mark.parametrize("target", ["asan", "tsan"])
+def test_hashtrie_sanitized_concurrent(target):
+    """Concurrent inserts/matches under ASan via a driver subprocess (the
+    sanitizer runtime must be preloaded before python's allocator)."""
+    d = build("hashtrie", target)
+    so = os.path.join(d, f"libhashtrie_{target}.so")
+    driver = f"""
+import ctypes, threading
+lib = ctypes.CDLL({so!r})
+lib.ht_create.restype = ctypes.c_void_p
+lib.ht_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+lib.ht_insert.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                          ctypes.c_size_t, ctypes.c_char_p]
+lib.ht_match.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+lib.ht_match.restype = ctypes.c_size_t
+lib.ht_destroy.argtypes = [ctypes.c_void_p]
+t = lib.ht_create(8, 64)
+def worker(wid):
+    for i in range(200):
+        text = (f"prompt-{{wid}}-{{i}}" * 4).encode()
+        lib.ht_insert(t, text, len(text), f"ep-{{wid}}".encode())
+        out = ctypes.create_string_buffer(256)
+        lib.ht_match(t, text, len(text), b"ep-0\\nep-1\\nep-2",
+                     out, 256)
+threads = [threading.Thread(target=worker, args=(w,)) for w in range(3)]
+[x.start() for x in threads]
+[x.join() for x in threads]
+lib.ht_destroy(t)
+print("SMOKE-OK")
+"""
+    env = dict(os.environ,
+               LD_PRELOAD=_sanitizer_runtime(target),
+               ASAN_OPTIONS="detect_leaks=0,exitcode=66")
+    r = subprocess.run([sys.executable, "-c", driver], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "SMOKE-OK" in r.stdout, (
+        r.stdout[-400:] + r.stderr[-800:]
+    )
+
+
+def _sanitizer_runtime(target: str) -> str:
+    name = {"asan": "libasan.so", "tsan": "libtsan.so"}[target]
+    r = subprocess.run(["gcc", f"-print-file-name={name}"],
+                       capture_output=True, text=True)
+    path = r.stdout.strip()
+    assert os.path.sep in path, f"{name} not found"
+    return path
+
+
+def test_reconciler_asan_roundtrip():
+    d = build("reconciler", "asan")
+    so = os.path.join(d, "libreconcile_asan.so")
+    driver = f"""
+import ctypes
+lib = ctypes.CDLL({so!r})
+lib.rc_subset_drifted.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+lib.rc_subset_drifted.restype = ctypes.c_int
+assert lib.rc_subset_drifted(b'{{"a": [1, {{"b": "x"}}]}}',
+                             b'{{"a": [1, {{"b": "x"}}], "c": 2}}') == 0
+assert lib.rc_subset_drifted(b'{{"a": 1}}', b'{{"a": 2}}') == 1
+assert lib.rc_subset_drifted(b'not json', b'{{}}') == -1
+print("SMOKE-OK")
+"""
+    env = dict(os.environ, LD_PRELOAD=_sanitizer_runtime("asan"),
+               ASAN_OPTIONS="detect_leaks=0,exitcode=66")
+    r = subprocess.run([sys.executable, "-c", driver], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "SMOKE-OK" in r.stdout, (
+        r.stdout[-400:] + r.stderr[-800:]
+    )
+
+
+def test_picker_tsan_concurrent_picks():
+    """The picker binary under TSan, hammered by concurrent HTTP clients
+    (its per-connection threads share the trie + counters)."""
+    import json
+    import socket
+    import threading
+    import time
+    import urllib.request
+
+    d = build("gateway_picker", "tsan")
+    binary = os.path.join(d, "picker_server_tsan")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, TSAN_OPTIONS="exitcode=66,halt_on_error=1")
+    proc = subprocess.Popen([binary, "--port", str(port), "--picker",
+                             "prefix", "--chunk-size", "8"],
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        healthy = False
+        for _ in range(200):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=1)
+                healthy = True
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert healthy, "picker never became healthy under TSan"
+
+        errors = []
+
+        def client(cid):
+            try:
+                for i in range(20):
+                    body = json.dumps({
+                        "prompt": f"shared prefix {cid % 2} tail {i}",
+                        "endpoints": ["http://a:1", "http://b:1"],
+                    }).encode()
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/pick", data=body)
+                    urllib.request.urlopen(req, timeout=10).read()
+            except Exception as e:  # a dead thread must fail the test
+                errors.append(f"client {cid}: {e}")
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errors, errors
+        assert proc.poll() is None, (
+            "picker died under TSan: " + (proc.stderr.read() or "")[-800:]
+        )
+    finally:
+        proc.kill()
+        stderr = proc.stderr.read() or ""
+    assert "WARNING: ThreadSanitizer" not in stderr, stderr[-1200:]
