@@ -1,0 +1,123 @@
+"""Validating admission webhook for the operator's CRDs.
+
+Parity with the reference operator's kubebuilder webhook wiring
+(operator/cmd/main.go there): invalid CRs are rejected at admission time
+with a human-readable reason instead of failing silently in reconcile.
+Serves the Kubernetes AdmissionReview v1 contract on POST /validate;
+GET /healthz for the webhook Deployment's probes. The
+ValidatingWebhookConfiguration manifest lives next to the CRDs
+(operator/webhook.yaml).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+
+def validate_tpuruntime(spec: dict) -> Optional[str]:
+    if not spec.get("model"):
+        return "spec.model is required"
+    replicas = spec.get("replicas", 1)
+    if not isinstance(replicas, int) or replicas < 0:
+        return "spec.replicas must be a non-negative integer"
+    tpu = spec.get("tpu") or {}
+    chips = tpu.get("chips", 8)
+    if not isinstance(chips, int) or chips < 1:
+        return "spec.tpu.chips must be >= 1"
+    ec = spec.get("engineConfig") or {}
+    tp = ec.get("tensorParallelSize")
+    if tp is not None and (not isinstance(tp, int) or tp < 1):
+        return "spec.engineConfig.tensorParallelSize must be >= 1"
+    if tp is not None and chips % tp != 0:
+        return (f"spec.tpu.chips ({chips}) must be divisible by "
+                f"tensorParallelSize ({tp})")
+    au = spec.get("autoscaling") or {}
+    lo = au.get("minReplicas", 1)
+    hi = au.get("maxReplicas", 8)
+    if au and (not isinstance(lo, int) or lo < 0):
+        return "spec.autoscaling.minReplicas must be a non-negative integer"
+    if au and lo > hi:
+        return "spec.autoscaling.minReplicas must be <= maxReplicas"
+    return None
+
+
+def validate_loraadapter(spec: dict) -> Optional[str]:
+    if not spec.get("baseModel"):
+        return "spec.baseModel is required"
+    src = spec.get("source") or {}
+    if not src.get("path"):
+        # only source.path is read by reconcile_lora — accepting any
+        # other field here would admit CRs that fail silently later
+        return "spec.source.path is required"
+    placement = spec.get("placement") or {}
+    algo = placement.get("algorithm", "default")
+    if algo not in ("default", "ordered", "equalized"):
+        return f"unknown placement algorithm {algo!r}"
+    return None
+
+
+VALIDATORS = {
+    "TPURuntime": validate_tpuruntime,
+    "LoraAdapter": validate_loraadapter,
+}
+
+
+def build_app() -> web.Application:
+    async def validate(request: web.Request) -> web.Response:
+        try:
+            review = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid AdmissionReview"},
+                                     status=400)
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+        obj = req.get("object") or {}
+        kind = (obj.get("kind")
+                or (req.get("kind") or {}).get("kind") or "")
+        validator = VALIDATORS.get(kind)
+        reason = validator(obj.get("spec") or {}) if validator else None
+        response = {"uid": uid, "allowed": reason is None}
+        if reason is not None:
+            response["status"] = {"message": reason, "code": 422}
+        return web.json_response({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": response,
+        })
+
+    async def health(request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+    app = web.Application()
+    app.router.add_post("/validate", validate)
+    app.router.add_get("/healthz", health)
+    return app
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-serving-operator-webhook")
+    p.add_argument("--port", type=int, default=9443)
+    p.add_argument("--tls-cert", default=None)
+    p.add_argument("--tls-key", default=None)
+    args = p.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        # half-configured TLS must not silently serve plaintext: the
+        # apiserver requires HTTPS and failurePolicy Fail would then
+        # block every CR write in the cluster
+        p.error("--tls-cert and --tls-key must be provided together")
+    ssl_ctx = None
+    if args.tls_cert and args.tls_key:
+        import ssl
+
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(args.tls_cert, args.tls_key)
+    web.run_app(build_app(), port=args.port, ssl_context=ssl_ctx,
+                access_log=None)
+
+
+if __name__ == "__main__":
+    main()

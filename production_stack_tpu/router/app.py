@@ -105,6 +105,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument("--log-level", default="info")
     p.add_argument("--dynamic-config-file", default=None)
+    p.add_argument("--sentry-dsn", default=None,
+                   help="opt-in Sentry error/profiling reporting "
+                        "(reference parity; needs sentry-sdk in the image)")
+    p.add_argument("--sentry-traces-sample-rate", type=float, default=0.1)
     p.add_argument("--feature-gates", default="",
                    help="Feature=bool[,Feature=bool...]")
     p.add_argument("--callbacks", default=None,
@@ -138,6 +142,23 @@ class RouterApp:
     def initialize(self) -> None:
         args = self.args
         set_log_level(args.log_level)
+
+        # Sentry opt-in (reference: sentry_sdk.init in its app.py:172-179);
+        # gated on both the flag and the sdk being baked into the image
+        if getattr(args, "sentry_dsn", None):
+            try:
+                import sentry_sdk
+
+                sentry_sdk.init(
+                    dsn=args.sentry_dsn,
+                    traces_sample_rate=args.sentry_traces_sample_rate,
+                )
+                logger.info("sentry reporting enabled")
+            except ImportError:
+                logger.warning(
+                    "--sentry-dsn set but sentry-sdk is not installed; "
+                    "error reporting disabled"
+                )
 
         # API keys (reference: VLLM_API_KEY env / secrets): one key per line
         self._api_keys: set[str] = set()

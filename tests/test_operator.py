@@ -360,3 +360,66 @@ def test_native_drift_core_matches_python():
     for desired, live in cases:
         assert subset_drifted(desired, live) == \
             _py_subset_drifted(desired, live), (desired, live)
+
+
+def test_admission_webhook_validation():
+    """Invalid CRs are rejected at admission (reference: kubebuilder
+    webhooks); valid ones pass with the AdmissionReview v1 shape."""
+    from production_stack_tpu.operator.webhook import build_app
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        def review(kind, spec):
+            return {"apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": "u1",
+                                "object": {"kind": kind, "spec": spec}}}
+
+        async with TestClient(TestServer(build_app())) as c:
+            r = await c.post("/validate", json=review("TPURuntime", {
+                "model": "llama-3-8b",
+                "tpu": {"chips": 8},
+                "engineConfig": {"tensorParallelSize": 4},
+            }))
+            body = await r.json()
+            assert body["response"]["allowed"] is True
+            assert body["response"]["uid"] == "u1"
+
+            # chips not divisible by tp
+            r = await c.post("/validate", json=review("TPURuntime", {
+                "model": "m", "tpu": {"chips": 8},
+                "engineConfig": {"tensorParallelSize": 3},
+            }))
+            body = await r.json()
+            assert body["response"]["allowed"] is False
+            assert "divisible" in body["response"]["status"]["message"]
+
+            r = await c.post("/validate", json=review("TPURuntime", {}))
+            assert not (await r.json())["response"]["allowed"]
+
+            r = await c.post("/validate", json=review("LoraAdapter", {
+                "baseModel": "m",
+                "source": {"path": "/a"},
+                "placement": {"algorithm": "sideways"},
+            }))
+            body = await r.json()
+            assert not body["response"]["allowed"]
+
+            # adapterPath alone is NOT accepted (reconcile reads only
+            # source.path)
+            r = await c.post("/validate", json=review("LoraAdapter", {
+                "baseModel": "m", "adapterPath": "/a",
+            }))
+            assert not (await r.json())["response"]["allowed"]
+
+            r = await c.post("/validate", json=review("TPURuntime", {
+                "model": "m", "autoscaling": {"minReplicas": -1},
+            }))
+            assert not (await r.json())["response"]["allowed"]
+
+            # unknown kinds are allowed through (no validator registered)
+            r = await c.post("/validate", json=review("TPURouter", {}))
+            assert (await r.json())["response"]["allowed"]
+
+    asyncio.run(main())

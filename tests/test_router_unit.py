@@ -188,3 +188,18 @@ def test_semantic_cache_hit_and_threshold():
     far = float(embed("what is the capital of france?") @
                 embed("completely different text about tpus"))
     assert far < 0.95
+
+
+def test_sentry_flag_gated_on_sdk():
+    """--sentry-dsn without sentry-sdk in the image degrades to a warning,
+    not a crash (reference inits sentry unconditionally; ours is gated)."""
+    from production_stack_tpu.router.app import RouterApp, build_parser
+
+    args = build_parser().parse_args([
+        "--service-discovery", "static",
+        "--static-backends", "http://127.0.0.1:9",
+        "--static-models", "m",
+        "--sentry-dsn", "https://x@sentry.example/1",
+    ])
+    app = RouterApp(args)
+    app.initialize()  # must not raise (sdk absent in this image)
