@@ -29,6 +29,9 @@
 //                hash table); routes to the deepest match when the
 //                unmatched remainder <= threshold, else falls back to
 //                roundrobin (reference: kv_aware_picker.go:47-86)
+//   session    — sticky hashing of the request's session_key field onto
+//                the sorted endpoint list (beyond the reference's three
+//                pickers; mirrors the router's SessionRouter)
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -334,7 +337,8 @@ class Picker {
           trie_(ht_create(chunk_size, 1024)) {}
 
     PickResult pick(const std::string& model, const std::string& prompt,
-                    std::vector<std::string> endpoints) {
+                    std::vector<std::string> endpoints,
+                    const std::string& session_key = "") {
         for (auto& e : endpoints) e = sanitize_endpoint(e);
         endpoints.erase(
             std::remove_if(endpoints.begin(), endpoints.end(),
@@ -347,6 +351,8 @@ class Picker {
             r = pick_prefix(prompt, endpoints);
         } else if (mode_ == "kvaware") {
             r = pick_kvaware(model, prompt, endpoints);
+        } else if (mode_ == "session") {
+            r = pick_session(session_key, endpoints);
         } else {
             r = pick_roundrobin(endpoints);
         }
@@ -404,6 +410,52 @@ class Picker {
         }
         ht_insert(trie_, prompt.data(), prompt.size(), r.endpoint.c_str());
         return r;
+    }
+
+    static uint64_t fnv64(const std::string& s) {
+        uint64_t h = 1469598103934665603ULL;
+        for (char c : s) {
+            h ^= (unsigned char)c;
+            h *= 1099511628211ULL;
+        }
+        // splitmix64 finalizer: bare FNV clusters similar short strings
+        // (an endpoint's vnodes would band together and capture the whole
+        // key space)
+        h ^= h >> 30;
+        h *= 0xbf58476d1ce4e5b9ULL;
+        h ^= h >> 27;
+        h *= 0x94d049bb133111ebULL;
+        h ^= h >> 31;
+        return h;
+    }
+
+    PickResult pick_session(const std::string& session_key,
+                            const std::vector<std::string>& endpoints) {
+        if (session_key.empty()) return pick_roundrobin(endpoints);
+        // consistent-hash ring (64 virtual points per endpoint), the same
+        // scheme as the router's SessionRouter: scaling the pool remaps
+        // only the keys adjacent to the added/removed node's points —
+        // plain modulo would reshuffle nearly every session on any scale
+        // event
+        const uint64_t kh = fnv64(session_key);
+        const std::string* best = nullptr;
+        uint64_t best_h = UINT64_MAX;
+        const std::string* first = nullptr;   // wraparound target
+        uint64_t first_h = UINT64_MAX;
+        for (const auto& ep : endpoints) {
+            for (int v = 0; v < 64; ++v) {
+                uint64_t h = fnv64(ep + "#" + std::to_string(v));
+                if (h < first_h) {
+                    first_h = h;
+                    first = &ep;
+                }
+                if (h >= kh && h < best_h) {
+                    best_h = h;
+                    best = &ep;
+                }
+            }
+        }
+        return {best ? *best : *first, 0};
     }
 
     PickResult pick_kvaware(const std::string& model,
@@ -545,17 +597,19 @@ void handle(int fd, Picker* picker,
         respond(fd, 200, "text/plain; version=0.0.4", picker->metrics());
     } else if (req.method == "POST" &&
                (req.path == "/pick" || req.path == "/process")) {
-        std::string model, prompt;
+        std::string model, prompt, session_key;
         std::vector<std::string> endpoints;
         json_string_field(req.body, "model", &model);
         json_string_field(req.body, "prompt", &prompt);
+        json_string_field(req.body, "session_key", &session_key);
         if (!json_string_array(req.body, "endpoints", &endpoints))
             endpoints = static_endpoints;
         if (endpoints.empty()) {
             respond(fd, 400, "application/json",
                     "{\"error\": \"no endpoints\"}");
         } else {
-            PickResult r = picker->pick(model, prompt, endpoints);
+            PickResult r = picker->pick(model, prompt, endpoints,
+                                        session_key);
             std::string hdr = "x-gateway-destination-endpoint: " +
                               r.endpoint + "\r\n";
             if (req.path == "/pick") {
@@ -617,7 +671,8 @@ int main(int argc, char** argv) {
         } else {
             fprintf(stderr,
                     "usage: picker_server [--port N] "
-                    "[--picker roundrobin|prefix|kvaware] [--threshold N] "
+                    "[--picker roundrobin|prefix|kvaware|session] "
+                    "[--threshold N] "
                     "[--chunk-size N] [--lookup-timeout-ms N] [--trie-max-prompts N] "
                     "[--endpoints url1,url2]\n");
             return 2;

@@ -258,3 +258,44 @@ def test_kvaware_against_real_engine(binary):
         await runner.cleanup()
 
     asyncio.run(main())
+
+
+def test_session_picker_sticky_and_fallback(binary):
+    """session mode: same session_key -> same endpoint stably; no key ->
+    round-robin fallback (a 4th picker beyond the reference's three)."""
+    proc, port = start_picker(binary, "--picker", "session")
+    try:
+        eps = ["http://b:1", "http://a:1", "http://c:1"]
+        first = pick(port, "p", eps)
+        # stickiness across repeats and prompt changes
+        got = {json.loads(req("POST", port, "/pick",
+                              {"session_key": "user-42", "prompt": f"p{i}",
+                               "endpoints": eps})[2])["endpoint"]
+               for i in range(5)}
+        assert len(got) == 1
+        # different keys spread across the pool
+        spread = {json.loads(req("POST", port, "/pick",
+                                 {"session_key": f"user-{i}", "prompt": "p",
+                                  "endpoints": eps})[2])["endpoint"]
+                  for i in range(20)}
+        assert len(spread) > 1
+        # no session_key -> round-robin actually ADVANCES
+        a = pick(port, "p", eps)["endpoint"]
+        b = pick(port, "p", eps)["endpoint"]
+        assert a != b
+        # consistent-hash property: removing one endpoint keeps every
+        # session NOT on the removed pod where it was (minimal remap)
+        keys = [f"user-{i}" for i in range(30)]
+        def place(pool, key):
+            return json.loads(req("POST", port, "/pick",
+                                  {"session_key": key, "prompt": "p",
+                                   "endpoints": pool})[2])["endpoint"]
+        before = {k: place(eps, k) for k in keys}
+        removed = before[keys[0]]
+        smaller = [e for e in eps if e != removed]
+        moved = sum(1 for k in keys
+                    if before[k] != removed
+                    and place(smaller, k) != before[k])
+        assert moved == 0, f"{moved} unaffected sessions remapped"
+    finally:
+        proc.kill()
