@@ -434,28 +434,34 @@ class Picker {
         if (session_key.empty()) return pick_roundrobin(endpoints);
         // consistent-hash ring (64 virtual points per endpoint), the same
         // scheme as the router's SessionRouter: scaling the pool remaps
-        // only the keys adjacent to the added/removed node's points —
-        // plain modulo would reshuffle nearly every session on any scale
-        // event
-        const uint64_t kh = fnv64(session_key);
-        const std::string* best = nullptr;
-        uint64_t best_h = UINT64_MAX;
-        const std::string* first = nullptr;   // wraparound target
-        uint64_t first_h = UINT64_MAX;
+        // only the keys adjacent to the added/removed node's points. The
+        // ring is cached per endpoint set — rebuilding 64*N hashes per
+        // request would be pure hot-path waste while the pool is stable.
+        std::string pool_key;
         for (const auto& ep : endpoints) {
-            for (int v = 0; v < 64; ++v) {
-                uint64_t h = fnv64(ep + "#" + std::to_string(v));
-                if (h < first_h) {
-                    first_h = h;
-                    first = &ep;
-                }
-                if (h >= kh && h < best_h) {
-                    best_h = h;
-                    best = &ep;
-                }
-            }
+            pool_key += ep;
+            pool_key += '\n';
         }
-        return {best ? *best : *first, 0};
+        {
+            std::lock_guard<std::mutex> lock(ring_mu_);
+            if (pool_key != ring_pool_key_) {
+                ring_.clear();
+                for (const auto& ep : endpoints) {
+                    for (int v = 0; v < 64; ++v) {
+                        ring_.emplace_back(
+                            fnv64(ep + "#" + std::to_string(v)), ep);
+                    }
+                }
+                std::sort(ring_.begin(), ring_.end());
+                ring_pool_key_ = pool_key;
+            }
+            const uint64_t kh = fnv64(session_key);
+            auto it = std::lower_bound(
+                ring_.begin(), ring_.end(),
+                std::make_pair(kh, std::string()));
+            if (it == ring_.end()) it = ring_.begin();  // wraparound
+            return {it->second, 0};
+        }
     }
 
     PickResult pick_kvaware(const std::string& model,
@@ -513,6 +519,9 @@ class Picker {
     void* trie_;
     std::atomic<uint64_t> cursor_{0};
     std::atomic<uint64_t> inserts_{0};
+    std::mutex ring_mu_;
+    std::string ring_pool_key_;
+    std::vector<std::pair<uint64_t, std::string>> ring_;
     std::mutex mu_;
     std::map<std::string, uint64_t> picks_;
 };

@@ -313,3 +313,20 @@ def test_router_selector_follows_release_name():
     labels = eng["spec"]["template"]["metadata"]["labels"]
     assert labels["environment"] == "serving"
     assert labels["release"] == "ci-stack"
+
+
+def test_operator_webhook_renders():
+    objs = render_objects(HELM, {"operatorWebhook": {"enabled": True}})
+    wh = named(by_kind(objs, "Deployment"), "-webhook")[0]
+    c = wh["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"] == ["python", "-m",
+                            "production_stack_tpu.operator.webhook"]
+    assert "--tls-cert" in c["args"]  # never plaintext in-cluster
+    svc = named(by_kind(objs, "Service"), "-webhook")
+    assert svc and svc[0]["spec"]["ports"][0]["port"] == 9443
+    # the webhook CONFIG renders with the backend, names/namespace aligned
+    cfgs = by_kind(objs, "ValidatingWebhookConfiguration")
+    assert cfgs, "chart must render the webhook configuration"
+    client = cfgs[0]["webhooks"][0]["clientConfig"]["service"]
+    assert client["name"] == svc[0]["metadata"]["name"]
+    assert client["namespace"] == "default"
