@@ -25,6 +25,7 @@ north-star p50 TTFT target is 200 ms.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -55,8 +56,10 @@ def main() -> None:
     long_prompt_len = 4096 if on_tpu else 64
     long_n = 16 if on_tpu else 2
 
+    # PSTPU_BENCH_QUANT=int8 benchmarks the W8A8 path (engine/quant.py)
+    quant = os.environ.get("PSTPU_BENCH_QUANT") or None
     cfg = EngineConfig(
-        model=ModelConfig.from_pretrained(model),
+        model=ModelConfig.from_pretrained(model, quant=quant),
         cache=CacheConfig(block_size=16),
         # VMEM envelope (measured, see docs/roofline.md): the Pallas KV-write
         # stages prefill_batch x bucket token slabs in scoped VMEM — keep
@@ -149,7 +152,8 @@ def main() -> None:
 
     target = 2000.0
     print(json.dumps({
-        "metric": f"output throughput ({model}, bf16, {num_seqs} concurrent, "
+        "metric": f"output throughput ({model}, {quant or 'bf16'}, "
+                  f"{num_seqs} concurrent, "
                   f"{prompt_len}p/{out_len}o, 1 chip)",
         "value": round(tok_per_s, 1),
         "unit": "tok/s/chip",

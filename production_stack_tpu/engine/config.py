@@ -41,6 +41,10 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # Qwen2-family: biases on the QKV projections
     qkv_bias: bool = False
+    # weight/activation quantization: None (model dtype) or "int8"
+    # (W8A8 — per-channel weight + dynamic per-token activation scales on
+    # the MXU's native int8 path; engine/quant.py)
+    quant: Optional[str] = None
     # where to load weights from (safetensors dir); None → random init
     weights_path: Optional[str] = None
     tokenizer: Optional[str] = None  # HF tokenizer path; None → byte tokenizer

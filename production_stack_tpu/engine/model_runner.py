@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from production_stack_tpu.engine.config import EngineConfig, ModelConfig
 from production_stack_tpu.engine import kv_cache as kvmod
+from production_stack_tpu.engine.quant import maybe_quantize
 from production_stack_tpu.engine.sampling import sample_tokens
 from production_stack_tpu.engine.weights import init_or_load
 from production_stack_tpu.models.registry import get_model
@@ -69,10 +70,11 @@ class ModelRunner:
         self.rules = rules_for_model(self.cfg, mesh)
         self.model = get_model(self.cfg)
         with jax.set_mesh(mesh):
-            self.params = (
+            self.params = maybe_quantize(
+                self.cfg,
                 params
                 if params is not None
-                else init_or_load(self.cfg, mesh, self.rules, config.seed)
+                else init_or_load(self.cfg, mesh, self.rules, config.seed),
             )
         self.use_pallas = _pallas_ok(self.cfg, mesh, config.cache.block_size)
         self.num_blocks = self._resolve_num_blocks(num_blocks)
@@ -466,9 +468,9 @@ class ModelRunner:
     def restore_params(self) -> None:
         if self.params is None:
             with jax.set_mesh(self.mesh):
-                self.params = init_or_load(
+                self.params = maybe_quantize(self.cfg, init_or_load(
                     self.cfg, self.mesh, self.rules, self.config.seed
-                )
+                ))
 
     @property
     def params_alive(self) -> bool:

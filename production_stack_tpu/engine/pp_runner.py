@@ -29,6 +29,7 @@ from jax.sharding import Mesh
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.model_runner import ModelRunner, _make_lora
+from production_stack_tpu.engine.quant import embed_lookup, maybe_quantize
 from production_stack_tpu.models.registry import get_model
 from production_stack_tpu.parallel.mesh import AXIS_STAGE, MESH_AXES
 from production_stack_tpu.parallel.shardings import (
@@ -141,7 +142,9 @@ class StagedModelRunner:
                 p["embed"] = put(full["embed"], specs["embed"])
             else:
                 p["lm_head"] = put(full["lm_head"], specs["lm_head"])
-        return p
+        # full params stay in model dtype (raw arrays slice by layer range);
+        # each stage quantizes its own slice, so sleep/restore re-applies too
+        return maybe_quantize(self.stage_cfg, p)
 
     # -- compiled stage steps ----------------------------------------------
     def _compile_steps(self) -> None:
@@ -395,7 +398,7 @@ class StagedModelRunner:
                     return dense_causal_attention(q, k, v), caches
 
                 if first:
-                    x = params["embed"].astype(cfg.jax_dtype)[x]
+                    x = embed_lookup(params["embed"], x, cfg.jax_dtype)
                 hidden, _ = model.forward_hidden(
                     cfg, params, x, positions, attend, None
                 )
@@ -448,7 +451,7 @@ def _stage_prefill(cfg, attend_impl, first: bool, last: bool, params, kv,
         )
 
     if first:
-        x = params["embed"].astype(cfg.jax_dtype)[x]
+        x = embed_lookup(params["embed"], x, cfg.jax_dtype)
     hidden, kv = model.forward_hidden(
         cfg, params, x, positions, attend, kv,
         lora=_make_lora(lora_bank, adapter_ids, positions.shape[1]),
@@ -492,7 +495,7 @@ def _stage_decode(cfg, attend_impl, first: bool, last: bool, params, kv,
         )
 
     if first:
-        x = params["embed"].astype(cfg.jax_dtype)[x]
+        x = embed_lookup(params["embed"], x, cfg.jax_dtype)
     hidden, kv = model.forward_hidden(
         cfg, params, x, positions, attend, kv,
         lora=_make_lora(lora_bank, adapter_ids, 1),

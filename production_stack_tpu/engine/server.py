@@ -1155,6 +1155,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensor-parallel-size", type=int, default=-1)
     p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument("--dtype", default=None)
+    p.add_argument("--quantization", default=None, choices=["int8"],
+                   help="serve W8A8 int8 (per-channel weight + dynamic "
+                        "per-token activation scales on the MXU int8 path; "
+                        "halves weight HBM traffic — engine/quant.py)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--served-model-name", default=None)
     p.add_argument("--num-scheduler-steps", type=int, default=None,
@@ -1198,6 +1202,8 @@ def config_from_args(args) -> EngineConfig:
         overrides["max_model_len"] = args.max_model_len
     if args.dtype:
         overrides["dtype"] = args.dtype
+    if args.quantization:
+        overrides["quant"] = args.quantization
     cfg = EngineConfig.for_model(args.model, **overrides)
     if args.served_model_name:
         cfg.model = dataclasses.replace(cfg.model, name=args.served_model_name)

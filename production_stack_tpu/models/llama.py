@@ -27,6 +27,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.engine.quant import (
+    embed_lookup,
+    head_from_embed,
+    is_quantized,
+    quant_einsum,
+)
 from production_stack_tpu.ops.attention import dense_causal_attention
 from production_stack_tpu.ops.norms import rms_norm
 from production_stack_tpu.ops.rope import apply_rope
@@ -156,15 +162,15 @@ def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray, lb=None,
          onehot=None) -> jnp.ndarray:
     if cfg.architecture == "mixtral" and cfg.num_experts > 0:
         return _moe_mlp(cfg, lp, x)  # LoRA on MoE experts: not supported yet
-    gate = jnp.einsum("...te,ef->...tf", x, lp["w_gate"])
-    up = jnp.einsum("...te,ef->...tf", x, lp["w_up"])
+    gate = quant_einsum("...te,ef->...tf", x, lp["w_gate"])
+    up = quant_einsum("...te,ef->...tf", x, lp["w_up"])
     if lb is not None:
         if "w_gate" in lb:
             gate = gate + _lora_delta(x, onehot, *lb["w_gate"])
         if "w_up" in lb:
             up = up + _lora_delta(x, onehot, *lb["w_up"])
     hidden2 = jax.nn.silu(gate) * up
-    out = jnp.einsum("...tf,fe->...te", hidden2, lp["w_down"])
+    out = quant_einsum("...tf,fe->...te", hidden2, lp["w_down"])
     if lb is not None and "w_down" in lb:
         out = out + _lora_delta(hidden2, onehot, *lb["w_down"])
     return out
@@ -210,9 +216,9 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
     ).astype(xt.dtype)
 
     expert_in = jnp.einsum("txc,te->xce", disp, xt)  # (X, C, E)
-    gate = jnp.einsum("xce,xef->xcf", expert_in, lp["w_gate"])
-    up = jnp.einsum("xce,xef->xcf", expert_in, lp["w_up"])
-    expert_out = jnp.einsum(
+    gate = quant_einsum("xce,xef->xcf", expert_in, lp["w_gate"])
+    up = quant_einsum("xce,xef->xcf", expert_in, lp["w_up"])
+    expert_out = quant_einsum(
         "xcf,xfe->xce", jax.nn.silu(gate) * up, lp["w_down"]
     )
     out = jnp.einsum("txc,xce->te", comb, expert_out)
@@ -246,7 +252,7 @@ def forward_tokens(
     lora: Any = None,
 ) -> Tuple[jnp.ndarray, Any]:
     """Embed tokens then run the decoder stack (see forward_hidden)."""
-    x = params["embed"].astype(cfg.jax_dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     return forward_hidden(cfg, params, x, positions, attend, kv_caches, lora)
 
 
@@ -281,9 +287,9 @@ def forward_hidden(
         h, layer_idx, caches = carry
         lp, lb = scanned  # layer params, per-layer lora bank (or None)
         normed = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("...te,ehd->...thd", normed, lp["wq"])
-        k = jnp.einsum("...te,ehd->...thd", normed, lp["wk"])
-        v = jnp.einsum("...te,ehd->...thd", normed, lp["wv"])
+        q = quant_einsum("...te,ehd->...thd", normed, lp["wq"])
+        k = quant_einsum("...te,ehd->...thd", normed, lp["wk"])
+        v = quant_einsum("...te,ehd->...thd", normed, lp["wv"])
         if lb is not None:
             if "wq" in lb:
                 q = q + _lora_delta(normed, onehot, *lb["wq"])
@@ -298,7 +304,7 @@ def forward_hidden(
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn, caches = attend(q, k, v, caches, layer_idx)
-        o = jnp.einsum("...thd,hde->...te", attn, lp["wo"])
+        o = quant_einsum("...thd,hde->...te", attn, lp["wo"])
         if lb is not None and "wo" in lb:
             flat = attn.reshape(*attn.shape[:-2], -1)  # (..., T, H*D)
             o = o + _lora_delta(flat, onehot, *lb["wo"])
@@ -316,10 +322,11 @@ def forward_hidden(
 
 def logits_from_hidden(cfg: ModelConfig, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return jnp.einsum("...te,ev->...tv", hidden, head.astype(cfg.jax_dtype)).astype(
-        jnp.float32
-    )
+    head = (head_from_embed(params["embed"]) if cfg.tie_word_embeddings
+            else params["lm_head"])
+    if not is_quantized(head):
+        head = head.astype(cfg.jax_dtype)
+    return quant_einsum("...te,ev->...tv", hidden, head, jnp.float32)
 
 
 def forward_dense(
