@@ -22,7 +22,7 @@ from production_stack_tpu.parallel.mesh import MeshConfig
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     name: str = "tiny-llama"
-    architecture: str = "llama"  # "llama" | "mixtral"
+    architecture: str = "llama"  # "llama" | "mixtral" | "gemma" | "gemma2"
     vocab_size: int = 32000
     hidden_size: int = 2048
     intermediate_size: int = 5632
@@ -41,6 +41,19 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # Qwen2-family: biases on the QKV projections
     qkv_bias: bool = False
+    # Gemma family knobs (all default to the Llama behaviour)
+    act: str = "silu"  # MLP gate activation: "silu" | "gelu_tanh" (GeGLU)
+    norm_offset: float = 0.0  # RMSNorm scales by (offset + weight); Gemma: 1
+    embed_scale: bool = False  # multiply embeddings by sqrt(hidden_size)
+    attn_logit_softcap: float = 0.0  # cap*tanh(s/cap) on attention scores
+    final_logit_softcap: float = 0.0  # same on the LM-head logits
+    post_norms: bool = False  # Gemma-2 post-attention/post-MLP norms
+    query_scale: float = 0.0  # score scale; 0 → head_dim**-0.5
+    # local-attention window (Gemma-2 alternates local/global layers). We
+    # serve such models exactly ONLY within the window: max_model_len is
+    # required to be <= sliding_window (enforced at engine init), where
+    # local and global attention coincide.
+    sliding_window: int = 0
     # weight/activation quantization: None (model dtype) or "int8"
     # (W8A8 — per-channel weight + dynamic per-token activation scales on
     # the MXU's native int8 path; engine/quant.py)
@@ -67,11 +80,33 @@ class ModelConfig:
         archs = cfg.get("architectures") or []
         if any("Mixtral" in a for a in archs) or "num_local_experts" in cfg:
             arch = "mixtral"
+        elif any("Gemma2" in a for a in archs):
+            arch = "gemma2"
+        elif any(a.startswith("Gemma") and "Gemma2" not in a for a in archs):
+            # only Gemma 1 maps onto the gemma knobs; Gemma-3 adds QK-norm
+            # and per-layer rope/window layouts we don't implement — loading
+            # it as gemma-1 would silently drop tensors and serve garbage
+            if not all(a.startswith(("GemmaModel", "GemmaFor"))
+                       for a in archs if "Gemma" in a):
+                raise ValueError(
+                    f"unsupported Gemma variant {archs}; supported: "
+                    "GemmaForCausalLM (gemma), Gemma2ForCausalLM (gemma2)"
+                )
+            arch = "gemma"
         qkv_bias = any("Qwen2" in a for a in archs) or bool(
             cfg.get("attention_bias", False)
         )
         hidden = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]
+        gemma = arch in ("gemma", "gemma2")
+        hf_act = cfg.get("hidden_activation") or cfg.get("hidden_act") or "silu"
+        qpas = cfg.get("query_pre_attn_scalar", 0)
+        window = int(cfg.get("sliding_window") or 0) if arch == "gemma2" else 0
+        max_len = cfg.get("max_position_embeddings", 4096)
+        if window:
+            # exact-serving gate: local and global attention coincide only
+            # within the window (see ModelConfig.sliding_window)
+            max_len = min(max_len, window)
         return ModelConfig(
             qkv_bias=qkv_bias,
             name=name or cfg.get("_name_or_path", "hf-model"),
@@ -85,10 +120,20 @@ class ModelConfig:
             head_dim=cfg.get("head_dim", hidden // heads),
             rope_theta=cfg.get("rope_theta", 10000.0),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
-            max_model_len=cfg.get("max_position_embeddings", 4096),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            max_model_len=max_len,
+            tie_word_embeddings=cfg.get("tie_word_embeddings", gemma),
             num_experts=cfg.get("num_local_experts", 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            act="gelu_tanh" if "gelu" in hf_act else "silu",
+            norm_offset=1.0 if gemma else 0.0,
+            embed_scale=gemma,
+            attn_logit_softcap=float(
+                cfg.get("attn_logit_softcapping") or 0.0),
+            final_logit_softcap=float(
+                cfg.get("final_logit_softcapping") or 0.0),
+            post_norms=arch == "gemma2",
+            query_scale=(qpas ** -0.5) if qpas else 0.0,
+            sliding_window=window,
         )
 
     @staticmethod
@@ -148,6 +193,42 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         name="tiny-qwen2", vocab_size=512, hidden_size=128,
         intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
         head_dim=32, max_model_len=512, qkv_bias=True, dtype="float32",
+    ),
+    "tiny-gemma": ModelConfig(
+        name="tiny-gemma", architecture="gemma", vocab_size=512,
+        hidden_size=128, intermediate_size=256, num_layers=2, num_heads=4,
+        num_kv_heads=1, head_dim=48, max_model_len=512, dtype="float32",
+        tie_word_embeddings=True, act="gelu_tanh", norm_offset=1.0,
+        embed_scale=True,
+    ),
+    "tiny-gemma2": ModelConfig(
+        name="tiny-gemma2", architecture="gemma2", vocab_size=512,
+        hidden_size=128, intermediate_size=256, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=32, max_model_len=512, dtype="float32",
+        tie_word_embeddings=True, act="gelu_tanh", norm_offset=1.0,
+        embed_scale=True, post_norms=True, attn_logit_softcap=50.0,
+        final_logit_softcap=30.0, query_scale=64.0 ** -0.5,
+        sliding_window=512,  # query_pre_attn_scalar 64 ≠ head_dim 32
+    ),
+    "gemma-7b-class": ModelConfig(
+        # Gemma-7B geometry: GeGLU, (1+w) RMSNorm, sqrt(E)-scaled embeds,
+        # tied head, head_dim 256 ≠ E/H
+        name="gemma-7b-class", architecture="gemma", vocab_size=256000,
+        hidden_size=3072, intermediate_size=24576, num_layers=28,
+        num_heads=16, num_kv_heads=16, head_dim=256, max_model_len=8192,
+        tie_word_embeddings=True, act="gelu_tanh", norm_offset=1.0,
+        embed_scale=True, rms_norm_eps=1e-6,
+    ),
+    "gemma2-9b-class": ModelConfig(
+        # Gemma-2-9B geometry; served within the 4096 local-attention
+        # window where local/global layers coincide (exactness gate)
+        name="gemma2-9b-class", architecture="gemma2", vocab_size=256000,
+        hidden_size=3584, intermediate_size=14336, num_layers=42,
+        num_heads=16, num_kv_heads=8, head_dim=256, max_model_len=4096,
+        tie_word_embeddings=True, act="gelu_tanh", norm_offset=1.0,
+        embed_scale=True, post_norms=True, attn_logit_softcap=50.0,
+        final_logit_softcap=30.0, query_scale=256.0 ** -0.5,
+        sliding_window=4096, rms_norm_eps=1e-6,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b", architecture="mixtral", vocab_size=32000, hidden_size=4096,

@@ -67,6 +67,17 @@ class ModelRunner:
         self.config = config
         self.cfg = config.model
         self.mesh = mesh
+        if (self.cfg.sliding_window
+                and self.cfg.max_model_len > self.cfg.sliding_window):
+            # local/global attention layers coincide only within the window;
+            # beyond it the global-attention approximation would silently
+            # diverge from the model's semantics — refuse instead
+            raise ValueError(
+                f"{self.cfg.name}: max_model_len {self.cfg.max_model_len} "
+                f"exceeds the local-attention window "
+                f"{self.cfg.sliding_window}; serve with max_model_len <= "
+                "window (exactness gate, see ModelConfig.sliding_window)"
+            )
         self.rules = rules_for_model(self.cfg, mesh)
         self.model = get_model(self.cfg)
         with jax.set_mesh(mesh):
@@ -217,7 +228,8 @@ class ModelRunner:
                     q_positions):
         layer = jax.lax.dynamic_index_in_dim(caches, layer_idx, 0, keepdims=False)
         return paged_attention(
-            q, layer, block_tables, context_lens, q_positions, tp=self.tp
+            q, layer, block_tables, context_lens, q_positions, tp=self.tp,
+            soft_cap=self.cfg.attn_logit_softcap,
         )
 
     def _attend_prefill(self, q, k, v, caches, layer_idx, block_tables,
@@ -245,7 +257,10 @@ class ModelRunner:
 
         def inner(q4, nk, fused, bt, cl, sm, li, qstarts):
             fused = kv_cache_write_pallas(fused, nk, sm, li)
-            out = paged_prefill_attention_pallas(q4, fused, bt, qstarts, cl, li)
+            out = paged_prefill_attention_pallas(
+                q4, fused, bt, qstarts, cl, li,
+                soft_cap=self.cfg.attn_logit_softcap,
+            )
             return out, fused
 
         out, caches = self._sharded(inner, q_rank=4)(
@@ -273,7 +288,10 @@ class ModelRunner:
 
         def inner(q3, nk, fused, bt, cl, sm, li, _unused):
             fused = kv_cache_write_pallas(fused, nk, sm, li)
-            out = paged_decode_attention_pallas(q3, fused, bt, cl, li)
+            out = paged_decode_attention_pallas(
+                q3, fused, bt, cl, li,
+                soft_cap=self.cfg.attn_logit_softcap,
+            )
             return out, fused
 
         out, caches = self._sharded(inner, q_rank=3)(
@@ -493,7 +511,9 @@ class ModelRunner:
 
             def _embed(params, tokens, mask):
                 def attend(q, k, v, caches, layer_idx):
-                    return dense_causal_attention(q, k, v), caches
+                    return dense_causal_attention(
+                        q, k, v, soft_cap=cfg.attn_logit_softcap
+                    ), caches
 
                 S = tokens.shape[1]
                 positions = jnp.broadcast_to(
@@ -693,7 +713,8 @@ def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
 
     def attend(q, k, v, caches, layer_idx):
         out = ring_causal_attention(q, k, v, mesh, AXIS_SEQ,
-                                    head_axis=head_axis)
+                                    head_axis=head_axis,
+                                    soft_cap=cfg.attn_logit_softcap)
         caches = write_kv(caches, layer_idx, k[0], v[0], slot_mapping, tp)
         return out, caches
 

@@ -29,7 +29,7 @@ from jax.sharding import Mesh
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.model_runner import ModelRunner, _make_lora
-from production_stack_tpu.engine.quant import embed_lookup, maybe_quantize
+from production_stack_tpu.engine.quant import maybe_quantize
 from production_stack_tpu.models.registry import get_model
 from production_stack_tpu.parallel.mesh import AXIS_STAGE, MESH_AXES
 from production_stack_tpu.parallel.shardings import (
@@ -395,10 +395,12 @@ class StagedModelRunner:
 
             def stage_fwd(first, params, x, positions):
                 def attend(q, k, v, caches, layer_idx):
-                    return dense_causal_attention(q, k, v), caches
+                    return dense_causal_attention(
+                        q, k, v, soft_cap=cfg.attn_logit_softcap
+                    ), caches
 
                 if first:
-                    x = embed_lookup(params["embed"], x, cfg.jax_dtype)
+                    x = model.embed_tokens(cfg, params, x)
                 hidden, _ = model.forward_hidden(
                     cfg, params, x, positions, attend, None
                 )
@@ -451,7 +453,7 @@ def _stage_prefill(cfg, attend_impl, first: bool, last: bool, params, kv,
         )
 
     if first:
-        x = embed_lookup(params["embed"], x, cfg.jax_dtype)
+        x = model.embed_tokens(cfg, params, x)
     hidden, kv = model.forward_hidden(
         cfg, params, x, positions, attend, kv,
         lora=_make_lora(lora_bank, adapter_ids, positions.shape[1]),
@@ -495,7 +497,7 @@ def _stage_decode(cfg, attend_impl, first: bool, last: bool, params, kv,
         )
 
     if first:
-        x = embed_lookup(params["embed"], x, cfg.jax_dtype)
+        x = model.embed_tokens(cfg, params, x)
     hidden, kv = model.forward_hidden(
         cfg, params, x, positions, attend, kv,
         lora=_make_lora(lora_bank, adapter_ids, 1),

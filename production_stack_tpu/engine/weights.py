@@ -113,6 +113,17 @@ def _hf_key_map(cfg: ModelConfig, i: int) -> dict[str, tuple[str, str]]:
         f"model.layers.{i}.self_attn.o_proj.weight": ("wo", "proj_o"),
         f"model.layers.{i}.post_attention_layernorm.weight": ("mlp_norm", "copy"),
     }
+    if cfg.post_norms:
+        # Gemma-2 block: HF "post_attention_layernorm" is the norm on the
+        # ATTENTION OUTPUT (our post_attn_norm); the pre-MLP norm is
+        # "pre_feedforward_layernorm" and the MLP output norm
+        # "post_feedforward_layernorm"
+        m[f"model.layers.{i}.post_attention_layernorm.weight"] = (
+            "post_attn_norm", "copy")
+        m[f"model.layers.{i}.pre_feedforward_layernorm.weight"] = (
+            "mlp_norm", "copy")
+        m[f"model.layers.{i}.post_feedforward_layernorm.weight"] = (
+            "post_mlp_norm", "copy")
     if cfg.qkv_bias:  # Qwen2 family
         m[f"model.layers.{i}.self_attn.q_proj.bias"] = ("bq", "bias_q")
         m[f"model.layers.{i}.self_attn.k_proj.bias"] = ("bk", "bias_kv")

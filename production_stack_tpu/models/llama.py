@@ -20,6 +20,7 @@ reference assumes exists.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -63,6 +64,13 @@ def param_specs(cfg: ModelConfig) -> dict:
                 "bq": (L.LAYERS, L.HEADS, L.HEAD_DIM),
                 "bk": (L.LAYERS, L.KV_HEADS, L.HEAD_DIM),
                 "bv": (L.LAYERS, L.KV_HEADS, L.HEAD_DIM),
+            }
+        )
+    if cfg.post_norms:  # Gemma-2: norms on the attn/MLP outputs too
+        layer.update(
+            {
+                "post_attn_norm": (L.LAYERS, L.EMBED),
+                "post_mlp_norm": (L.LAYERS, L.EMBED),
             }
         )
     if cfg.architecture == "mixtral" and cfg.num_experts > 0:
@@ -110,13 +118,16 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     def normal(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)).astype(dt)
 
+    # stored norm weight giving an effective scale of 1 (Gemma stores
+    # zero-centred weights; forward adds cfg.norm_offset)
+    norm_one = 1.0 - cfg.norm_offset
     layers = {
-        "attn_norm": jnp.ones((Ln := LN, E), dt),
+        "attn_norm": jnp.full((Ln := LN, E), norm_one, dt),
         "wq": normal(keys[0], (Ln, E, H, D), E),
         "wk": normal(keys[1], (Ln, E, KH, D), E),
         "wv": normal(keys[2], (Ln, E, KH, D), E),
         "wo": normal(keys[3], (Ln, H, D, E), H * D),
-        "mlp_norm": jnp.ones((Ln, E), dt),
+        "mlp_norm": jnp.full((Ln, E), norm_one, dt),
     }
     if cfg.qkv_bias:
         layers.update(
@@ -124,6 +135,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
                 "bq": normal(keys[10], (Ln, H, D), E),
                 "bk": normal(keys[11], (Ln, KH, D), E),
                 "bv": normal(keys[12], (Ln, KH, D), E),
+            }
+        )
+    if cfg.post_norms:
+        # Gemma stores zero-centred norm weights (forward adds norm_offset)
+        layers.update(
+            {
+                "post_attn_norm": jnp.full((Ln, E), 1.0 - cfg.norm_offset, dt),
+                "post_mlp_norm": jnp.full((Ln, E), 1.0 - cfg.norm_offset, dt),
             }
         )
     if cfg.architecture == "mixtral" and cfg.num_experts > 0:
@@ -147,7 +166,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     params = {
         "embed": normal(keys[8], (V, E), E),
         "layers": layers,
-        "final_norm": jnp.ones((E,), dt),
+        "final_norm": jnp.full((E,), norm_one, dt),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = normal(keys[9], (E, V), E)
@@ -169,7 +188,10 @@ def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray, lb=None,
             gate = gate + _lora_delta(x, onehot, *lb["w_gate"])
         if "w_up" in lb:
             up = up + _lora_delta(x, onehot, *lb["w_up"])
-    hidden2 = jax.nn.silu(gate) * up
+    # Gemma is GeGLU (tanh-approx gelu on the gate); Llama/Qwen are SwiGLU
+    act = (jax.nn.silu if cfg.act == "silu"
+           else functools.partial(jax.nn.gelu, approximate=True))
+    hidden2 = act(gate) * up
     out = quant_einsum("...tf,fe->...te", hidden2, lp["w_down"])
     if lb is not None and "w_down" in lb:
         out = out + _lora_delta(hidden2, onehot, *lb["w_down"])
@@ -252,8 +274,17 @@ def forward_tokens(
     lora: Any = None,
 ) -> Tuple[jnp.ndarray, Any]:
     """Embed tokens then run the decoder stack (see forward_hidden)."""
-    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
+    x = embed_tokens(cfg, params, tokens)
     return forward_hidden(cfg, params, x, positions, attend, kv_caches, lora)
+
+
+def embed_tokens(cfg: ModelConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding incl. the Gemma sqrt(E) scale — the ONE site for the
+    normalizer semantics (pipeline stages must embed identically)."""
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.jax_dtype)
+    return x
 
 
 def forward_hidden(
@@ -286,7 +317,8 @@ def forward_hidden(
     def layer_fn(carry, scanned):
         h, layer_idx, caches = carry
         lp, lb = scanned  # layer params, per-layer lora bank (or None)
-        normed = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        normed = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps,
+                          cfg.norm_offset)
         q = quant_einsum("...te,ehd->...thd", normed, lp["wq"])
         k = quant_einsum("...te,ehd->...thd", normed, lp["wk"])
         v = quant_einsum("...te,ehd->...thd", normed, lp["wv"])
@@ -301,6 +333,12 @@ def forward_hidden(
             q = q + lp["bq"]
             k = k + lp["bk"]
             v = v + lp["bv"]
+        if cfg.query_scale:
+            # fold a non-default score scale (Gemma-2 query_pre_attn_scalar)
+            # into q: attention impls keep their head_dim**-0.5
+            q = q * jnp.asarray(
+                cfg.query_scale * cfg.head_dim ** 0.5, q.dtype
+            )
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn, caches = attend(q, k, v, caches, layer_idx)
@@ -308,9 +346,17 @@ def forward_hidden(
         if lb is not None and "wo" in lb:
             flat = attn.reshape(*attn.shape[:-2], -1)  # (..., T, H*D)
             o = o + _lora_delta(flat, onehot, *lb["wo"])
+        if cfg.post_norms:
+            o = rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps,
+                         cfg.norm_offset)
         h = h + o
-        normed2 = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(cfg, lp, normed2, lb=lb, onehot=onehot)
+        normed2 = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps,
+                           cfg.norm_offset)
+        mlp_out = _mlp(cfg, lp, normed2, lb=lb, onehot=onehot)
+        if cfg.post_norms:
+            mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"],
+                               cfg.rms_norm_eps, cfg.norm_offset)
+        h = h + mlp_out
         return (h, layer_idx + 1, caches), None
 
     bank = None if lora is None else lora["bank"]
@@ -321,12 +367,17 @@ def forward_hidden(
 
 
 def logits_from_hidden(cfg: ModelConfig, params: dict, hidden: jnp.ndarray) -> jnp.ndarray:
-    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps,
+                      cfg.norm_offset)
     head = (head_from_embed(params["embed"]) if cfg.tie_word_embeddings
             else params["lm_head"])
     if not is_quantized(head):
         head = head.astype(cfg.jax_dtype)
-    return quant_einsum("...te,ev->...tv", hidden, head, jnp.float32)
+    logits = quant_einsum("...te,ev->...tv", hidden, head, jnp.float32)
+    if cfg.final_logit_softcap:  # Gemma-2
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def forward_dense(
@@ -341,7 +392,9 @@ def forward_dense(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
     def attend(q, k, v, caches, layer_idx):
-        return dense_causal_attention(q, k, v), caches
+        return dense_causal_attention(
+            q, k, v, soft_cap=cfg.attn_logit_softcap
+        ), caches
 
     hidden, _ = forward_tokens(cfg, params, tokens, positions, attend, None)
     return logits_from_hidden(cfg, params, hidden)

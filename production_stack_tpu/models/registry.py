@@ -17,6 +17,10 @@ from production_stack_tpu.models import llama
 _REGISTRY: dict[str, ModuleType] = {
     "llama": llama,
     "mixtral": llama,  # shared stack; MoE block chosen via cfg.architecture
+    # Gemma runs the shared stack too: GeGLU / (1+w) norms / embed scale /
+    # softcaps / post-norms are ModelConfig knobs inside the layer code
+    "gemma": llama,
+    "gemma2": llama,
 }
 
 

@@ -24,10 +24,13 @@ def dense_causal_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     scale: float | None = None,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Causal multi-head attention with grouped KV (GQA).
 
     q: (B, S, H, D); k, v: (B, S, KH, D) with H = KH * G. Returns (B, S, H, D).
+    ``soft_cap`` > 0 applies Gemma-2-style score capping cap*tanh(s/cap)
+    before masking.
     """
     B, S, H, D = q.shape
     KH = k.shape[2]
@@ -38,6 +41,8 @@ def dense_causal_attention(
     scores = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
+    if soft_cap:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
     causal = jnp.tril(jnp.ones((S, S), dtype=bool))
     scores = jnp.where(causal[None, None, None], scores, NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
@@ -55,6 +60,7 @@ def segment_causal_attention(
     q_segments: jnp.ndarray,
     kv_segments: jnp.ndarray,
     scale: float | None = None,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Ragged attention over flattened token streams.
 
@@ -71,6 +77,8 @@ def segment_causal_attention(
     scores = jnp.einsum(
         "qkgd,skd->kgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
+    if soft_cap:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
     valid = (
         (q_segments[:, None] == kv_segments[None, :])
         & (kv_positions[None, :] <= q_positions[:, None])

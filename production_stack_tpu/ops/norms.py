@@ -11,13 +11,20 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
-    """RMSNorm in float32 accumulation, cast back to input dtype (Llama-style)."""
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6,
+    offset: float = 0.0,
+) -> jnp.ndarray:
+    """RMSNorm in float32 accumulation, cast back to input dtype.
+
+    ``offset`` supports the Gemma convention of scaling by (1 + weight)
+    with a zero-centred stored weight (offset=1); Llama-style is offset=0.
+    """
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    return (normed * (weight.astype(jnp.float32) + offset)).astype(dtype)
 
 
 def layer_norm(

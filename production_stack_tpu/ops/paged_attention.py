@@ -80,6 +80,7 @@ def paged_attention(
     q_positions: jnp.ndarray,
     tp: int = 1,
     scale: float | None = None,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     B, S, H, D = q.shape
     n, block_size, KH2, _ = kv_layer.shape
@@ -102,6 +103,8 @@ def paged_attention(
     scores = jnp.einsum(
         "bskgd,btkd->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
+    if soft_cap:  # Gemma-2 score capping, before masking (HF order)
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
     scores = jnp.where(mask[:, None, None], scores, NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     denom = probs.sum(axis=-1, keepdims=True)

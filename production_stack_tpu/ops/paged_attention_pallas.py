@@ -60,6 +60,7 @@ def _decode_kernel(
     windows: int,
     seqs_per_cell: int,
     scale: float,
+    soft_cap: float = 0.0,
 ):
     """Batched paged decode attention.
 
@@ -145,6 +146,8 @@ def _decode_kernel(
                     )
                 )  # (G, T)
             sc = jnp.stack(s_heads) * scale  # (KH, G, T)
+            if soft_cap:  # Gemma-2 score capping, before masking
+                sc = soft_cap * jnp.tanh(sc / soft_cap)
             sc = jnp.where(kvpos < ctx, sc, NEG_INF)
 
             m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
@@ -205,6 +208,7 @@ def paged_decode_attention_pallas(
     layer_idx: jnp.ndarray | int = 0,
     windows: int = 8,
     interpret: bool = False,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     B, H, D = q.shape
     L, N, bs, KH2, _ = kv_cache.shape
@@ -233,7 +237,7 @@ def paged_decode_attention_pallas(
     )
     kernel = functools.partial(
         _decode_kernel, block_size=bs, windows=windows, seqs_per_cell=spb,
-        scale=D**-0.5,
+        scale=D**-0.5, soft_cap=soft_cap,
     )
     out = pl.pallas_call(
         kernel,
@@ -268,6 +272,7 @@ def _prefill_kernel(
     q_tile: int,
     group: int,
     scale: float,
+    soft_cap: float = 0.0,
 ):
     p = pl.program_id(0)
     t = pl.program_id(1)
@@ -326,6 +331,8 @@ def _prefill_kernel(
                 )
             )  # (R, T)
         s = jnp.stack(s_heads) * scale  # (KH, R, T)
+        if soft_cap:  # Gemma-2 score capping, before masking
+            s = soft_cap * jnp.tanh(s / soft_cap)
         kvpos = w * win_tokens + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, win_tokens), 2
         )
@@ -368,6 +375,7 @@ def paged_prefill_attention_pallas(
     q_tile: int = 128,
     windows: int = 8,
     interpret: bool = False,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     P, S, H, D = q.shape
     L, N, bs, KH2, _ = kv_cache.shape
@@ -399,7 +407,7 @@ def paged_prefill_attention_pallas(
     )
     kernel = functools.partial(
         _prefill_kernel, block_size=bs, windows=windows, q_tile=TQ,
-        group=G, scale=D**-0.5,
+        group=G, scale=D**-0.5, soft_cap=soft_cap,
     )
     out = pl.pallas_call(
         kernel,

@@ -31,6 +31,7 @@ def _ring_attention_local(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = True,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Per-shard body (runs under shard_map)."""
     B, Sl, H, D = q.shape
@@ -51,6 +52,8 @@ def _ring_attention_local(
         s = jnp.einsum(
             "bqkgd,bskd->bkgqs", qg, k_cur.astype(jnp.float32)
         ) * scale  # (B, KH, G, Sl, Sl)
+        if soft_cap:  # Gemma-2 score capping, before masking
+            s = soft_cap * jnp.tanh(s / soft_cap)
         if causal:
             mask = kv_pos[None, :] <= q_pos[:, None]  # (Sl, Sl)
             s = jnp.where(mask[None, None, None], s, NEG_INF)
@@ -85,6 +88,7 @@ def ring_causal_attention(
     mesh: Mesh,
     axis_name: str = "seq",
     head_axis: str | None = None,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """jit-level wrapper: shards the sequence dim over ``axis_name`` and runs
     the ring. S must divide the axis size. ``head_axis`` additionally shards
@@ -92,7 +96,8 @@ def ring_causal_attention(
     ring only ever talks over ``axis_name``)."""
     spec = P(None, axis_name, head_axis, None)
     fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name),
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          soft_cap=soft_cap),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
