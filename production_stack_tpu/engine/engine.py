@@ -89,6 +89,11 @@ class LLMEngine:
         self._presence = np.zeros(B, np.float32)
         self._frequency = np.zeros(B, np.float32)
         self._adapter_ids = np.zeros(B, np.int32)
+        from production_stack_tpu.engine.sampling import MAX_TOKEN_CONTROLS
+
+        self._ctrl_ids = np.full((B, MAX_TOKEN_CONTROLS), -1, np.int32)
+        self._ctrl_vals = np.zeros((B, MAX_TOKEN_CONTROLS), np.float32)
+        self._ctrl_mode = np.zeros(B, np.int32)
         self._count_reset_slots: list[Sequence] = []
         self._slot_seq: dict[int, Sequence] = {}
         # deferred prefill resolution: (prefills, device sampled array).
@@ -141,8 +146,12 @@ class LLMEngine:
                 sampling,
                 seed=int.from_bytes(os.urandom(4), "little"),
             )
+        from production_stack_tpu.engine.sampling import make_token_controls
+
         seq = Sequence(request_id, list(prompt_token_ids), sampling,
-                       adapter_slot=adapter_slot)
+                       adapter_slot=adapter_slot,
+                       token_ctrl=make_token_controls(
+                           sampling, self.config.model.vocab_size))
         self.scheduler.add(seq)
         self.total_prompt_tokens += len(prompt_token_ids)
         return seq
@@ -282,6 +291,11 @@ class LLMEngine:
             greedy_only=s.temperature <= 0.0,
             adapter_ids=(np.asarray([seq.adapter_slot], np.int32)
                          if seq.adapter_slot else None),
+            ctrl=(
+                (seq.token_ctrl[0][None, :], seq.token_ctrl[1][None, :],
+                 np.asarray([seq.token_ctrl[2]], np.int32))
+                if seq.token_ctrl is not None else None
+            ),
         )
         seq.num_computed_tokens = n
         seq.status = SequenceStatus.RUNNING
@@ -347,10 +361,24 @@ class LLMEngine:
 
         greedy_only = all(sp.seq.sampling.temperature <= 0.0 for sp in prefills)
         use_lora = any(sp.seq.adapter_slot for sp in prefills)
+        ctrl = None
+        if any(sp.seq.token_ctrl is not None for sp in prefills):
+            from production_stack_tpu.engine.sampling import (
+                MAX_TOKEN_CONTROLS,
+            )
+
+            c_ids = np.full((P, MAX_TOKEN_CONTROLS), -1, np.int32)
+            c_vals = np.zeros((P, MAX_TOKEN_CONTROLS), np.float32)
+            c_mode = np.zeros(P, np.int32)
+            for i, sp in enumerate(prefills):
+                if sp.seq.token_ctrl is not None:
+                    c_ids[i], c_vals[i], c_mode[i] = sp.seq.token_ctrl
+            ctrl = (c_ids, c_vals, c_mode)
         sampled_dev = self.runner.prefill(
             tokens, positions, tables, context_lens, slot_mapping.reshape(-1),
             last_idx, temps, top_ps, top_ks, seeds, greedy_only=greedy_only,
             adapter_ids=adapter_ids if use_lora else None,
+            ctrl=ctrl,
             fetch=False,
         )
 
@@ -438,6 +466,13 @@ class LLMEngine:
             self._presence[i] = s.presence_penalty
             self._frequency[i] = s.frequency_penalty
             self._adapter_ids[i] = seq.adapter_slot
+            if seq.token_ctrl is not None:
+                (self._ctrl_ids[i], self._ctrl_vals[i],
+                 self._ctrl_mode[i]) = seq.token_ctrl
+            else:
+                self._ctrl_ids[i] = -1
+                self._ctrl_vals[i] = 0.0
+                self._ctrl_mode[i] = 0
 
         # multi_step fused decode+sample iterations in one dispatch; sampled
         # tokens come back (K, B) and are appended until a stop fires
@@ -452,6 +487,7 @@ class LLMEngine:
                 if seq.slot >= 0:
                     self.runner.set_count_row(seq.slot, seq.output_token_ids)
             self._count_reset_slots.clear()
+        use_controls = any(s.token_ctrl is not None for s in decodes)
         result = self.runner.decode_multi(
             self._tokens, self._positions, self._block_tables,
             self._context_lens, self._slot_mapping,
@@ -460,6 +496,8 @@ class LLMEngine:
             presence=self._presence if use_penalties else None,
             frequency=self._frequency if use_penalties else None,
             adapter_ids=self._adapter_ids if use_lora else None,
+            ctrl=((self._ctrl_ids, self._ctrl_vals, self._ctrl_mode)
+                  if use_controls else None),
             tokens_dev=(pending["next_tok"] if chain else None),
             fetch=not can_chain,
         )

@@ -100,7 +100,7 @@ class ModelRunner:
         self._prefill = jax.jit(
             functools.partial(_prefill_step, self.cfg, self._attend_prefill),
             donate_argnums=(1,),
-            static_argnames=("greedy_only",),
+            static_argnames=("greedy_only", "use_controls"),
         )
         self._decode = jax.jit(
             functools.partial(_decode_step, self.cfg, self._attend_decode),
@@ -112,7 +112,8 @@ class ModelRunner:
                 max(config.scheduler.multi_step, 1),
             ),
             donate_argnums=(1,),
-            static_argnames=("block_size", "greedy_only", "use_penalties"),
+            static_argnames=("block_size", "greedy_only", "use_penalties",
+                             "use_controls"),
         )
         self._sample = jax.jit(sample_tokens)
         from production_stack_tpu.parallel.mesh import AXIS_SEQ
@@ -130,7 +131,7 @@ class ModelRunner:
                     _prefill_ring_step, self.cfg, mesh, head_axis, self.tp
                 ),
                 donate_argnums=(1,),
-                static_argnames=("greedy_only",),
+                static_argnames=("greedy_only", "use_controls"),
             )
         # per-slot output-token counts for presence/frequency penalties
         # ((B, V) int32; allocated on first penalised batch)
@@ -307,6 +308,7 @@ class ModelRunner:
                 temps: np.ndarray, top_ps: np.ndarray, top_ks: np.ndarray,
                 seeds: np.ndarray, greedy_only: bool = True,
                 adapter_ids: Optional[np.ndarray] = None,
+                ctrl: Optional[tuple] = None,
                 fetch: bool = True):
         """A batch of prefill chunks (shapes padded: tokens (P, S), tables
         (P, M), slot_mapping (P*S,)). Each chunk's next token is sampled in
@@ -327,7 +329,10 @@ class ModelRunner:
                 lora_bank=self.lora_bank if use_lora else None,
                 adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
                              if use_lora else None),
+                ctrl=(tuple(jnp.asarray(c) for c in ctrl)
+                      if ctrl is not None else None),
                 greedy_only=greedy_only,
+                use_controls=ctrl is not None,
             )
         if not fetch:
             return sampled
@@ -338,7 +343,8 @@ class ModelRunner:
                      temps: np.ndarray, top_ps: np.ndarray,
                      top_ks: np.ndarray, seeds: np.ndarray,
                      greedy_only: bool = True,
-                     adapter_ids: Optional[np.ndarray] = None) -> np.ndarray:
+                     adapter_ids: Optional[np.ndarray] = None,
+                     ctrl: Optional[tuple] = None) -> np.ndarray:
         """Whole-prompt prefill sharded over the seq axis (ring attention).
 
         tokens/positions: (1, S) with S a multiple of the seq-axis size;
@@ -356,7 +362,10 @@ class ModelRunner:
                 lora_bank=self.lora_bank if use_lora else None,
                 adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
                              if use_lora else None),
+                ctrl=(tuple(jnp.asarray(c) for c in ctrl)
+                      if ctrl is not None else None),
                 greedy_only=greedy_only,
+                use_controls=ctrl is not None,
             )
         return np.asarray(jax.device_get(sampled))
 
@@ -408,7 +417,8 @@ class ModelRunner:
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
                      greedy_only: bool = False,
                      presence=None, frequency=None,
-                     adapter_ids=None, tokens_dev=None, fetch: bool = True):
+                     adapter_ids=None, ctrl=None, tokens_dev=None,
+                     fetch: bool = True):
         """multi_step fused decode+sample iterations; returns sampled tokens
         (num_steps, B) on host — or the un-fetched device array with
         ``fetch=False`` so the next dispatch overlaps this one's compute
@@ -434,6 +444,8 @@ class ModelRunner:
             frequency = None if frequency is None else np.array(frequency)
             adapter_ids = (None if adapter_ids is None
                            else np.array(adapter_ids))
+            ctrl = (None if ctrl is None
+                    else tuple(np.array(c) for c in ctrl))
         if use_penalties:
             self._ensure_counts()
             counts = self.token_counts
@@ -459,9 +471,12 @@ class ModelRunner:
                 counts, pres, freq,
                 self.lora_bank if use_lora else None,
                 (jnp.asarray(adapter_ids, jnp.int32) if use_lora else None),
+                ctrl=(tuple(jnp.asarray(c) for c in ctrl)
+                      if ctrl is not None else None),
                 block_size=self.config.cache.block_size,
                 greedy_only=greedy_only,
                 use_penalties=use_penalties,
+                use_controls=ctrl is not None,
             )
         if use_penalties:
             self.token_counts = new_counts
@@ -656,7 +671,8 @@ def _make_lora(lora_bank, adapter_ids, T: int):
 def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
                   block_tables, context_lens, slot_mapping, last_idx,
                   temps, top_ps, top_ks, seeds, lora_bank=None,
-                  adapter_ids=None, greedy_only: bool = False):
+                  adapter_ids=None, ctrl=None, greedy_only: bool = False,
+                  use_controls: bool = False):
     """Batched prefill chunks + fused first-token sampling.
 
     tokens/positions: (P, S); block_tables (P, M); context_lens (P,) with 0
@@ -681,6 +697,10 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
         hidden, last_idx[:, None, None], axis=1
     )[:, 0]  # (P, E)
     logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    if use_controls:
+        from production_stack_tpu.engine.sampling import apply_token_controls
+
+        logits = apply_token_controls(logits, *ctrl)
     if greedy_only:
         sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
@@ -694,8 +714,9 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
 def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
                        tokens, positions, slot_mapping, last_idx,
                        temps, top_ps, top_ks, seeds,
-                       lora_bank=None, adapter_ids=None,
-                       greedy_only: bool = False):
+                       lora_bank=None, adapter_ids=None, ctrl=None,
+                       greedy_only: bool = False,
+                       use_controls: bool = False):
     """Whole-prompt ring-attention prefill + fused next-token sampling.
 
     The prompt's activations are sequence-sharded end to end (GSPMD
@@ -726,6 +747,10 @@ def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
         hidden, last_idx[:, None, None], axis=1
     )[:, 0]  # (1, E)
     logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    if use_controls:
+        from production_stack_tpu.engine.sampling import apply_token_controls
+
+        logits = apply_token_controls(logits, *ctrl)
     if greedy_only:
         sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
@@ -758,9 +783,10 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
                        tokens, positions, block_tables, context_lens,
                        slot_mapping, temps, top_ps, top_ks, seeds, steps,
                        token_counts, presence, frequency,
-                       lora_bank=None, adapter_ids=None, *,
+                       lora_bank=None, adapter_ids=None, ctrl=None, *,
                        block_size: int, greedy_only: bool = False,
-                       use_penalties: bool = False):
+                       use_penalties: bool = False,
+                       use_controls: bool = False):
     """``num_steps`` fused decode+sample iterations in ONE dispatch.
 
     The token sampled at iteration i feeds iteration i+1 entirely on device;
@@ -791,6 +817,12 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             from production_stack_tpu.engine.sampling import penalize_logits
 
             logits = penalize_logits(logits, counts, presence, frequency)
+        if use_controls:
+            from production_stack_tpu.engine.sampling import (
+                apply_token_controls,
+            )
+
+            logits = apply_token_controls(logits, *ctrl)
         if greedy_only:
             sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:

@@ -159,20 +159,21 @@ class StagedModelRunner:
                     _stage_prefill, cfg, runner._attend_prefill, first, last
                 ),
                 donate_argnums=(1,),
-                static_argnames=("greedy_only",),
+                static_argnames=("greedy_only", "use_controls"),
             ))
             self._decode_steps.append(jax.jit(
                 functools.partial(
                     _stage_decode, cfg, runner._attend_decode, first, last
                 ),
                 donate_argnums=(1,),
-                static_argnames=("greedy_only", "use_penalties"),
+                static_argnames=("greedy_only", "use_penalties",
+                                 "use_controls"),
             ))
 
     # -- public step API (ModelRunner-compatible) --------------------------
     def prefill(self, tokens, positions, block_tables, context_lens,
                 slot_mapping, last_idx, temps, top_ps, top_ks, seeds,
-                greedy_only: bool = True, adapter_ids=None,
+                greedy_only: bool = True, adapter_ids=None, ctrl=None,
                 fetch: bool = True):
         x = jnp.asarray(tokens)  # stage 0 consumes token ids
         common = (
@@ -194,7 +195,10 @@ class StagedModelRunner:
                     lora_bank=runner.lora_bank if use_lora else None,
                     adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
                                  if use_lora else None),
+                    ctrl=(tuple(jnp.asarray(c) for c in ctrl)
+                          if ctrl is not None else None),
                     greedy_only=greedy_only,
+                    use_controls=ctrl is not None,
                 )
         if not fetch:
             return x  # last stage's sampled tokens, un-fetched
@@ -206,7 +210,7 @@ class StagedModelRunner:
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
                      greedy_only: bool = False,
                      presence=None, frequency=None,
-                     adapter_ids=None, tokens_dev=None,
+                     adapter_ids=None, ctrl=None, tokens_dev=None,
                      fetch: bool = True) -> np.ndarray:
         """K single decode steps, each relayed through the stages. The host
         advances positions/slots between steps (the sampled token must come
@@ -260,6 +264,9 @@ class StagedModelRunner:
                                          if use_lora else None),
                             greedy_only=greedy_only,
                             use_penalties=use_penalties,
+                            ctrl=(tuple(jnp.asarray(c) for c in ctrl)
+                                  if ctrl is not None else None),
+                            use_controls=ctrl is not None,
                             **extra,
                         )
                         if use_penalties:
@@ -435,8 +442,8 @@ class StagedModelRunner:
 def _stage_prefill(cfg, attend_impl, first: bool, last: bool, params, kv,
                    x, positions, block_tables, context_lens, slot_mapping,
                    last_idx, temps, top_ps, top_ks, seeds,
-                   lora_bank=None, adapter_ids=None,
-                   greedy_only: bool = False):
+                   lora_bank=None, adapter_ids=None, ctrl=None,
+                   greedy_only: bool = False, use_controls: bool = False):
     """One stage of a batched prefill chunk.
 
     Stage 0 receives token ids (P, S) and embeds; later stages receive
@@ -464,6 +471,10 @@ def _stage_prefill(cfg, attend_impl, first: bool, last: bool, params, kv,
         hidden, last_idx[:, None, None], axis=1
     )[:, 0]
     logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    if use_controls:
+        from production_stack_tpu.engine.sampling import apply_token_controls
+
+        logits = apply_token_controls(logits, *ctrl)
     if greedy_only:
         sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
@@ -478,7 +489,9 @@ def _stage_decode(cfg, attend_impl, first: bool, last: bool, params, kv,
                   lora_bank=None, adapter_ids=None,
                   temps=None, top_ps=None, top_ks=None, seeds=None,
                   steps=None, counts=None, presence=None, frequency=None,
-                  greedy_only: bool = False, use_penalties: bool = False):
+                  ctrl=None,
+                  greedy_only: bool = False, use_penalties: bool = False,
+                  use_controls: bool = False):
     """One stage of a single fused decode step (B, 1).
 
     Last stage samples (with optional presence/frequency penalties, counts
@@ -507,6 +520,10 @@ def _stage_decode(cfg, attend_impl, first: bool, last: bool, params, kv,
     logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]
     if use_penalties:
         logits = penalize_logits(logits, counts, presence, frequency)
+    if use_controls:
+        from production_stack_tpu.engine.sampling import apply_token_controls
+
+        logits = apply_token_controls(logits, *ctrl)
     if greedy_only:
         sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:

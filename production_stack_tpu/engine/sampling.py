@@ -34,10 +34,77 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     logprobs: Optional[int] = None
+    # structured-decoding primitives (OpenAI logit_bias / vLLM
+    # allowed_token_ids): token id → additive bias, and an optional
+    # whitelist restricting sampling to the listed ids
+    logit_bias: Optional[dict] = None
+    allowed_token_ids: Sequence[int] = ()
 
     def clamped(self, max_model_len: int, prompt_len: int) -> "SamplingParams":
         limit = max(max_model_len - prompt_len, 1)
         return dataclasses.replace(self, max_tokens=min(self.max_tokens, limit))
+
+
+# -- token controls (logit_bias / allowed_token_ids) -------------------------
+# Device-side representation: per-slot (K,) sparse id/value rows + a mode
+# flag (0 = none, 1 = bias, 2 = whitelist+bias). Static shapes: the fused
+# multi-step decode loop applies them every iteration with no recompile;
+# the compiled variant only exists when a batch actually carries controls
+# (the ``use_controls`` static flag mirrors ``use_penalties``).
+
+MAX_TOKEN_CONTROLS = 64  # ids per request; above this the server 400s
+
+CTRL_NONE, CTRL_BIAS, CTRL_ALLOW = 0, 1, 2
+
+
+def make_token_controls(s: "SamplingParams", vocab_size: int):
+    """Host-side: compact a request's controls to (ids, vals, mode) numpy
+    rows, or None. Raises ValueError on overflow/out-of-range ids."""
+    import numpy as np
+
+    bias = {int(k): float(v) for k, v in (s.logit_bias or {}).items()}
+    if s.allowed_token_ids:
+        ids = list(dict.fromkeys(int(t) for t in s.allowed_token_ids))
+        mode = CTRL_ALLOW
+    elif bias:
+        ids = list(bias)
+        mode = CTRL_BIAS
+    else:
+        return None
+    if len(ids) > MAX_TOKEN_CONTROLS:
+        raise ValueError(
+            f"too many token controls ({len(ids)} > {MAX_TOKEN_CONTROLS})"
+        )
+    # bias keys validate even under a whitelist (a bias on a non-whitelisted
+    # id is a no-op, but an out-of-range one is a client bug → 400)
+    for t in list(ids) + list(bias):
+        if not 0 <= t < vocab_size:
+            raise ValueError(f"token id {t} out of range [0, {vocab_size})")
+    out_ids = np.full(MAX_TOKEN_CONTROLS, -1, np.int32)
+    out_vals = np.zeros(MAX_TOKEN_CONTROLS, np.float32)
+    out_ids[: len(ids)] = ids
+    out_vals[: len(ids)] = [bias.get(t, 0.0) for t in ids]
+    return out_ids, out_vals, mode
+
+
+def apply_token_controls(
+    logits: jnp.ndarray,  # (B, V) float32
+    ctrl_ids: jnp.ndarray,  # (B, K) int32, -1 padding
+    ctrl_vals: jnp.ndarray,  # (B, K) float32
+    ctrl_mode: jnp.ndarray,  # (B,) int32
+) -> jnp.ndarray:
+    """Additive bias scatter + whitelist mask, batched over slots."""
+    B, V = logits.shape
+    valid = ctrl_ids >= 0
+    ids = jnp.clip(ctrl_ids, 0, V - 1)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    biased = logits.at[rows, ids].add(jnp.where(valid, ctrl_vals, 0.0))
+    allowed = (
+        jnp.zeros((B, V), jnp.bool_).at[rows, ids].max(valid)
+    )
+    return jnp.where(
+        (ctrl_mode == CTRL_ALLOW)[:, None] & ~allowed, NEG_INF, biased
+    )
 
 
 MAX_CONSIDERED = 128  # top-k/top-p truncation window (full-vocab sort on a

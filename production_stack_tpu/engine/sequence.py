@@ -36,6 +36,8 @@ class Sequence:
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
     adapter_slot: int = 0  # multi-LoRA bank slot; 0 = base model
+    # compacted token controls (sampling.make_token_controls): or None
+    token_ctrl: Optional[tuple] = None
 
     output_token_ids: list[int] = dataclasses.field(default_factory=list)
     status: SequenceStatus = SequenceStatus.WAITING

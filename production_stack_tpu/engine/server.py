@@ -30,7 +30,10 @@ from production_stack_tpu.engine.async_engine import AsyncEngine
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.metrics import ServerMetrics
-from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.sampling import (
+    SamplingParams,
+    make_token_controls,
+)
 
 
 def _sampling_from_body(body: dict) -> SamplingParams:
@@ -53,7 +56,19 @@ def _sampling_from_body(body: dict) -> SamplingParams:
         n=int(n) if n is not None else 1,  # n=0 must reach the validator
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        # OpenAI logit_bias carries string token-id keys; vLLM's
+        # allowed_token_ids restricts sampling to a whitelist
+        logit_bias=_parse_logit_bias(body.get("logit_bias")),
+        allowed_token_ids=tuple(body.get("allowed_token_ids") or ()),
     )
+
+
+def _parse_logit_bias(raw) -> Optional[dict]:
+    if not raw:
+        return None
+    if not isinstance(raw, dict):
+        raise ValueError("logit_bias must be a map of token id -> bias")
+    return {int(k): float(v) for k, v in raw.items()}
 
 
 MAX_CHOICES = 128  # OpenAI caps n at 128; batched prompts share the cap
@@ -213,7 +228,14 @@ class EngineServer:
         prompt_ids = self.engine.tokenizer.encode(prompt)
         if body.get("stop_sequences"):  # Anthropic-spec field name
             body = dict(body, stop=body["stop_sequences"])
-        sampling = _sampling_from_body(body)
+        try:
+            sampling = _sampling_from_body(body)
+            make_token_controls(sampling, self.config.model.vocab_size)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid sampling parameter: {e}"}},
+                status=400,
+            )
         rid = f"msg_{uuid.uuid4().hex[:24]}"
 
         if len(prompt_ids) > self.config.model.max_model_len - 1:
@@ -830,6 +852,10 @@ class EngineServer:
                    chat: bool) -> web.StreamResponse:
         try:
             sampling = _sampling_from_body(body)
+            # validate token controls HERE (the engine recomputes them in
+            # add_request, after this handler has already committed to a
+            # stream) so bad ids/overflow become a 400, not a mid-stream 500
+            make_token_controls(sampling, self.config.model.vocab_size)
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid sampling parameter: {e}",
