@@ -773,6 +773,18 @@ class LLMEngine:
                          sampling=sp)
         while self.has_unfinished():
             self.step()
+        # token-controls variants (static use_controls flag): the first
+        # logit_bias/allowed_token_ids request must not stall on a
+        # mid-traffic recompile of the fused decode + prefill graphs
+        for temp in (0.0, 0.7):  # greedy and sampled control variants
+            sp = SamplingParams(temperature=temp, logit_bias={1: 0.0},
+                                max_tokens=max(sched.multi_step, 1) + 1,
+                                ignore_eos=True)
+            self.add_request(f"warmup-ctrl-{time.monotonic_ns()}",
+                             prompt_token_ids=rng.integers(1, vocab, 8).tolist(),
+                             sampling=sp)
+            while self.has_unfinished():
+                self.step()
         # ring-prefill variants: each power-of-two size class from the
         # threshold up to max_model_len, greedy + sampled
         if self.scheduler.ring_enabled:

@@ -8,6 +8,7 @@ next to creative) needs no recompilation and no per-request dispatch.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional, Sequence
 
 import jax
@@ -63,6 +64,11 @@ def make_token_controls(s: "SamplingParams", vocab_size: int):
     import numpy as np
 
     bias = {int(k): float(v) for k, v in (s.logit_bias or {}).items()}
+    for t, v in bias.items():
+        if not math.isfinite(v):
+            # json accepts NaN/Infinity literals; a NaN bias would poison
+            # the whole logit row on device — reject up-front
+            raise ValueError(f"logit_bias for token {t} must be finite")
     if s.allowed_token_ids:
         ids = list(dict.fromkeys(int(t) for t in s.allowed_token_ids))
         mode = CTRL_ALLOW

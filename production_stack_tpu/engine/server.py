@@ -112,22 +112,28 @@ class EngineServer:
     def build_app(self) -> web.Application:
         import os
 
-        middlewares = []
+        from production_stack_tpu.testing.faults import (
+            FaultSpec,
+            FaultState,
+            fault_middleware,
+        )
+
+        # fault injection is an explicit opt-in: the middleware AND the
+        # live /debug/faults toggle exist only when the operator set
+        # FAULT_INJECTION (any value — "" arms the toggle with no faults);
+        # a production engine without it has no injectable surface at all
+        self._faults_armed = "FAULT_INJECTION" in os.environ
         spec = os.environ.get("FAULT_INJECTION", "")
-        if spec:
-            from production_stack_tpu.testing.faults import (
-                FaultSpec,
-                fault_middleware,
+        self.faults = FaultState(FaultSpec.parse(spec) if spec else None)
+        if self.faults.spec is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "FAULT INJECTION ACTIVE: %s", self.faults.spec
             )
-
-            parsed = FaultSpec.parse(spec)
-            if parsed.active:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "FAULT INJECTION ACTIVE: %s", parsed
-                )
-                middlewares.append(fault_middleware(parsed))
+        middlewares = (
+            [fault_middleware(self.faults)] if self._faults_armed else []
+        )
         app = web.Application(client_max_size=64 * 1024 * 1024,
                               middlewares=middlewares)
         app.router.add_post("/v1/completions", self.completions)
@@ -149,6 +155,8 @@ class EngineServer:
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/debug/profile", self.profile)
         app.router.add_get("/debug/memory", self.memory_profile)
+        if self._faults_armed:
+            app.router.add_post("/debug/faults", self.debug_faults)
         app.router.add_post("/sleep", self.sleep)
         app.router.add_post("/wake_up", self.wake_up)
         app.router.add_get("/is_sleeping", self.is_sleeping)
@@ -687,6 +695,37 @@ class EngineServer:
     async def detokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
         return web.json_response({"prompt": self.engine.tokenizer.decode(body.get("tokens") or [])})
+
+    async def debug_faults(self, request: web.Request) -> web.Response:
+        """Flip fault injection on a LIVE engine (resilience drills,
+        tutorials/22-fault-injection.md) — no pod restart needed.
+
+        Query params mirror the --fault-injection spec string:
+        ``?error_rate=0.5&latency_ms=100&drop_rate=0.1&seed=7``;
+        ``?off=1`` clears. /debug/* is outside the faulted /v1/* surface,
+        so the toggle itself never faults."""
+        from production_stack_tpu.testing.faults import FaultSpec
+
+        q = request.rel_url.query
+        try:
+            off = q.get("off")
+            if off is not None:
+                if off.lower() not in ("1", "true"):
+                    raise ValueError("off must be 1 or true")
+                self.faults.set(None)
+            else:
+                spec = ",".join(f"{k}={v}" for k, v in q.items())
+                self.faults.set(FaultSpec.parse(spec))
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400
+            )
+        s = self.faults.spec
+        body = {"active": s is not None}
+        if s is not None:
+            body.update(error_rate=s.error_rate, latency_ms=s.latency_ms,
+                        drop_rate=s.drop_rate)
+        return web.json_response(body)
 
     # -- profiling ------------------------------------------------------------
     async def profile(self, request: web.Request) -> web.Response:
@@ -1267,7 +1306,8 @@ def main(argv=None) -> None:
     import os
 
     args = build_parser().parse_args(argv)
-    if args.fault_injection:
+    if args.fault_injection is not None:
+        # "" arms the live /debug/faults toggle with no faults injected
         os.environ["FAULT_INJECTION"] = args.fault_injection
     config = config_from_args(args)
     server = EngineServer(config, warmup_on_start=not args.skip_warmup)

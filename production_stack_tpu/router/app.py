@@ -355,9 +355,13 @@ class RouterApp:
                 if blocked is not None:
                     return blocked
             if self.semantic_cache is not None and path == "/v1/chat/completions":
+                from production_stack_tpu.router import metrics as m
+
                 hit = await self.semantic_cache.lookup(request)
                 if hit is not None:
+                    m.semantic_cache_hits_total.inc()
                     return hit
+                m.semantic_cache_misses_total.inc()
             resp = await self.request_service.route_general_request(request, path)
             return resp
 

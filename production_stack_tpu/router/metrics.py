@@ -63,6 +63,12 @@ request_errors_total = Counter(
     "vllm:request_errors_total", "Total request errors",
     ["server", "model", "error_type"],
 )
+semantic_cache_hits_total = Counter(
+    "vllm:semantic_cache_hits", "Semantic cache hits (short-circuited)", []
+)
+semantic_cache_misses_total = Counter(
+    "vllm:semantic_cache_misses", "Semantic cache misses", []
+)
 request_latency_seconds = Histogram(
     "vllm:request_latency_seconds",
     "End-to-end request latency observed at the router",

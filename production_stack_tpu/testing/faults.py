@@ -69,13 +69,27 @@ class FaultSpec:
         return bool(self.error_rate or self.latency_ms or self.drop_rate)
 
 
-def fault_middleware(spec: FaultSpec):
-    """aiohttp middleware injecting the spec's faults on POST /v1/*."""
-    rng = random.Random(spec.seed)
+class FaultState:
+    """Mutable holder so faults can be flipped on a LIVE engine (the
+    server's POST /debug/faults) — a drill shouldn't need a pod restart."""
+
+    def __init__(self, spec: Optional[FaultSpec] = None):
+        self.set(spec)
+
+    def set(self, spec: Optional[FaultSpec]) -> None:
+        self.spec = spec if spec is not None and spec.active else None
+        self.rng = random.Random(spec.seed if spec is not None else None)
+
+
+def fault_middleware(state: FaultState):
+    """aiohttp middleware injecting the state's faults on POST /v1/*."""
 
     @web.middleware
     async def middleware(request: web.Request, handler):
-        if request.method != "POST" or not request.path.startswith("/v1/"):
+        spec = state.spec
+        rng = state.rng
+        if (spec is None or request.method != "POST"
+                or not request.path.startswith("/v1/")):
             return await handler(request)
         if spec.latency_ms:
             import asyncio

@@ -126,6 +126,77 @@ def test_direct_injected_errors_visible():
         del os.environ["FAULT_INJECTION"]
 
 
+def test_live_fault_toggle():
+    """With FAULT_INJECTION set (even empty), POST /debug/faults flips
+    injection on a running engine with no restart: on → /v1 faults;
+    off → healthy again."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from production_stack_tpu.engine.server import EngineServer
+
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, prefill_buckets=(32,)),
+    )
+    os.environ["FAULT_INJECTION"] = ""  # armed, no faults yet
+    try:
+        server = EngineServer(cfg)
+
+        async def main():
+            async with TestClient(TestServer(server.build_app())) as c:
+                r = await c.post("/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 1})
+                assert r.status == 200  # started clean
+                r = await c.post("/debug/faults?error_rate=1.0")
+                assert (await r.json())["active"]
+                r = await c.post("/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 1})
+                assert r.status == 500
+                r = await c.post("/debug/faults?off=0")  # ambiguous → 400
+                assert r.status == 400
+                r = await c.post("/debug/faults?off=1")
+                assert not (await r.json())["active"]
+                r = await c.post("/v1/completions",
+                                 json={"prompt": "x", "max_tokens": 1})
+                assert r.status == 200
+                r = await c.post("/debug/faults?error_rate=2.0")  # invalid
+                assert r.status == 400
+
+        asyncio.run(main())
+    finally:
+        del os.environ["FAULT_INJECTION"]
+
+
+def test_fault_toggle_absent_when_unarmed():
+    """An engine started WITHOUT FAULT_INJECTION has no injectable
+    surface: /debug/faults does not exist (blast-radius gate)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from production_stack_tpu.engine.server import EngineServer
+
+    assert "FAULT_INJECTION" not in os.environ
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=64),
+        scheduler=SchedulerConfig(max_num_seqs=2, prefill_buckets=(32,)),
+    )
+    server = EngineServer(cfg)
+
+    async def main():
+        async with TestClient(TestServer(server.build_app())) as c:
+            r = await c.post("/debug/faults?error_rate=1.0")
+            assert r.status == 404
+
+    asyncio.run(main())
+
+
 def test_latency_and_drop_faults():
     import time
 
