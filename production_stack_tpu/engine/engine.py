@@ -709,6 +709,29 @@ class LLMEngine:
         mask[0, : len(prompt_token_ids)] = 1
         return self.runner.pooled_embed(tokens, mask)[0]
 
+    def choice_logprobs(self, prompt_token_ids: list[int],
+                        choices_ids: list[list[int]]) -> list[float]:
+        """log P(choice | prompt) for each choice, teacher-forced in one
+        batched dense pass — the guided_choice scoring primitive. Sequence-
+        level (not a greedy token walk): the server selects or samples
+        among choices from these exact probabilities."""
+        import numpy as np
+
+        n = len(choices_ids)
+        N = 1 << (n - 1).bit_length() if n else 1  # pow-2 compile classes
+        total = len(prompt_token_ids) + max(len(c) for c in choices_ids)
+        S = self._bucket(total)
+        if S < total:  # bucket_for clamps at the top prefill bucket —
+            # scoring runs dense, so pad to the next power of two instead
+            S = 1 << (total - 1).bit_length()
+        tokens = np.zeros((N, S), np.int32)
+        cont = np.zeros((N, S), bool)
+        p = len(prompt_token_ids)
+        for i, c in enumerate(choices_ids):
+            tokens[i, : p + len(c)] = list(prompt_token_ids) + list(c)
+            cont[i, p : p + len(c)] = True
+        return self.runner.sequence_logprobs(tokens, cont)[:n].tolist()
+
     def warmup(self) -> None:
         """Pre-compile every serving shape variant so no live request pays a
         compile: each prefill bucket at P=1, the P=prefill_batch variant,
@@ -776,6 +799,9 @@ class LLMEngine:
         # token-controls variants (static use_controls flag): the first
         # logit_bias/allowed_token_ids request must not stall on a
         # mid-traffic recompile of the fused decode + prefill graphs
+        # guided-choice scorer: one representative (N, S) variant so the
+        # first guided request doesn't compile mid-traffic
+        self.choice_logprobs([1, 2, 3, 4], [[5], [6, 7]])
         for temp in (0.0, 0.7):  # greedy and sampled control variants
             sp = SamplingParams(temperature=temp, logit_bias={1: 0.0},
                                 max_tokens=max(sched.multi_step, 1) + 1,

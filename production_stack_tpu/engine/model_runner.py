@@ -548,6 +548,55 @@ class ModelRunner:
             )
         return np.asarray(jax.device_get(out))
 
+    # -- teacher-forced sequence scoring (guided choice) ---------------------
+    def sequence_logprobs(self, tokens: np.ndarray,
+                          cont_mask: np.ndarray) -> np.ndarray:
+        """Sum log P(token_j | tokens_<j) over positions where
+        ``cont_mask`` is set — the exact score of a continuation given its
+        prompt, teacher-forced in one dense causal pass per row.
+
+        tokens: (N, S) int32, 0-padded; cont_mask: (N, S) bool marking the
+        CONTINUATION token positions (their probabilities come from the
+        logits one position earlier). Returns (N,) float32 sums.
+        """
+        if getattr(self, "_seqlp_fn", None) is None:
+            from production_stack_tpu.ops.attention import (
+                dense_causal_attention,
+            )
+
+            model = self.model
+            cfg = self.cfg
+
+            def _score(params, tokens, cont_mask):
+                def attend(q, k, v, caches, layer_idx):
+                    return dense_causal_attention(
+                        q, k, v, soft_cap=cfg.attn_logit_softcap
+                    ), caches
+
+                S = tokens.shape[1]
+                positions = jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32), tokens.shape
+                )
+                hidden, _ = model.forward_tokens(
+                    cfg, params, tokens, positions, attend, None
+                )
+                logits = model.logits_from_hidden(cfg, params, hidden)
+                logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+                tgt = tokens[:, 1:]
+                picked = jnp.take_along_axis(
+                    logp, tgt[..., None], axis=-1
+                )[..., 0]  # (N, S-1): logP of token j+1 given prefix
+                return jnp.sum(
+                    picked * cont_mask[:, 1:].astype(jnp.float32), axis=-1
+                )
+
+            self._seqlp_fn = jax.jit(_score)
+        with jax.set_mesh(self.mesh):
+            out = self._seqlp_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(cont_mask)
+            )
+        return np.asarray(jax.device_get(out))
+
     # -- multi-LoRA bank -----------------------------------------------------
     def register_lora(self, slot: int, bank_np: dict) -> None:
         """Write an adapter's stacked (A, B) pairs into bank slot ``slot``."""

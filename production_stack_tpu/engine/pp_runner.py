@@ -390,37 +390,42 @@ class StagedModelRunner:
     def kv_alive(self) -> bool:
         return all(r.kv is not None for r in self.stages)
 
-    # -- dense pooled embedding (the /v1/embeddings surface) ----------------
-    def pooled_embed(self, tokens: np.ndarray, mask: np.ndarray) -> np.ndarray:
-        if getattr(self, "_pooled_stage_fns", None) is None:
-            from production_stack_tpu.ops.attention import (
-                dense_causal_attention,
-            )
-
-            model = get_model(self.stage_cfg)
-            cfg = self.stage_cfg
-
-            def stage_fwd(first, params, x, positions):
-                def attend(q, k, v, caches, layer_idx):
-                    return dense_causal_attention(
-                        q, k, v, soft_cap=cfg.attn_logit_softcap
-                    ), caches
-
-                if first:
-                    x = model.embed_tokens(cfg, params, x)
-                hidden, _ = model.forward_hidden(
-                    cfg, params, x, positions, attend, None
-                )
-                return hidden
-
-            self._pooled_stage_fns = [
-                jax.jit(functools.partial(stage_fwd, s == 0))
-                for s in range(self.n_stages)
-            ]
-        S = tokens.shape[1]
-        positions = np.broadcast_to(
-            np.arange(S, dtype=np.int32), tokens.shape
+    # -- dense forward chained through the stages ---------------------------
+    def _ensure_stage_fns(self) -> None:
+        if getattr(self, "_pooled_stage_fns", None) is not None:
+            return
+        from production_stack_tpu.ops.attention import (
+            dense_causal_attention,
         )
+
+        model = get_model(self.stage_cfg)
+        cfg = self.stage_cfg
+
+        def stage_fwd(first, params, x, positions):
+            def attend(q, k, v, caches, layer_idx):
+                return dense_causal_attention(
+                    q, k, v, soft_cap=cfg.attn_logit_softcap
+                ), caches
+
+            if first:
+                x = model.embed_tokens(cfg, params, x)
+            hidden, _ = model.forward_hidden(
+                cfg, params, x, positions, attend, None
+            )
+            return hidden
+
+        self._pooled_stage_fns = [
+            jax.jit(functools.partial(stage_fwd, s == 0))
+            for s in range(self.n_stages)
+        ]
+
+    def pipe_hidden(self, tokens: np.ndarray) -> jnp.ndarray:
+        """Dense causal forward chained through the stages → final hidden
+        (the pooled-embedding and guided-choice scoring backbone)."""
+        self._ensure_stage_fns()
+        S = tokens.shape[1]
+        positions = np.broadcast_to(np.arange(S, dtype=np.int32),
+                                    tokens.shape)
         x = jnp.asarray(tokens)
         for s, runner in enumerate(self.stages):
             if s > 0:
@@ -429,10 +434,47 @@ class StagedModelRunner:
                 x = self._pooled_stage_fns[s](
                     runner.params, x, jnp.asarray(positions)
                 )
+        return x
+
+    # -- dense pooled embedding (the /v1/embeddings surface) ----------------
+    def pooled_embed(self, tokens: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        x = self.pipe_hidden(tokens)
         m = np.asarray(mask)[:, :, None].astype(np.float32)
         h = np.asarray(jax.device_get(x)).astype(np.float32)
         pooled = (h * m).sum(axis=1) / np.maximum(m.sum(axis=1), 1.0)
         return pooled
+
+    # -- teacher-forced sequence scoring (guided choice) ---------------------
+
+    def sequence_logprobs(self, tokens: np.ndarray,
+                          cont_mask: np.ndarray) -> np.ndarray:
+        """ModelRunner.sequence_logprobs over the staged pipeline: hidden
+        states stream through the stages, the last stage scores."""
+        hidden = self.pipe_hidden(tokens)
+        model = get_model(self.stage_cfg)
+        cfg = self.stage_cfg
+        last = self.stages[-1]
+        if getattr(self, "_seqlp_tail_fn", None) is None:
+            def _tail(params, hidden, tokens, cont_mask):
+                logits = model.logits_from_hidden(cfg, params, hidden)
+                logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+                tgt = tokens[:, 1:]
+                picked = jnp.take_along_axis(
+                    logp, tgt[..., None], axis=-1
+                )[..., 0]
+                return jnp.sum(
+                    picked * cont_mask[:, 1:].astype(jnp.float32), axis=-1
+                )
+
+            self._seqlp_tail_fn = jax.jit(_tail)
+        sub = self.submeshes[-1]
+        with jax.set_mesh(sub):
+            out = self._seqlp_tail_fn(
+                last.params, hidden,
+                jax.device_put(jnp.asarray(tokens), _replicated(sub)),
+                jax.device_put(jnp.asarray(cont_mask), _replicated(sub)),
+            )
+        return np.asarray(jax.device_get(out))
 
 
 # ---------------------------------------------------------------------------

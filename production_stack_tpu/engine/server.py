@@ -925,6 +925,13 @@ class EngineServer:
                     status=400,
                 )
 
+        guided = body.get("guided_choice")
+        if guided is not None:
+            return await self._guided_choice_response(
+                request, guided, prompt_ids_list, sampling, rid, created,
+                model, chat, stream,
+            )
+
         n = max(1, int(sampling.n))
         nchoices = len(prompt_ids_list) * n
 
@@ -1090,6 +1097,109 @@ class EngineServer:
                 "remote_port": None,
             }
         return web.json_response(payload)
+
+    async def _guided_choice_response(self, request, guided, prompt_ids_list,
+                                      sampling, rid, created, model,
+                                      chat, stream) -> web.StreamResponse:
+        """vLLM's guided_choice, scored at the SEQUENCE level: one batched
+        teacher-forced pass computes log P(choice | prompt) for every
+        choice; temperature 0 picks the argmax, otherwise the choice is
+        sampled from softmax(logP / T). Exactly one of the given strings is
+        returned — with principled whole-sequence probabilities rather
+        than the reference engines' greedy token-walk approximation."""
+        import numpy as np
+
+        if (not isinstance(guided, list) or not guided
+                or not all(isinstance(c, str) and c for c in guided)
+                or len(guided) > 64):
+            return web.json_response(
+                {"error": {"message": "guided_choice must be 1..64 "
+                           "non-empty strings",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if len(prompt_ids_list) != 1 or sampling.n != 1:
+            return web.json_response(
+                {"error": {"message": "guided_choice requires a single "
+                           "prompt and n=1",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        tk = self.engine.tokenizer
+        prompt_ids = prompt_ids_list[0]
+        # continuations must NOT carry a BOS: the choice is scored
+        # mid-sequence, conditioned on the prompt
+        choice_ids = [tk.encode(c, add_bos=False) for c in guided]
+        if any(not c for c in choice_ids):
+            return web.json_response(
+                {"error": {"message": "guided_choice entry tokenizes to "
+                           "nothing", "type": "invalid_request_error"}},
+                status=400,
+            )
+        if (len(prompt_ids) + max(len(c) for c in choice_ids)
+                > self.config.model.max_model_len):
+            return web.json_response(
+                {"error": {"message": "prompt + longest choice exceeds "
+                           "max_model_len",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        logps = await self.async_engine.run_on_engine(
+            lambda eng: eng.choice_logprobs(prompt_ids, choice_ids)
+        )
+        if sampling.temperature <= 0.0:
+            idx = int(np.argmax(logps))
+        else:
+            z = np.asarray(logps, np.float64) / sampling.temperature
+            p = np.exp(z - z.max())
+            p /= p.sum()
+            rng = np.random.default_rng(sampling.seed)
+            idx = int(rng.choice(len(p), p=p))
+        text = guided[idx]
+        usage = {  # OpenAI semantics: the client's one prompt, counted once
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(choice_ids[idx]),
+            "total_tokens": len(prompt_ids) + len(choice_ids[idx]),
+        }
+        if chat:
+            choice = {"index": 0,
+                      "message": {"role": "assistant", "content": text},
+                      "finish_reason": "stop", "logprobs": None}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": "stop",
+                      "logprobs": None}
+            obj = "text_completion"
+        if not stream:
+            return web.json_response({
+                "id": rid, "object": obj, "created": created,
+                "model": model, "choices": [choice], "usage": usage,
+            })
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache", "X-Request-Id": rid},
+        )
+        await resp.prepare(request)
+        obj_chunk = "chat.completion.chunk" if chat else "text_completion"
+        if chat:
+            chunks = [
+                {"delta": {"role": "assistant", "content": text},
+                 "index": 0, "finish_reason": None},
+                {"delta": {}, "index": 0, "finish_reason": "stop"},
+            ]
+        else:
+            chunks = [
+                {"text": text, "index": 0, "finish_reason": None},
+                {"text": "", "index": 0, "finish_reason": "stop"},
+            ]
+        for c in chunks:
+            payload = {"id": rid, "object": obj_chunk, "created": created,
+                       "model": model, "choices": [c]}
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
 
     async def _stream_response(self, request, gens, rids, rid, created, model,
                                chat, t_start, n_prompt, sampling,
