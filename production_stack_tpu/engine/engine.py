@@ -112,9 +112,35 @@ class LLMEngine:
         # the blocks are released executes later in device program order —
         # so deferred stops can't corrupt reused or cached blocks.
         self._pending_decode = None
+        # n-gram speculative decoding (engine/spec.py): verify-chunk width,
+        # padded to a sublane multiple for the Pallas prefill kernel. The
+        # staged PP runner relays activations host-side per stage and has
+        # no verify program — spec stays off there.
+        k = config.scheduler.spec_ngram_k
+        if k > 0 and not hasattr(self.runner, "verify"):
+            # zeroing the config also resets decode_horizon, so the block
+            # capacity for the verify span isn't paid for nothing
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "speculative decoding disabled: the staged pipeline runner "
+                "has no verify program (spec_ngram_k=%d ignored)", k
+            )
+            config.scheduler.spec_ngram_k = k = 0
+        self._spec_S = -(-(k + 1) // 8) * 8 if k > 0 else 0
+        if self._spec_S:
+            S = self._spec_S
+            self._sp_tokens = np.zeros((B, S), np.int32)
+            self._sp_positions = np.full((B, S), -1, np.int32)
+            self._sp_slots = np.full((B, S), -1, np.int32)
+            self._sp_tables = np.zeros((B, M), np.int32)
+            self._sp_ctx = np.zeros(B, np.int32)
+            self._sp_adapters = np.zeros(B, np.int32)
         # metrics
         self.total_prompt_tokens = 0
         self.total_output_tokens = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     # -- request intake ------------------------------------------------------
     def add_request(
@@ -186,9 +212,108 @@ class LLMEngine:
         decodes = [s for s in out.decodes
                    if s.status is SequenceStatus.RUNNING]
         if decodes:
-            outputs.extend(self._run_decode(decodes))
+            if self._spec_S and self._spec_eligible(decodes):
+                outputs.extend(self._run_decode_spec(decodes))
+            else:
+                outputs.extend(self._run_decode(decodes))
         else:
             outputs.extend(self._resolve_pending_decode())
+        return outputs
+
+    @staticmethod
+    def _spec_eligible(decodes: list[Sequence]) -> bool:
+        """Speculation verifies against the greedy argmax, so the whole
+        batch must be greedy with plain logits — temperature, penalties or
+        token controls anywhere fall the step back to normal decode."""
+        return all(
+            s.sampling.temperature <= 0.0
+            and not s.sampling.presence_penalty
+            and not s.sampling.frequency_penalty
+            and s.token_ctrl is None
+            for s in decodes
+        )
+
+    def _run_decode_spec(self, decodes: list[Sequence]) -> list[RequestOutput]:
+        """One speculative step: propose drafts from each sequence's own
+        history (n-gram prompt lookup), verify all of them in ONE forward
+        over the paged cache, accept the longest model-confirmed prefix.
+        Every emitted token is the model's own argmax — greedy output is
+        unchanged by speculation; steps without matches degenerate to a
+        plain one-token decode inside the same program."""
+        from production_stack_tpu.engine.spec import accept_drafts, propose_ngram
+
+        outputs = self._resolve_pending_decode()
+        decodes = [s for s in decodes if s.status is SequenceStatus.RUNNING]
+        if not decodes:
+            return outputs
+        sched = self.config.scheduler
+        bs = self.config.cache.block_size
+        row_drafts: list[tuple[Sequence, list[int]]] = []
+        any_drafts = False
+        for seq in decodes:
+            pos = seq.num_computed_tokens
+            # drafts may not run past the allocated blocks or the model's
+            # length cap (their K/V land in real slots)
+            max_d = min(
+                sched.spec_ngram_k,
+                self.config.model.max_model_len - 1 - pos,
+                len(seq.block_ids) * bs - pos - 1,
+            )
+            drafts = (
+                propose_ngram(
+                    seq.token_ids, max_d, sched.spec_ngram_max,
+                    sched.spec_ngram_min, sched.spec_window,
+                )
+                if max_d > 0 else []
+            )
+            any_drafts = any_drafts or bool(drafts)
+            row_drafts.append((seq, drafts))
+        if not any_drafts:
+            # nothing to verify: the plain (multi-step) decode program is
+            # strictly cheaper than an S-wide verify carrying one token
+            outputs.extend(self._run_decode(decodes))
+            return outputs
+        # persistent host buffers (rewritten in place each step); stale
+        # token/table data in inactive rows is masked by ctx 0 / pos -1
+        self._sp_ctx[:] = 0
+        self._sp_positions[:] = -1
+        self._sp_slots[:] = -1
+        for seq, drafts in row_drafts:
+            i = seq.slot
+            pos = seq.num_computed_tokens
+            n = 1 + len(drafts)
+            self._sp_tokens[i, :n] = [seq.token_ids[pos]] + drafts
+            self._sp_positions[i, :n] = np.arange(pos, pos + n)
+            self._sp_slots[i, :n] = slot_mapping_for(seq.block_ids, pos, n, bs)
+            self._sp_tables[i, : len(seq.block_ids)] = seq.block_ids
+            self._sp_ctx[i] = pos + n
+            self._sp_adapters[i] = seq.adapter_slot
+        use_lora = any(s.adapter_slot for s in decodes)
+        verified = self.runner.verify(
+            self._sp_tokens, self._sp_positions, self._sp_tables,
+            self._sp_ctx, self._sp_slots.reshape(-1),
+            adapter_ids=self._sp_adapters if use_lora else None,
+        )
+        live, token_lists = [], []
+        for seq, drafts in row_drafts:
+            if seq.status.is_finished:
+                continue  # aborted while the dispatch was in flight
+            new_tokens, n_acc = accept_drafts(drafts, verified[seq.slot])
+            self.spec_drafted += len(drafts)
+            self.spec_accepted += n_acc
+            new_toks = []
+            for t in new_tokens:
+                seq.num_computed_tokens += 1
+                seq.output_token_ids.append(t)
+                new_toks.append(t)
+                self.total_output_tokens += 1
+                if seq.first_token_time is None:
+                    seq.first_token_time = time.monotonic()
+                if self._check_stop(seq, t) is not None:
+                    break
+            live.append(seq)
+            token_lists.append(new_toks)
+        outputs.extend(self._postprocess(live, token_lists))
         return outputs
 
     def _resolve_pending_prefill(self) -> list[RequestOutput]:
@@ -243,7 +368,9 @@ class LLMEngine:
         from production_stack_tpu.engine.kv_offload import chain_hashes
 
         bs = self.config.cache.block_size
-        n_full = min(len(seq.token_ids) // bs, len(seq.block_ids))
+        # only positions < num_computed hold valid KV (see Scheduler.finish)
+        n_valid = min(len(seq.token_ids), seq.num_computed_tokens)
+        n_full = min(n_valid // bs, len(seq.block_ids))
         if n_full <= 0:
             return
         import numpy as np
@@ -664,6 +791,8 @@ class LLMEngine:
             "cpu_cache_usage_perc": 0.0,
             "cpu_prefix_cache_hits_total": 0,
             "cpu_prefix_cache_queries_total": 0,
+            "spec_decode_num_draft_tokens_total": self.spec_drafted,
+            "spec_decode_num_accepted_tokens_total": self.spec_accepted,
         }
         if self.host_kv is not None:
             out["cpu_cache_usage_perc"] = self.host_kv.usage
@@ -787,6 +916,21 @@ class LLMEngine:
                          for _ in range(p)]
                 run(batch, 0.0)
                 run(batch, 0.7)
+        # speculative verify program: compile the one static (B, S) shape
+        # directly with an all-inactive batch (ctx 0, slots -1 — no KV is
+        # touched); whether live traffic's drafts ever match is dynamic, so
+        # generation-driven warmup can't be relied on to reach this program
+        if self._spec_S:
+            B = self.config.scheduler.max_num_seqs
+            S = self._spec_S
+            M = self.runner.max_blocks_per_seq
+            self.runner.verify(
+                np.zeros((B, S), np.int32),
+                np.full((B, S), -1, np.int32),
+                np.zeros((B, M), np.int32),
+                np.zeros(B, np.int32),
+                np.full(B * S, -1, np.int32),
+            )
         # penalised decode variant (static use_penalties flag)
         sp = SamplingParams(temperature=0.0, presence_penalty=0.5,
                             max_tokens=max(sched.multi_step, 1) + 1,

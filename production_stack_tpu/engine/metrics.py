@@ -83,6 +83,17 @@ class EngineStatsCollector:
             "Host-tier prefix block queries",
             s.get("cpu_prefix_cache_queries_total", 0),
         )
+        # n-gram speculative decoding (vLLM spec-decode metric names)
+        yield counter(
+            "vllm:spec_decode_num_draft_tokens",
+            "Speculative draft tokens proposed",
+            s.get("spec_decode_num_draft_tokens_total", 0),
+        )
+        yield counter(
+            "vllm:spec_decode_num_accepted_tokens",
+            "Speculative draft tokens accepted",
+            s.get("spec_decode_num_accepted_tokens_total", 0),
+        )
         yield counter(
             "vllm:prompt_tokens", "Cumulative prompt tokens", s["prompt_tokens_total"]
         )

@@ -116,6 +116,11 @@ class ModelRunner:
                              "use_controls"),
         )
         self._sample = jax.jit(sample_tokens)
+        if config.scheduler.spec_ngram_k > 0:
+            self._verify = jax.jit(
+                functools.partial(_verify_step, self.cfg, self._attend_prefill),
+                donate_argnums=(1,),
+            )
         from production_stack_tpu.parallel.mesh import AXIS_SEQ
 
         self.seq_parallel = mesh.shape[AXIS_SEQ] > 1
@@ -368,6 +373,28 @@ class ModelRunner:
                 use_controls=ctrl is not None,
             )
         return np.asarray(jax.device_get(sampled))
+
+    def verify(self, tokens: np.ndarray, positions: np.ndarray,
+               block_tables: np.ndarray, context_lens: np.ndarray,
+               slot_mapping: np.ndarray,
+               adapter_ids: Optional[np.ndarray] = None) -> np.ndarray:
+        """Speculative-decode verification: one forward over short
+        prefill-shaped chunks (tokens (B, S): last accepted token + drafts,
+        -1-padded positions/slots past each row's live span), returning the
+        greedy argmax at EVERY position (B, S). The host accepts the longest
+        draft prefix the model reproduces (engine/spec.py)."""
+        use_lora = adapter_ids is not None and self.lora_bank is not None
+        with jax.set_mesh(self.mesh):
+            self.kv, out = self._verify(
+                self.params, self.kv,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(block_tables), jnp.asarray(context_lens),
+                jnp.asarray(slot_mapping),
+                lora_bank=self.lora_bank if use_lora else None,
+                adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
+                             if use_lora else None),
+            )
+        return np.asarray(jax.device_get(out))
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray, context_lens: np.ndarray,
@@ -809,6 +836,40 @@ def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
     return new_kv, sampled
 
 
+def _verify_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
+                 block_tables, context_lens, slot_mapping,
+                 lora_bank=None, adapter_ids=None):
+    """Speculative verification: greedy argmax at ALL chunk positions.
+
+    Reuses the batched-prefill attention path (causal within the chunk +
+    paged context), so drafts' K/V land in their deterministic slots; a
+    rejected draft's slot is rewritten when the real token for that
+    position is fed on a later step. The per-position LM head runs under
+    ``lax.map`` so the (B, S, V) logits cube is never materialised —
+    only one (B, V) slice lives at a time."""
+    from production_stack_tpu.models.registry import get_model
+
+    model = get_model(cfg)
+
+    def attend(q, k, v, caches, layer_idx):
+        return attend_impl(
+            q, k, v, caches, layer_idx, block_tables, context_lens, positions,
+            slot_mapping,
+        )
+
+    hidden, new_kv = model.forward_tokens(
+        cfg, params, tokens, positions, attend, kv,
+        lora=_make_lora(lora_bank, adapter_ids, tokens.shape[1]),
+    )
+
+    def one_pos(h_s):  # (B, E) hidden at one chunk position
+        logits = model.logits_from_hidden(cfg, params, h_s[:, None])[:, 0]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    out = jax.lax.map(one_pos, hidden.transpose(1, 0, 2))  # (S, B)
+    return new_kv, out.transpose(1, 0)  # (B, S)
+
+
 def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
                  block_tables, context_lens, slot_mapping):
     from production_stack_tpu.models.registry import get_model
@@ -884,8 +945,13 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
         new_pos = jnp.where(active, pos + 1, pos)
         new_ctx = jnp.where(active, ctx + 1, ctx)
         block = block_tables[jnp.arange(B), jnp.clip(new_pos, 0, None) // block_size]
+        # positions at/past max_model_len have no allocated slot: the
+        # clamped table lookup would alias another position's block, and a
+        # stray KV write there would be committed to the prefix cache when
+        # the (finishing) sequence's blocks are content-addressed
+        valid = active & (new_pos < cfg.max_model_len)
         new_slots = jnp.where(
-            active, block * block_size + new_pos % block_size, -1
+            valid, block * block_size + new_pos % block_size, -1
         )
         tok = jnp.where(active, sampled, tok)
         if use_penalties:

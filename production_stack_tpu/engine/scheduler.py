@@ -117,8 +117,17 @@ class Scheduler:
     def finish(self, seq: Sequence, status: SequenceStatus) -> None:
         """Mark finished; full blocks stay content-addressed in the allocator
         so the next conversation round prefix-hits this context (the
-        multi-round-QA KV-reuse win the reference gets from LMCache)."""
-        self.allocator.commit_full_blocks(seq.token_ids, seq.block_ids)
+        multi-round-QA KV-reuse win the reference gets from LMCache).
+
+        Only positions < num_computed_tokens hold valid KV: the final
+        sampled token was never fed back (single-step), and under
+        speculative decoding rejected drafts leave garbage in the tail
+        slots — committing a block containing such a position would
+        content-address wrong KV for future prefix matches."""
+        n_valid = min(len(seq.token_ids), seq.num_computed_tokens)
+        self.allocator.commit_full_blocks(
+            seq.token_ids[:n_valid], seq.block_ids
+        )
         self._release(seq)
         try:
             # a seq can finish while PREEMPTED (its deferred prefill token
@@ -216,13 +225,18 @@ class Scheduler:
             key=lambda s: s.slot,
         )
         bs = self.cache_config.block_size
-        horizon = max(self.config.multi_step, 1)
+        horizon = self.config.decode_horizon
         survivors = []
         for seq in decodes:
             if seq.status is not SequenceStatus.RUNNING:
                 continue  # preempted earlier in this same pass
             preempted_self = False
-            while len(seq.block_ids) * bs < seq.num_computed_tokens + horizon:
+            # capacity past max_model_len is never consumed (the runner
+            # drops KV writes there), so don't allocate blocks for it —
+            # near the length cap the table row may have no slack
+            target = min(seq.num_computed_tokens + horizon,
+                         self.max_model_len)
+            while len(seq.block_ids) * bs < target:
                 bid = self.allocator.append_block()
                 while bid is None:
                     victim = self._pick_victim(exclude=seq)

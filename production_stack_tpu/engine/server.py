@@ -1354,6 +1354,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prompt length at which prefill switches to the "
                         "ring-attention sequence-parallel path (needs "
                         "--sequence-parallel-size > 1)")
+    p.add_argument("--speculative-ngram", type=int, default=0,
+                   help="n-gram (prompt-lookup) speculative decoding: "
+                        "propose up to this many draft tokens per step from "
+                        "the sequence's own history and verify them in one "
+                        "forward (vLLM --speculative-config ngram "
+                        "equivalent; greedy requests only). 0 = off")
+    p.add_argument("--speculative-ngram-max", type=int, default=3,
+                   help="longest tail n-gram matched against the history")
     p.add_argument("--fault-injection", default=None,
                    help="inject faults on the OpenAI surface for "
                         "resilience drills, e.g. "
@@ -1398,6 +1406,9 @@ def config_from_args(args) -> EngineConfig:
         cfg.scheduler.prefill_buckets = tuple(
             int(x) for x in args.prefill_buckets.split(",")
         )
+    if args.speculative_ngram:
+        cfg.scheduler.spec_ngram_k = args.speculative_ngram
+        cfg.scheduler.spec_ngram_max = args.speculative_ngram_max
     if args.host_offload_blocks:
         cfg.cache.host_offload_blocks = args.host_offload_blocks
     if args.remote_kv_url:

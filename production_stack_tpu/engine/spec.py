@@ -1,0 +1,78 @@
+"""N-gram (prompt-lookup) draft proposal for speculative decoding.
+
+The reference's engines get speculative decoding from vLLM
+(``--speculative-config '{"method": "ngram", ...}'``); here it is engine-
+native. The proposer is pure host-side control plane: it scans the
+sequence's own token history (prompt + generated) for the most recent
+occurrence of the current tail n-gram and proposes the tokens that followed
+it. Multi-round QA and agentic workloads repeat long spans verbatim, so
+acceptance rates are high exactly where decode throughput matters.
+
+Verification happens on device in ONE forward over the paged cache
+(ModelRunner.verify): the drafts enter as a short prefill-shaped chunk and
+the model's greedy output at every position either confirms or replaces
+them — output tokens are always the model's own argmax, so greedy output
+is identical with speculation on or off (up to XLA reduction-order
+numerics across batch shapes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def propose_ngram(
+    token_ids: list[int],
+    k: int,
+    n_max: int = 3,
+    n_min: int = 1,
+    window: int = 4096,
+) -> list[int]:
+    """Propose up to ``k`` draft tokens continuing ``token_ids``.
+
+    Tries tail n-grams from ``n_max`` down to ``n_min``; for the first
+    length with a match in the trailing ``window`` tokens, returns the
+    (up to k) tokens that followed the MOST RECENT match. Returns [] when
+    no n-gram recurs — the caller then decodes normally.
+    """
+    if k <= 0:
+        return []
+    arr = np.asarray(token_ids[-window:], dtype=np.int64)
+    L = arr.shape[0]
+    for n in range(n_max, n_min - 1, -1):
+        if L < n + 1:
+            continue
+        tail = arr[L - n:]
+        # candidate start positions: the n-gram must end before the tail
+        # itself AND have at least one following token
+        starts = np.lib.stride_tricks.sliding_window_view(arr[: L - 1], n)
+        hits = np.flatnonzero((starts == tail).all(axis=1))
+        if hits.size == 0:
+            continue
+        pos = int(hits[-1])  # most recent occurrence
+        follow = arr[pos + n : pos + n + k]
+        if follow.size == 0:
+            continue
+        return [int(t) for t in follow]
+    return []
+
+
+def accept_drafts(drafts: list[int], verified: np.ndarray) -> tuple[list[int], int]:
+    """Greedy acceptance: given the model's argmax ``verified[j]`` at each
+    verify position j (position 0 consumed the last accepted token,
+    positions 1..n consumed the drafts), return (new_tokens, n_accepted).
+
+    Draft j (1-based) is accepted iff every earlier draft was accepted and
+    ``drafts[j-1] == verified[j-1]`` — i.e. the draft equals what the model
+    would have produced anyway. The first non-matching model output is the
+    bonus token, so each verify yields between 1 and len(drafts)+1 tokens,
+    all of them the model's own argmax.
+    """
+    n_acc = 0
+    for j, d in enumerate(drafts):
+        if d == int(verified[j]):
+            n_acc += 1
+        else:
+            break
+    new_tokens = [int(verified[j]) for j in range(n_acc + 1)]
+    return new_tokens, n_acc
