@@ -22,7 +22,10 @@ from production_stack_tpu.parallel.mesh import MeshConfig
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     name: str = "tiny-llama"
-    architecture: str = "llama"  # "llama" | "mixtral" | "gemma" | "gemma2"
+    # "llama" | "mixtral" | "gemma" | "gemma2" | "phi3" — Mistral and Qwen
+    # run as "llama" (their deltas are knobs: sliding_window, qkv_bias,
+    # qk_norm); "phi3" differs only in its fused HF weight layout
+    architecture: str = "llama"
     vocab_size: int = 32000
     hidden_size: int = 2048
     intermediate_size: int = 5632
@@ -41,6 +44,8 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # Qwen2-family: biases on the QKV projections
     qkv_bias: bool = False
+    # Qwen3-family: per-head RMSNorm on q and k (over head_dim, pre-rope)
+    qk_norm: bool = False
     # Gemma family knobs (all default to the Llama behaviour)
     act: str = "silu"  # MLP gate activation: "silu" | "gelu_tanh" (GeGLU)
     norm_offset: float = 0.0  # RMSNorm scales by (offset + weight); Gemma: 1
@@ -80,6 +85,24 @@ class ModelConfig:
         archs = cfg.get("architectures") or []
         if any("Mixtral" in a for a in archs) or "num_local_experts" in cfg:
             arch = "mixtral"
+        elif any("Phi3" in a for a in archs):
+            # only the standard Phi-3 maps onto the fused-Llama layout;
+            # Phi-3-small (query_key_value naming, gegelu, blocksparse)
+            # would die mid-load with an opaque KeyError — refuse up front
+            if not all(a == "Phi3ForCausalLM" for a in archs if "Phi3" in a):
+                raise ValueError(
+                    f"unsupported Phi-3 variant {archs}; supported: "
+                    "Phi3ForCausalLM"
+                )
+            # Llama stack with fused HF qkv/gate_up weight layout; LongRoPE
+            # extension factors are not implemented — serve within the
+            # original context only
+            if cfg.get("rope_scaling"):
+                raise ValueError(
+                    "Phi-3 LongRoPE rope_scaling is not supported; use a "
+                    "checkpoint without rope_scaling (e.g. the 4k variants)"
+                )
+            arch = "phi3"
         elif any("Gemma2" in a for a in archs):
             arch = "gemma2"
         elif any(a.startswith("Gemma") and "Gemma2" not in a for a in archs):
@@ -96,12 +119,27 @@ class ModelConfig:
         qkv_bias = any("Qwen2" in a for a in archs) or bool(
             cfg.get("attention_bias", False)
         )
+        if any("Qwen3Moe" in a for a in archs):
+            # Qwen3-MoE stores mlp.experts.N.* under the num_experts key
+            # (not Mixtral's num_local_experts/block_sparse_moe layout) —
+            # parsing it as dense would KeyError mid-load
+            raise ValueError(
+                f"unsupported Qwen3 variant {archs}; supported: "
+                "Qwen3ForCausalLM (dense)"
+            )
+        qk_norm = any("Qwen3" in a for a in archs)
         hidden = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]
         gemma = arch in ("gemma", "gemma2")
         hf_act = cfg.get("hidden_activation") or cfg.get("hidden_act") or "silu"
         qpas = cfg.get("query_pre_attn_scalar", 0)
-        window = int(cfg.get("sliding_window") or 0) if arch == "gemma2" else 0
+        # local-attention window: Gemma-2 alternates local/global, Mistral
+        # and Phi-3 window every layer — either way exact serving holds only
+        # within the window (the ModelConfig.sliding_window gate). Qwen2/3
+        # checkpoints carry a sliding_window value but disable it.
+        window = int(cfg.get("sliding_window") or 0)
+        if not cfg.get("use_sliding_window", True):
+            window = 0
         max_len = cfg.get("max_position_embeddings", 4096)
         if window:
             # exact-serving gate: local and global attention coincide only
@@ -109,6 +147,7 @@ class ModelConfig:
             max_len = min(max_len, window)
         return ModelConfig(
             qkv_bias=qkv_bias,
+            qk_norm=qk_norm,
             name=name or cfg.get("_name_or_path", "hf-model"),
             architecture=arch,
             vocab_size=cfg["vocab_size"],
@@ -117,7 +156,8 @@ class ModelConfig:
             num_layers=cfg["num_hidden_layers"],
             num_heads=heads,
             num_kv_heads=cfg.get("num_key_value_heads", heads),
-            head_dim=cfg.get("head_dim", hidden // heads),
+            # some checkpoints write an explicit null here
+            head_dim=cfg.get("head_dim") or hidden // heads,
             rope_theta=cfg.get("rope_theta", 10000.0),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_model_len=max_len,
@@ -229,6 +269,46 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         embed_scale=True, post_norms=True, attn_logit_softcap=50.0,
         final_logit_softcap=30.0, query_scale=256.0 ** -0.5,
         sliding_window=4096, rms_norm_eps=1e-6,
+    ),
+    "tiny-mistral": ModelConfig(
+        name="tiny-mistral", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=32, max_model_len=512, sliding_window=512, dtype="float32",
+    ),
+    "mistral-7b-class": ModelConfig(
+        # Mistral-7B geometry; every layer windows at 4096, so the
+        # exactness gate serves max_model_len <= window
+        name="mistral-7b-class", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, rope_theta=10000.0, max_model_len=4096,
+        sliding_window=4096,
+    ),
+    "tiny-phi3": ModelConfig(
+        name="tiny-phi3", architecture="phi3", vocab_size=512,
+        hidden_size=128, intermediate_size=256, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=32, max_model_len=512, dtype="float32",
+    ),
+    "phi3-mini-class": ModelConfig(
+        # Phi-3-mini-4k geometry (fused HF qkv/gate_up layout, plain rope);
+        # every layer windows at 2047, so the exactness gate serves
+        # max_model_len <= window
+        name="phi3-mini-class", architecture="phi3", vocab_size=32064,
+        hidden_size=3072, intermediate_size=8192, num_layers=32,
+        num_heads=32, num_kv_heads=32, head_dim=96, max_model_len=2047,
+        sliding_window=2047,
+    ),
+    "tiny-qwen3": ModelConfig(
+        name="tiny-qwen3", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=32, max_model_len=512, qk_norm=True,
+        tie_word_embeddings=True, dtype="float32",
+    ),
+    "qwen3-8b-class": ModelConfig(
+        # Qwen3-8B geometry: QK-norm, no biases, head_dim 128 ≠ E/H
+        name="qwen3-8b-class", vocab_size=151936, hidden_size=4096,
+        intermediate_size=12288, num_layers=36, num_heads=32, num_kv_heads=8,
+        head_dim=128, rope_theta=1000000.0, max_model_len=32768,
+        qk_norm=True, rms_norm_eps=1e-6,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b", architecture="mixtral", vocab_size=32000, hidden_size=4096,

@@ -103,8 +103,10 @@ def init_random(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules, seed: int) -
 
 # --- HF checkpoint mapping (Llama/Mixtral family) ---------------------------
 
-def _hf_key_map(cfg: ModelConfig, i: int) -> dict[str, tuple[str, str]]:
-    """HF tensor name → (our layer param name, reshape rule) for layer i."""
+def _hf_key_map(cfg: ModelConfig, i: int) -> dict:
+    """HF tensor name → (our layer param name, reshape rule) for layer i.
+    A value may also be a LIST of (name, rule) pairs when one HF tensor
+    feeds several of our params (Phi-3's fused projections)."""
     m = {
         f"model.layers.{i}.input_layernorm.weight": ("attn_norm", "copy"),
         f"model.layers.{i}.self_attn.q_proj.weight": ("wq", "proj_q"),
@@ -113,6 +115,23 @@ def _hf_key_map(cfg: ModelConfig, i: int) -> dict[str, tuple[str, str]]:
         f"model.layers.{i}.self_attn.o_proj.weight": ("wo", "proj_o"),
         f"model.layers.{i}.post_attention_layernorm.weight": ("mlp_norm", "copy"),
     }
+    if cfg.architecture == "phi3":
+        # fused layouts: qkv_proj rows are [q | k | v], gate_up_proj rows
+        # are [gate | up] (reference models: HF Phi3ForCausalLM)
+        for key in (f"model.layers.{i}.self_attn.q_proj.weight",
+                    f"model.layers.{i}.self_attn.k_proj.weight",
+                    f"model.layers.{i}.self_attn.v_proj.weight"):
+            del m[key]
+        m[f"model.layers.{i}.self_attn.qkv_proj.weight"] = [
+            ("wq", "fused_q"), ("wk", "fused_k"), ("wv", "fused_v"),
+        ]
+        m[f"model.layers.{i}.mlp.gate_up_proj.weight"] = [
+            ("w_gate", "fused_gate"), ("w_up", "fused_up"),
+        ]
+        m[f"model.layers.{i}.mlp.down_proj.weight"] = ("w_down", "t")
+    if cfg.qk_norm:  # Qwen3
+        m[f"model.layers.{i}.self_attn.q_norm.weight"] = ("q_norm", "copy")
+        m[f"model.layers.{i}.self_attn.k_norm.weight"] = ("k_norm", "copy")
     if cfg.post_norms:
         # Gemma-2 block: HF "post_attention_layernorm" is the norm on the
         # ATTENTION OUTPUT (our post_attn_norm); the pre-MLP norm is
@@ -134,7 +153,7 @@ def _hf_key_map(cfg: ModelConfig, i: int) -> dict[str, tuple[str, str]]:
             m[f"model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight"] = (f"w_gate.{x}", "t")
             m[f"model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight"] = (f"w_up.{x}", "t")
             m[f"model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight"] = (f"w_down.{x}", "t")
-    else:
+    elif cfg.architecture != "phi3":  # phi3's MLP keys are set above
         m[f"model.layers.{i}.mlp.gate_proj.weight"] = ("w_gate", "t")
         m[f"model.layers.{i}.mlp.up_proj.weight"] = ("w_up", "t")
         m[f"model.layers.{i}.mlp.down_proj.weight"] = ("w_down", "t")
@@ -157,6 +176,17 @@ def _convert(name_rule: str, w: np.ndarray, cfg: ModelConfig) -> np.ndarray:
         return w.reshape(H, D)
     if name_rule == "bias_kv":  # (KH*D,) -> (KH, D)
         return w.reshape(KH, D)
+    # Phi-3 fused layouts: qkv_proj rows [q | k | v], gate_up [gate | up]
+    if name_rule == "fused_q":
+        return _convert("proj_q", w[: H * D], cfg)
+    if name_rule == "fused_k":
+        return _convert("proj_kv", w[H * D : H * D + KH * D], cfg)
+    if name_rule == "fused_v":
+        return _convert("proj_kv", w[H * D + KH * D :], cfg)
+    if name_rule == "fused_gate":
+        return w[: w.shape[0] // 2].T
+    if name_rule == "fused_up":
+        return w[w.shape[0] // 2 :].T
     raise ValueError(name_rule)
 
 
@@ -196,13 +226,17 @@ def load_safetensors(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules) -> dict
     layers: dict[str, list] = {}
     for i in range(cfg.num_layers):
         per_expert: dict[str, list] = {}
-        for hf_name, (ours, rule) in _hf_key_map(cfg, i).items():
-            w = _convert(rule, get(hf_name), cfg)
-            if "." in ours:  # expert weights collected then stacked
-                base, xi = ours.split(".")
-                per_expert.setdefault(base, []).append((int(xi), w))
-            else:
-                layers.setdefault(ours, []).append(w)
+        for hf_name, targets in _hf_key_map(cfg, i).items():
+            if isinstance(targets, tuple):
+                targets = [targets]
+            src = get(hf_name)
+            for ours, rule in targets:
+                w = _convert(rule, src, cfg)
+                if "." in ours:  # expert weights collected then stacked
+                    base, xi = ours.split(".")
+                    per_expert.setdefault(base, []).append((int(xi), w))
+                else:
+                    layers.setdefault(ours, []).append(w)
         for base, items in per_expert.items():
             items.sort()
             layers.setdefault(base, []).append(np.stack([w for _, w in items]))

@@ -66,6 +66,13 @@ def param_specs(cfg: ModelConfig) -> dict:
                 "bv": (L.LAYERS, L.KV_HEADS, L.HEAD_DIM),
             }
         )
+    if cfg.qk_norm:  # Qwen3 family: per-head q/k RMSNorm over head_dim
+        layer.update(
+            {
+                "q_norm": (L.LAYERS, L.HEAD_DIM),
+                "k_norm": (L.LAYERS, L.HEAD_DIM),
+            }
+        )
     if cfg.post_norms:  # Gemma-2: norms on the attn/MLP outputs too
         layer.update(
             {
@@ -135,6 +142,13 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
                 "bq": normal(keys[10], (Ln, H, D), E),
                 "bk": normal(keys[11], (Ln, KH, D), E),
                 "bv": normal(keys[12], (Ln, KH, D), E),
+            }
+        )
+    if cfg.qk_norm:
+        layers.update(
+            {
+                "q_norm": jnp.full((Ln, D), norm_one, dt),
+                "k_norm": jnp.full((Ln, D), norm_one, dt),
             }
         )
     if cfg.post_norms:
@@ -333,6 +347,9 @@ def forward_hidden(
             q = q + lp["bq"]
             k = k + lp["bk"]
             v = v + lp["bv"]
+        if cfg.qk_norm:  # Qwen3: per-head RMSNorm over head_dim, pre-rope
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         if cfg.query_scale:
             # fold a non-default score scale (Gemma-2 query_pre_attn_scalar)
             # into q: attention impls keep their head_dim**-0.5

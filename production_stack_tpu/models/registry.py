@@ -21,6 +21,9 @@ _REGISTRY: dict[str, ModuleType] = {
     # softcaps / post-norms are ModelConfig knobs inside the layer code
     "gemma": llama,
     "gemma2": llama,
+    # Phi-3 is the Llama stack too; only its HF checkpoint layout differs
+    # (fused qkv_proj / gate_up_proj, split at load in engine/weights.py)
+    "phi3": llama,
 }
 
 
