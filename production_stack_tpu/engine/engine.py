@@ -33,6 +33,16 @@ from production_stack_tpu.engine.tokenizer import get_tokenizer
 from production_stack_tpu.parallel.mesh import build_mesh
 
 
+def _lp_row(lp: tuple, i: int):
+    """One token's logprob entry from fetched (tok_lp, ids, lps) arrays:
+    (token_logprob, [(token_id, logprob) * top-N])."""
+    tok_lp, ids, lps = lp
+    return (
+        float(tok_lp[i]),
+        [(int(t), float(v)) for t, v in zip(ids[i], lps[i])],
+    )
+
+
 class LLMEngine:
     def __init__(
         self,
@@ -164,6 +174,17 @@ class LLMEngine:
         sampling = (sampling or SamplingParams()).clamped(
             self.config.model.max_model_len, len(prompt_token_ids)
         )
+        if sampling.logprobs is not None:
+            from production_stack_tpu.engine.sampling import MAX_LOGPROBS
+
+            if not getattr(self.runner, "supports_logprobs", False):
+                raise ValueError(
+                    "logprobs are not supported with pipeline parallelism"
+                )
+            if not 0 <= sampling.logprobs <= MAX_LOGPROBS:
+                raise ValueError(
+                    f"logprobs must be in [0, {MAX_LOGPROBS}]"
+                )
         if sampling.seed is None:
             # unseeded sampling must be nondeterministic (OpenAI/vLLM
             # semantics): identical concurrent prompts must not draw the
@@ -230,6 +251,7 @@ class LLMEngine:
             and not s.sampling.presence_penalty
             and not s.sampling.frequency_penalty
             and s.token_ctrl is None
+            and s.sampling.logprobs is None  # verify emits argmax only
             for s in decodes
         )
 
@@ -320,10 +342,14 @@ class LLMEngine:
         """Fetch + postprocess the previous prefill dispatch (if any)."""
         if self._pending_prefill is None:
             return []
-        prefills, sampled_dev = self._pending_prefill
+        prefills, result_dev = self._pending_prefill
         self._pending_prefill = None
-        sampled = np.asarray(jax.device_get(sampled_dev))
-        return self._finish_prefill(prefills, sampled)
+        fetched = jax.device_get(result_dev)
+        if isinstance(fetched, (tuple, list)):  # (sampled, *logprob arrays)
+            fetched = tuple(np.asarray(x) for x in fetched)
+        else:  # staged PP runner: bare sampled tokens
+            fetched = (np.asarray(fetched),)
+        return self._finish_prefill(prefills, fetched)
 
     # -- host-DRAM KV tier (see engine/kv_offload.py) ------------------------
     def _host_extend_seq(self, seq: Sequence) -> None:
@@ -408,7 +434,7 @@ class LLMEngine:
         slot_mapping = np.full(S, -1, np.int32)
         slot_mapping[:n] = slot_mapping_for(seq.block_ids, 0, n, bs)
         s = seq.sampling
-        sampled = self.runner.prefill_ring(
+        result = self.runner.prefill_ring(
             tokens, positions, slot_mapping,
             np.asarray([n - 1], np.int32),
             np.asarray([s.temperature], np.float32),
@@ -431,11 +457,15 @@ class LLMEngine:
             self._count_reset_slots.append(seq)
         if seq.output_token_ids:
             return []  # preemption-recompute: newest token still pending
-        token = int(sampled[0])
+        token = int(result[0][0])
         seq.first_token_time = time.monotonic()
         seq.output_token_ids.append(token)
         self.total_output_tokens += 1
-        return self._postprocess([seq], [[token]])
+        lp_lists = (
+            [[_lp_row(result[1:], 0)]]
+            if seq.sampling.logprobs is not None else [None]
+        )
+        return self._postprocess([seq], [[token]], lp_lists)
 
     def _run_prefill(self, prefills: list) -> list[RequestOutput]:
         if prefills[0].ring:
@@ -535,8 +565,10 @@ class LLMEngine:
         self._pending_prefill = (resolve_list, sampled_dev)
         return outputs
 
-    def _finish_prefill(self, resolve_list, sampled) -> list[RequestOutput]:
-        finished_prompts, first_tokens = [], []
+    def _finish_prefill(self, resolve_list, fetched) -> list[RequestOutput]:
+        sampled = fetched[0]
+        lp = fetched[1:] if len(fetched) > 1 else None
+        finished_prompts, first_tokens, lp_lists = [], [], []
         for i, seq in resolve_list:
             if seq.status.is_finished:
                 continue  # aborted while the dispatch was in flight
@@ -546,13 +578,23 @@ class LLMEngine:
             self.total_output_tokens += 1
             finished_prompts.append(seq)
             first_tokens.append([token])
-        return self._postprocess(finished_prompts, first_tokens)
+            lp_lists.append(
+                [_lp_row(lp, i)]
+                if lp is not None and seq.sampling.logprobs is not None
+                else None
+            )
+        return self._postprocess(finished_prompts, first_tokens, lp_lists)
 
     def _run_decode(self, decodes: list[Sequence]) -> list[RequestOutput]:
         bs = self.config.cache.block_size
         outputs: list[RequestOutput] = []
+        use_logprobs = (
+            getattr(self.runner, "supports_logprobs", False)
+            and any(s.sampling.logprobs is not None for s in decodes)
+        )
         can_chain = (self.config.scheduler.chain_decode
-                     and getattr(self.runner, "supports_chaining", False))
+                     and getattr(self.runner, "supports_chaining", False)
+                     and not use_logprobs)  # chained results stay on device
         pending = self._pending_decode
         if pending is not None:
             # identity check on request ids, not slots: a freed slot can
@@ -627,6 +669,7 @@ class LLMEngine:
                   if use_controls else None),
             tokens_dev=(pending["next_tok"] if chain else None),
             fetch=not can_chain,
+            want_logprobs=use_logprobs,
         )
         if can_chain:
             sampled, next_tok = result
@@ -647,11 +690,12 @@ class LLMEngine:
                 # this one is in flight
                 outputs.extend(self._finish_decode(pending))
             return outputs
-        outputs.extend(self._finish_decode(
-            {"decodes": decodes, "slots": [s.slot for s in decodes],
-             "sampled": result},
-            fetched=True, advance=True,
-        ))
+        pend = {"decodes": decodes, "slots": [s.slot for s in decodes]}
+        if use_logprobs:
+            pend["sampled"], pend["lp"] = result[0], result[1:]
+        else:
+            pend["sampled"] = result
+        outputs.extend(self._finish_decode(pend, fetched=True, advance=True))
         return outputs
 
     def _resolve_pending_decode(self) -> list[RequestOutput]:
@@ -670,30 +714,40 @@ class LLMEngine:
         sampled = pending["sampled"]
         if not fetched:
             sampled = np.asarray(jax.device_get(sampled))
+        lp = pending.get("lp")  # (tok_lp (K, B), ids (K, B, N), lps ...)
         token_lists = []
+        lp_lists = []
         live = []
         for seq, slot in zip(pending["decodes"], pending["slots"]):
             if seq.status.is_finished:
                 continue  # aborted while in flight; surplus tokens dropped
+            want_lp = lp is not None and seq.sampling.logprobs is not None
             new_toks = []
+            new_lps = [] if want_lp else None
             for k in range(sampled.shape[0]):
                 t = int(sampled[k, slot])
                 if advance:
                     seq.num_computed_tokens += 1
                 seq.output_token_ids.append(t)
                 new_toks.append(t)
+                if want_lp:
+                    new_lps.append(
+                        _lp_row((lp[0][k], lp[1][k], lp[2][k]), slot)
+                    )
                 self.total_output_tokens += 1
                 if self._check_stop(seq, t) is not None:
                     break
             live.append(seq)
             token_lists.append(new_toks)
-        return self._postprocess(live, token_lists)
+            lp_lists.append(new_lps)
+        return self._postprocess(live, token_lists, lp_lists)
 
     def _postprocess(
-        self, seqs: list[Sequence], token_lists: list[list[int]]
+        self, seqs: list[Sequence], token_lists: list[list[int]],
+        lp_lists: Optional[list] = None,
     ) -> list[RequestOutput]:
         outputs = []
-        for seq, toks in zip(seqs, token_lists):
+        for j, (seq, toks) in enumerate(zip(seqs, token_lists)):
             status = self._check_stop(seq, toks[-1]) if toks else None
             if status is not None:
                 if self.host_kv is not None or self.remote_kv is not None:
@@ -712,6 +766,8 @@ class LLMEngine:
                     num_cached_tokens=seq.num_cached_tokens,
                     block_ids=(seq.released_block_ids if status is not None
                                else None),
+                    new_logprobs=(lp_lists[j] if lp_lists is not None
+                                  else None),
                 )
             )
         return outputs
@@ -931,6 +987,23 @@ class LLMEngine:
                 np.zeros(B, np.int32),
                 np.full(B * S, -1, np.int32),
             )
+        # logprob decode variants (static want_logprobs flag), greedy and
+        # sampled; the prefill program carries logprobs unconditionally so
+        # no per-bucket variant exists. Combinations with penalties/
+        # controls compile lazily if ever used (same tradeoff as the
+        # penalties x controls cross). The staged PP runner has no logprob
+        # programs (add_request rejects such requests there).
+        for temp in ((0.0, 0.7)
+                     if getattr(self.runner, "supports_logprobs", False)
+                     else ()):
+            sp = SamplingParams(temperature=temp, logprobs=5,
+                                max_tokens=max(sched.multi_step, 1) + 1,
+                                ignore_eos=True)
+            self.add_request(f"warmup-lp-{time.monotonic_ns()}",
+                             prompt_token_ids=rng.integers(1, vocab, 8).tolist(),
+                             sampling=sp)
+            while self.has_unfinished():
+                self.step()
         # penalised decode variant (static use_penalties flag)
         sp = SamplingParams(temperature=0.0, presence_penalty=0.5,
                             max_tokens=max(sched.multi_step, 1) + 1,

@@ -113,7 +113,7 @@ class ModelRunner:
             ),
             donate_argnums=(1,),
             static_argnames=("block_size", "greedy_only", "use_penalties",
-                             "use_controls"),
+                             "use_controls", "want_logprobs"),
         )
         self._sample = jax.jit(sample_tokens)
         if config.scheduler.spec_ngram_k > 0:
@@ -321,10 +321,13 @@ class ModelRunner:
         ``fetch=False``, the un-fetched device array so the caller can
         overlap the next dispatch with this one's compute + result fetch
         (JAX dispatch is async; the engine defers the device_get one step,
-        hiding the per-dispatch round trip — docs/roofline.md)."""
+        hiding the per-dispatch round trip — docs/roofline.md).
+
+        Returns (sampled (P,), tok_lp (P,), top_ids (P, N), top_lps (P, N))
+        — logprobs ride every prefill (see _prefill_step)."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
         with jax.set_mesh(self.mesh):
-            self.kv, sampled = self._prefill(
+            self.kv, result = self._prefill(
                 self.params, self.kv,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(block_tables), jnp.asarray(context_lens),
@@ -340,8 +343,8 @@ class ModelRunner:
                 use_controls=ctrl is not None,
             )
         if not fetch:
-            return sampled
-        return np.asarray(jax.device_get(sampled))
+            return result
+        return tuple(np.asarray(x) for x in jax.device_get(result))
 
     def prefill_ring(self, tokens: np.ndarray, positions: np.ndarray,
                      slot_mapping: np.ndarray, last_idx: np.ndarray,
@@ -358,7 +361,7 @@ class ModelRunner:
         S x S score matrix on one device — K/V shards rotate the ring."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
         with jax.set_mesh(self.mesh):
-            self.kv, sampled = self._prefill_ring(
+            self.kv, result = self._prefill_ring(
                 self.params, self.kv,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(slot_mapping), jnp.asarray(last_idx),
@@ -372,7 +375,7 @@ class ModelRunner:
                 greedy_only=greedy_only,
                 use_controls=ctrl is not None,
             )
-        return np.asarray(jax.device_get(sampled))
+        return tuple(np.asarray(x) for x in jax.device_get(result))
 
     def verify(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray, context_lens: np.ndarray,
@@ -439,13 +442,15 @@ class ModelRunner:
 
     supports_chaining = True  # device-resident token chaining across
     # dispatches (the staged PP runner relays through the host instead)
+    supports_logprobs = True  # prefill/decode programs emit logprobs
+    # (the staged PP runner's per-stage programs don't — server 400s)
 
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
                      greedy_only: bool = False,
                      presence=None, frequency=None,
                      adapter_ids=None, ctrl=None, tokens_dev=None,
-                     fetch: bool = True):
+                     fetch: bool = True, want_logprobs: bool = False):
         """multi_step fused decode+sample iterations; returns sampled tokens
         (num_steps, B) on host — or the un-fetched device array with
         ``fetch=False`` so the next dispatch overlaps this one's compute
@@ -488,7 +493,7 @@ class ModelRunner:
         tok_in = (tokens_dev if tokens_dev is not None
                   else jnp.asarray(tokens[:, None]))
         with jax.set_mesh(self.mesh):
-            (self.kv, new_counts), (sampled, next_tok) = self._decode_multi(
+            (self.kv, new_counts), (sampled, next_tok, *lp) = self._decode_multi(
                 self.params, self.kv,
                 tok_in, jnp.asarray(positions[:, None]),
                 jnp.asarray(block_tables), jnp.asarray(context_lens),
@@ -504,11 +509,15 @@ class ModelRunner:
                 greedy_only=greedy_only,
                 use_penalties=use_penalties,
                 use_controls=ctrl is not None,
+                want_logprobs=want_logprobs,
             )
         if use_penalties:
             self.token_counts = new_counts
         if not fetch:
-            return sampled, next_tok
+            return sampled, next_tok  # chain path never carries logprobs
+        if want_logprobs:
+            # (sampled (K, B), tok_lp (K, B), ids (K, B, N), lps (K, B, N))
+            return tuple(np.asarray(x) for x in jax.device_get((sampled, *lp)))
         return np.asarray(jax.device_get(sampled))
 
     # -- sleep mode hooks ----------------------------------------------------
@@ -773,6 +782,7 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
         hidden, last_idx[:, None, None], axis=1
     )[:, 0]  # (P, E)
     logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    raw_logits = logits  # logprobs report the raw model distribution
     if use_controls:
         from production_stack_tpu.engine.sampling import apply_token_controls
 
@@ -784,7 +794,12 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
             logits, temps, top_ps, top_ks, seeds,
             jnp.zeros_like(last_idx),
         )
-    return new_kv, sampled
+    # logprobs ride every prefill dispatch (one (P, V) top-k — noise next
+    # to the chunk forward) so no per-bucket logprob compile variant exists
+    from production_stack_tpu.engine.sampling import compute_logprobs
+
+    lp = compute_logprobs(raw_logits, sampled)
+    return new_kv, (sampled, *lp)
 
 
 def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
@@ -823,6 +838,7 @@ def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
         hidden, last_idx[:, None, None], axis=1
     )[:, 0]  # (1, E)
     logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    raw_logits = logits  # logprobs report the raw model distribution
     if use_controls:
         from production_stack_tpu.engine.sampling import apply_token_controls
 
@@ -833,7 +849,10 @@ def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
         sampled = sample_tokens(
             logits, temps, top_ps, top_ks, seeds, jnp.zeros_like(last_idx)
         )
-    return new_kv, sampled
+    from production_stack_tpu.engine.sampling import compute_logprobs
+
+    lp = compute_logprobs(raw_logits, sampled)
+    return new_kv, (sampled, *lp)
 
 
 def _verify_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
@@ -896,7 +915,8 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
                        lora_bank=None, adapter_ids=None, ctrl=None, *,
                        block_size: int, greedy_only: bool = False,
                        use_penalties: bool = False,
-                       use_controls: bool = False):
+                       use_controls: bool = False,
+                       want_logprobs: bool = False):
     """``num_steps`` fused decode+sample iterations in ONE dispatch.
 
     The token sampled at iteration i feeds iteration i+1 entirely on device;
@@ -923,6 +943,7 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             lora=_make_lora(lora_bank, adapter_ids, 1),
         )
         logits = model.logits_from_hidden(cfg, params, hidden)[:, 0]
+        raw_logits = logits  # logprobs report the raw model distribution
         if use_penalties:
             from production_stack_tpu.engine.sampling import penalize_logits
 
@@ -937,11 +958,15 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
             sampled = sample_tokens(logits, temps, top_ps, top_ks, seeds, step_ctr)
-        return kv, sampled
+        if want_logprobs:
+            from production_stack_tpu.engine.sampling import compute_logprobs
+
+            return kv, (sampled, *compute_logprobs(raw_logits, sampled))
+        return kv, (sampled,)
 
     def body(carry, _):
         kv, tok, pos, ctx, slots, step_ctr, counts = carry
-        kv, sampled = one(kv, tok, pos, ctx, slots, step_ctr, counts)
+        kv, (sampled, *lp) = one(kv, tok, pos, ctx, slots, step_ctr, counts)
         new_pos = jnp.where(active, pos + 1, pos)
         new_ctx = jnp.where(active, ctx + 1, ctx)
         block = block_tables[jnp.arange(B), jnp.clip(new_pos, 0, None) // block_size]
@@ -958,15 +983,20 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             counts = counts.at[jnp.arange(B), sampled].add(
                 active.astype(counts.dtype)
             )
-        return (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1, counts), sampled
+        return (
+            (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1, counts),
+            (sampled, *lp),
+        )
 
     init = (kv, tokens[:, 0], positions[:, 0], context_lens, slot_mapping,
             steps, token_counts)
-    (kv, _, _, _, _, _, counts), sampled = jax.lax.scan(
+    (kv, _, _, _, _, _, counts), (sampled, *lp) = jax.lax.scan(
         body, init, None, length=num_steps
     )
     # next_tok comes out of the SAME program: an eager slice on the result
     # would cost extra dispatches (each one a full round trip on a
     # tunneled device) on the chained-decode hot path
     next_tok = sampled[-1][:, None]  # (B, 1) input for a chained dispatch
-    return (kv, counts), (sampled, next_tok)  # sampled: (num_steps, B)
+    # sampled: (num_steps, B); lp (when requested): tok_lp (K, B),
+    # top_ids (K, B, N), top_lps (K, B, N)
+    return (kv, counts), (sampled, next_tok, *lp)

@@ -205,13 +205,15 @@ class StagedModelRunner:
         return np.asarray(jax.device_get(x))
 
     supports_chaining = False  # stages relay through the host each step
+    supports_logprobs = False  # per-stage programs emit sampled tokens only
 
     def decode_multi(self, tokens, positions, block_tables, context_lens,
                      slot_mapping, temps, top_ps, top_ks, seeds, steps,
                      greedy_only: bool = False,
                      presence=None, frequency=None,
                      adapter_ids=None, ctrl=None, tokens_dev=None,
-                     fetch: bool = True) -> np.ndarray:
+                     fetch: bool = True,
+                     want_logprobs: bool = False) -> np.ndarray:
         """K single decode steps, each relayed through the stages. The host
         advances positions/slots between steps (the sampled token must come
         back to stage 0, so cross-step fusion can't live in one program)."""

@@ -158,6 +158,27 @@ def sample_tokens(
     return jnp.where(temperatures <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+MAX_LOGPROBS = 20  # OpenAI top_logprobs cap; device returns this many and
+# the server slices each request's asked-for count
+
+
+def compute_logprobs(logits: jnp.ndarray, sampled: jnp.ndarray):
+    """Sampled-token logprob + top-MAX_LOGPROBS (ids, logprobs) per row.
+
+    Callers pass the RAW model logits — before penalties, token controls
+    and temperature (vLLM V1 semantics: logprobs report the model's
+    distribution, not the post-processed one actually sampled from).
+    logits (B, V) f32, sampled (B,) i32 →
+    (tok_lp (B,), top_ids (B, N) i32, top_lps (B, N))."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # (B,)
+    tok_lp = (
+        jnp.take_along_axis(logits, sampled[:, None], axis=-1)[:, 0] - lse
+    )
+    n = min(MAX_LOGPROBS, logits.shape[-1])
+    top_vals, top_ids = jax.lax.top_k(logits, n)
+    return tok_lp, top_ids.astype(jnp.int32), top_vals - lse[:, None]
+
+
 def penalize_logits(
     logits: jnp.ndarray,  # (B, V)
     output_counts: jnp.ndarray,  # (B, V) int32 — token counts in output so far

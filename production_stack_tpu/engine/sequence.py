@@ -96,3 +96,7 @@ class RequestOutput:
     num_output_tokens: int
     num_cached_tokens: int = 0
     block_ids: Optional[list[int]] = None  # set on finish (KV export handle)
+    # aligned with new_token_ids when the request asked for logprobs: each
+    # entry is (token_logprob, [(token_id, logprob), ...] top-N) — the
+    # server slices top-N down to the request's asked-for count
+    new_logprobs: Optional[list] = None

@@ -63,6 +63,77 @@ def _sampling_from_body(body: dict) -> SamplingParams:
     )
 
 
+def _parse_logprobs(body: dict, chat: bool) -> Optional[int]:
+    """OpenAI logprobs knobs → the engine's single count.
+
+    chat: ``logprobs: true`` (+ ``top_logprobs: 0..20``); completions:
+    ``logprobs: <int 0..20>`` (OpenAI caps 5; we allow the device limit).
+    Returns the top-N count, or None when logprobs weren't requested."""
+    from production_stack_tpu.engine.sampling import MAX_LOGPROBS
+
+    if chat:
+        if not body.get("logprobs"):
+            return None
+        top = body.get("top_logprobs")
+        top = int(top) if top is not None else 0
+        if not 0 <= top <= MAX_LOGPROBS:
+            raise ValueError(f"top_logprobs must be in [0, {MAX_LOGPROBS}]")
+        return top
+    raw = body.get("logprobs")
+    if raw is None or raw is False:
+        return None
+    if raw is True:
+        raise ValueError(
+            "completions logprobs must be an integer count; the boolean "
+            "form belongs to /v1/chat/completions"
+        )
+    n = int(raw)
+    if not 0 <= n <= MAX_LOGPROBS:
+        raise ValueError(f"logprobs must be in [0, {MAX_LOGPROBS}]")
+    return n
+
+
+def _fmt_chat_logprobs(tk, token_ids: list, lps: list, n_top: int) -> dict:
+    """OpenAI chat logprobs shape for one span of tokens."""
+    content = []
+    for t, (lp, top) in zip(token_ids, lps):
+        s = tk.decode([t])
+        content.append({
+            "token": s, "logprob": lp, "bytes": list(s.encode()),
+            "top_logprobs": [
+                {"token": tk.decode([tid]), "logprob": v,
+                 "bytes": list(tk.decode([tid]).encode())}
+                for tid, v in top[:n_top]
+            ],
+        })
+    return {"content": content}
+
+
+def _fmt_completion_logprobs(tk, token_ids: list, lps: list, n_top: int,
+                             offset0: int = 0) -> dict:
+    """OpenAI completions logprobs shape (tokens / token_logprobs /
+    top_logprobs / text_offset)."""
+    tokens, tlps, tops, offsets = [], [], [], []
+    off = offset0
+    for t, (lp, top) in zip(token_ids, lps):
+        s = tk.decode([t])
+        tokens.append(s)
+        tlps.append(lp)
+        offsets.append(off)
+        off += len(s)
+        if n_top:
+            # dict keyed by token string (OpenAI shape): distinct ids can
+            # decode to the same string — the highest-ranked keeps the key
+            d: dict = {}
+            for tid, v in top[:n_top]:
+                d.setdefault(tk.decode([tid]), v)
+            tops.append(d)
+        else:
+            tops.append(None)
+    return {"tokens": tokens, "token_logprobs": tlps, "top_logprobs": tops,
+            "text_offset": offsets}
+
+
 def _parse_logit_bias(raw) -> Optional[dict]:
     if not raw:
         return None
@@ -891,6 +962,9 @@ class EngineServer:
                    chat: bool) -> web.StreamResponse:
         try:
             sampling = _sampling_from_body(body)
+            lp_n = _parse_logprobs(body, chat)
+            if lp_n is not None:
+                sampling = dataclasses.replace(sampling, logprobs=lp_n)
             # validate token controls HERE (the engine recomputes them in
             # add_request, after this handler has already committed to a
             # stream) so bad ids/overflow become a 400, not a mid-stream 500
@@ -898,6 +972,15 @@ class EngineServer:
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid sampling parameter: {e}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        if (sampling.logprobs is not None
+                and not getattr(self.engine.runner, "supports_logprobs",
+                                False)):
+            return web.json_response(
+                {"error": {"message": "logprobs are not supported with "
+                           "pipeline parallelism",
                            "type": "invalid_request_error"}},
                 status=400,
             )
@@ -1008,6 +1091,7 @@ class EngineServer:
 
         async def collect(gen, crid):
             token_ids: list[int] = []
+            lps: list = []
             finish_reason = None
             first_token_t = None
             cached = 0
@@ -1016,6 +1100,8 @@ class EngineServer:
                 if first_token_t is None:
                     first_token_t = time.monotonic()
                 token_ids.extend(out.new_token_ids)
+                if out.new_logprobs:
+                    lps.extend(out.new_logprobs)
                 cached = out.num_cached_tokens
                 if out.block_ids is not None:
                     final_blocks = out.block_ids
@@ -1027,9 +1113,9 @@ class EngineServer:
                     # count only the tokens that contribute to the kept text
                     n_kept = _tokens_covering(tk, token_ids, len(stopped))
                     return (stopped, n_kept, "stop", first_token_t, cached,
-                            final_blocks)
+                            final_blocks, token_ids[:n_kept], lps[:n_kept])
             return (tk.decode(token_ids), len(token_ids), finish_reason,
-                    first_token_t, cached, final_blocks)
+                    first_token_t, cached, final_blocks, token_ids, lps)
 
         tasks = [asyncio.ensure_future(collect(g, r))
                  for g, r in zip(gens, rids)]
@@ -1061,19 +1147,31 @@ class EngineServer:
             "prompt_tokens_details": {"cached_tokens": cached},
         }
         choices = []
-        for idx, (text, _n, finish_reason, _t, _c, _b) in enumerate(results):
+        want_lp = sampling.logprobs is not None
+        for idx, (text, _n, finish_reason, _t, _c, _b, ids, lps) in enumerate(
+            results
+        ):
             if chat:
-                choices.append({
+                choice = {
                     "index": idx,
                     "message": {"role": "assistant", "content": text},
                     "finish_reason": finish_reason or "stop",
-                })
+                }
+                if want_lp:
+                    choice["logprobs"] = _fmt_chat_logprobs(
+                        tk, ids, lps, sampling.logprobs
+                    )
+                choices.append(choice)
             else:
                 choices.append({
                     "index": idx,
                     "text": text,
                     "finish_reason": finish_reason or "stop",
-                    "logprobs": None,
+                    "logprobs": (
+                        _fmt_completion_logprobs(tk, ids, lps,
+                                                 sampling.logprobs)
+                        if want_lp else None
+                    ),
                 })
         obj = "chat.completion" if chat else "text_completion"
         payload = {
@@ -1240,8 +1338,12 @@ class EngineServer:
         holdback = max((len(s) for s in sampling.stop), default=1) - 1
         shared = {"first_token_t": None}
 
+        want_lp = sampling.logprobs is not None
+
         async def stream_one(gen, crid, idx) -> int:
             token_ids: list[int] = []
+            all_lps: list = []
+            lp_emitted = 0
             sent_len = 0
             finish_reason = None
             n_kept = 0
@@ -1249,6 +1351,8 @@ class EngineServer:
                 if shared["first_token_t"] is None:
                     shared["first_token_t"] = time.monotonic()
                 token_ids.extend(out.new_token_ids)
+                if out.new_logprobs:
+                    all_lps.extend(out.new_logprobs)
                 text = tk.decode(token_ids)
                 stopped = self._check_stop_str(text, sampling)
                 if stopped is not None:
@@ -1265,13 +1369,39 @@ class EngineServer:
                 sent_len = limit
                 if delta or done:
                     fr = finish_reason or out.finish_reason
+                    # chunk logprobs cover tokens whose text is FULLY sent:
+                    # the stop-string holdback must gate entries too, or a
+                    # token later cut by the stop leaks its string/logprob
+                    chunk_lp = None
+                    if want_lp:
+                        m = _tokens_covering(tk, token_ids, sent_len)
+                        if (m and
+                                len(tk.decode(token_ids[:m])) > sent_len):
+                            m -= 1  # last token's text not fully sent yet
+                        hi = min(n_kept, len(all_lps), m)
+                        if lp_emitted < hi:
+                            span = token_ids[lp_emitted:hi]
+                            span_lps = all_lps[lp_emitted:hi]
+                            if chat:
+                                chunk_lp = _fmt_chat_logprobs(
+                                    tk, span, span_lps, sampling.logprobs
+                                )
+                            else:
+                                off = len(tk.decode(token_ids[:lp_emitted]))
+                                chunk_lp = _fmt_completion_logprobs(
+                                    tk, span, span_lps, sampling.logprobs,
+                                    offset0=off,
+                                )
+                            lp_emitted = hi
                     if chat:
                         choice = {"index": idx,
                                   "delta": {"content": delta} if delta else {},
                                   "finish_reason": fr if done else None}
+                        if want_lp:
+                            choice["logprobs"] = chunk_lp
                     else:
                         choice = {"index": idx, "text": delta,
-                                  "logprobs": None,
+                                  "logprobs": chunk_lp,
                                   "finish_reason": fr if done else None}
                     await send(
                         {"id": rid, "object": obj, "created": created,

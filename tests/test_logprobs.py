@@ -1,0 +1,200 @@
+"""OpenAI logprobs surface: engine emission + server formatting, both
+endpoints, streaming and not. (The reference's engines get logprobs from
+vLLM; here the fused decode/prefill programs emit them on request —
+engine/sampling.py compute_logprobs.)"""
+
+import asyncio
+import json
+import math
+
+import pytest
+
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.server import EngineServer
+from production_stack_tpu.parallel.mesh import MeshConfig
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=512),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64,
+            prefill_buckets=(32, 64), multi_step=2,
+        ),
+        mesh=MeshConfig(data=1, tensor=1),
+    )
+    return EngineServer(cfg)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def with_client(server, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async with TestClient(TestServer(server.build_app())) as client:
+        return await fn(client)
+
+
+def test_completions_logprobs(server):
+    async def fn(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello world",
+            "max_tokens": 6, "temperature": 0, "logprobs": 3,
+            "ignore_eos": True,
+        })
+        assert r.status == 200
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == 6
+        assert len(lp["token_logprobs"]) == 6
+        assert len(lp["top_logprobs"]) == 6
+        assert len(lp["text_offset"]) == 6
+        # greedy: the chosen token's logprob equals the top-ranked entry
+        for s, tl, top in zip(lp["tokens"], lp["token_logprobs"],
+                              lp["top_logprobs"]):
+            # token strings can collide under the byte tokenizer (dict
+            # keyed by string; the highest-ranked entry keeps the key)
+            assert 1 <= len(top) <= 3
+            assert tl <= 0.0
+            assert max(top.values()) == pytest.approx(tl, abs=1e-5)
+            assert sum(math.exp(v) for v in top.values()) <= 1.0 + 1e-5
+        # offsets are cumulative over the concatenated token strings
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_completions_logprobs_zero_top(server):
+    async def fn(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "abc",
+            "max_tokens": 3, "temperature": 0, "logprobs": 0,
+            "ignore_eos": True,
+        })
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == 3
+        assert lp["top_logprobs"] == [None, None, None]
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_chat_logprobs(server):
+    async def fn(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0.8, "seed": 7,
+            "logprobs": True, "top_logprobs": 2, "ignore_eos": True,
+        })
+        assert r.status == 200
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert len(lp["content"]) == 4
+        for entry in lp["content"]:
+            assert set(entry) == {"token", "logprob", "bytes",
+                                  "top_logprobs"}
+            assert len(entry["top_logprobs"]) == 2
+            assert entry["logprob"] <= 0.0
+            assert isinstance(entry["bytes"], list)
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_chat_logprobs_streaming(server):
+    async def fn(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "stream me"}],
+            "max_tokens": 5, "temperature": 0, "stream": True,
+            "logprobs": True, "top_logprobs": 1, "ignore_eos": True,
+        })
+        assert r.status == 200
+        entries = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[6:])
+            for c in chunk.get("choices", []):
+                if c.get("logprobs"):
+                    entries.extend(c["logprobs"]["content"])
+        assert len(entries) == 5
+        assert all(e["logprob"] <= 0.0 for e in entries)
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_logprobs_validation(server):
+    async def fn(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "logprobs": 21,
+        })
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "x"}],
+            "logprobs": True, "top_logprobs": 99,
+        })
+        assert r.status == 400
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_logprobs_rejected_with_pipeline_parallelism():
+    """The staged runner has no logprob programs: requests must 400/raise
+    up-front, and warmup must not emit logprob requests there."""
+    import jax
+
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.sampling import SamplingParams
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=128),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=32,
+                                  prefill_buckets=(16, 32)),
+        mesh=MeshConfig(data=1, stage=2, tensor=1),
+    )
+    mesh = build_mesh(cfg.mesh, devices=jax.devices()[:2])
+    eng = LLMEngine(cfg, mesh=mesh, num_blocks=128)
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        eng.add_request("lp", prompt_token_ids=[1, 2, 3],
+                        sampling=SamplingParams(logprobs=2))
+    # plain requests still serve
+    out = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0,
+                                                   max_tokens=2,
+                                                   ignore_eos=True))
+    assert len(out["offline-0"]) == 2
+
+
+def test_logprobs_with_stop_string_truncation(server):
+    """Tokens discarded by a stop-string cut must not carry logprob
+    entries either."""
+    async def fn(client):
+        # byte tokenizer: every output token decodes to one char; pick a
+        # stop string we can't predict — instead assert alignment only
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "q", "max_tokens": 8,
+            "temperature": 0, "logprobs": 1, "ignore_eos": True,
+        })
+        body = await r.json()
+        lp = body["choices"][0]["logprobs"]
+        n = body["usage"]["completion_tokens"]
+        assert len(lp["tokens"]) == n == len(lp["token_logprobs"])
+        return True
+
+    assert run(with_client(server, fn))
