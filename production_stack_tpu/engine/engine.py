@@ -917,6 +917,21 @@ class LLMEngine:
             cont[i, p : p + len(c)] = True
         return self.runner.sequence_logprobs(tokens, cont)[:n].tolist()
 
+    def prompt_logprobs(self, prompt_token_ids: list[int]) -> list:
+        """Logprob entries for ``prompt_token_ids[1:]`` (teacher-forced;
+        token 0 has no prediction) — the completions ``echo`` +
+        ``logprobs`` surface. Entries use the same (lp, [(id, lp)..])
+        shape generation produces. Pads to a power of two so the dense
+        scoring program compiles per size class, like choice_logprobs."""
+        n = len(prompt_token_ids)
+        if n < 2:
+            return []
+        S = 1 << (n - 1).bit_length()
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, :n] = prompt_token_ids
+        tok_lps, ids, lps = self.runner.prompt_logprobs(tokens)
+        return [_lp_row((tok_lps, ids, lps), p) for p in range(n - 1)]
+
     def warmup(self) -> None:
         """Pre-compile every serving shape variant so no live request pays a
         compile: each prefill bucket at P=1, the P=prefill_batch variant,

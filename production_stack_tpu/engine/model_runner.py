@@ -633,6 +633,66 @@ class ModelRunner:
             )
         return np.asarray(jax.device_get(out))
 
+    # -- teacher-forced per-position prompt logprobs (completions echo) -----
+    def prompt_logprobs(self, tokens: np.ndarray):
+        """Per-position next-token logprobs of a prompt, teacher-forced in
+        one dense causal pass. tokens (1, S) 0-padded; returns
+        (tok_lps (S-1,), top_ids (S-1, N), top_lps (S-1, N)) where row p
+        describes position p's prediction of token p+1 (the raw model
+        distribution, same convention as generation logprobs). Rows at/past
+        the live length are garbage the caller slices off."""
+        if getattr(self, "_prompt_lp_fn", None) is None:
+            from production_stack_tpu.engine.sampling import compute_logprobs
+            from production_stack_tpu.ops.attention import (
+                dense_causal_attention,
+            )
+
+            model = self.model
+            cfg = self.cfg
+
+            def _score(params, tokens):
+                def attend(q, k, v, caches, layer_idx):
+                    return dense_causal_attention(
+                        q, k, v, soft_cap=cfg.attn_logit_softcap
+                    ), caches
+
+                S = tokens.shape[1]
+                positions = jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32), tokens.shape
+                )
+                hidden, _ = model.forward_tokens(
+                    cfg, params, tokens, positions, attend, None
+                )
+                targets = tokens[0, 1:]  # (S-1,)
+                # chunked unembedding: per-position map would re-stream the
+                # full (E, V) head once per token; per-chunk it reads the
+                # head S/C times with a bounded (C, V) logits buffer
+                C = min(128, S - 1)
+                pad = -(S - 1) % C
+                h = jnp.pad(hidden[0, :-1], ((0, pad), (0, 0)))
+                t = jnp.pad(targets, (0, pad))
+                E = h.shape[-1]
+
+                def one_chunk(args):
+                    h_c, t_c = args  # (C, E), (C,)
+                    logits = model.logits_from_hidden(
+                        cfg, params, h_c[None]
+                    )[0]  # (C, V)
+                    return compute_logprobs(logits, t_c)
+
+                tok_lp, ids, lps = jax.lax.map(
+                    one_chunk, (h.reshape(-1, C, E), t.reshape(-1, C))
+                )
+                n = tok_lp.shape[0] * C
+                return (tok_lp.reshape(n)[: S - 1],
+                        ids.reshape(n, -1)[: S - 1],
+                        lps.reshape(n, -1)[: S - 1])
+
+            self._prompt_lp_fn = jax.jit(_score)
+        with jax.set_mesh(self.mesh):
+            out = self._prompt_lp_fn(self.params, jnp.asarray(tokens))
+        return tuple(np.asarray(x) for x in jax.device_get(out))
+
     # -- multi-LoRA bank -----------------------------------------------------
     def register_lora(self, slot: int, bank_np: dict) -> None:
         """Write an adapter's stacked (A, B) pairs into bank slot ``slot``."""

@@ -31,8 +31,10 @@ engine). This is engine-native capability per SURVEY.md §7 step 1.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Tuple
 
+import jax
 import jax.numpy as jnp
 
 # A quantized weight is a plain pytree node: {"q": int8, "s": f32 broadcastable
@@ -47,18 +49,29 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
 
 
+@functools.partial(jax.jit, static_argnames="contract_axes")
+def _quantize_leaf(w: jnp.ndarray, contract_axes: Tuple[int, ...]) -> dict:
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=contract_axes, keepdims=True)
+    s = jnp.maximum(s, _EPS) / 127.0
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
 def quantize_array(w: jnp.ndarray, contract_axes: Tuple[int, ...]) -> dict:
     """Symmetric int8 over ``contract_axes`` (the matmul-contracted dims).
 
     The scale keeps reduced axes as size-1 (keepdims), so ``q * s`` — and the
     matmul-output rescale — broadcast with no per-site reshape logic, even
     for batched weights like the MoE (X, E, F) expert stack.
+
+    Jitted (XLA fuses the f32 convert/round/clip — eager ops materialised a
+    full f32 copy per stage) and synchronised per leaf: quantizing a
+    multi-GB stack is async-dispatched, and letting every leaf's
+    transients queue unfetched stacked >HBM of temporaries at engine init
+    (observed as a RESOURCE_EXHAUSTED on the first prefill fetch, v5e 3B).
     """
-    wf = w.astype(jnp.float32)
-    s = jnp.max(jnp.abs(wf), axis=contract_axes, keepdims=True)
-    s = jnp.maximum(s, _EPS) / 127.0
-    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
-    return {"q": q, "s": s}
+    return jax.block_until_ready(_quantize_leaf(w, tuple(contract_axes)))
 
 
 def dequantize_array(w: dict) -> jnp.ndarray:

@@ -121,7 +121,7 @@ def _fmt_completion_logprobs(tk, token_ids: list, lps: list, n_top: int,
         tlps.append(lp)
         offsets.append(off)
         off += len(s)
-        if n_top:
+        if n_top and top:  # first echoed token has no prediction (None, [])
             # dict keyed by token string (OpenAI shape): distinct ids can
             # decode to the same string — the highest-ranked keeps the key
             d: dict = {}
@@ -143,6 +143,10 @@ def _parse_logit_bias(raw) -> Optional[dict]:
 
 
 MAX_CHOICES = 128  # OpenAI caps n at 128; batched prompts share the cap
+
+# echo+logprobs scores the prompt with a dense teacher-forced pass whose
+# attention materialises an S x S score matrix per layer — bound it
+MAX_ECHO_SCORE_TOKENS = 2048
 
 
 def _tokens_covering(tk, token_ids: list, text_len: int) -> int:
@@ -1008,6 +1012,31 @@ class EngineServer:
                     status=400,
                 )
 
+        echo = bool(body.get("echo")) and not chat
+        if echo:
+            err = None
+            if stream:
+                err = "echo is not supported with stream=true"
+            elif body.get("guided_choice") is not None:
+                err = "echo cannot be combined with guided_choice"
+            elif (sampling.logprobs is not None
+                  and any(len(p) > MAX_ECHO_SCORE_TOKENS
+                          for p in prompt_ids_list)):
+                err = (f"echo with logprobs is limited to "
+                       f"{MAX_ECHO_SCORE_TOKENS}-token prompts")
+            if err is not None:
+                return web.json_response(
+                    {"error": {"message": err,
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            if body.get("max_tokens") == 0:
+                # score-only mode: no generation, just the echoed prompt
+                # (with its teacher-forced logprobs when asked)
+                return await self._echo_score_response(
+                    prompt_ids_list, sampling, rid, created, model, t_start,
+                )
+
         guided = body.get("guided_choice")
         if guided is not None:
             return await self._guided_choice_response(
@@ -1050,6 +1079,17 @@ class EngineServer:
                     prompt_ids, choice_sampling, crid,
                     adapter_slot=adapter_slot,
                 ))
+        echo_info = None
+        if echo:
+            lps_list = []
+            for pids in prompt_ids_list:
+                lps_list.append(
+                    await self.async_engine.run_on_engine(
+                        lambda eng, p=pids: eng.prompt_logprobs(p)
+                    )
+                    if sampling.logprobs is not None else None
+                )
+            echo_info = {"ids": prompt_ids_list, "lps": lps_list}
         n_prompt = sum(len(p) for p in prompt_ids_list)
         if stream:
             so = body.get("stream_options")
@@ -1061,7 +1101,7 @@ class EngineServer:
             )
         return await self._full_response(
             gens, rids, rid, created, model, chat, t_start, n_prompt, sampling,
-            produce_kv=produce_kv,
+            produce_kv=produce_kv, echo_info=echo_info,
         )
 
     async def _abort_all(self, tasks, rids):
@@ -1086,7 +1126,8 @@ class EngineServer:
 
     async def _full_response(self, gens, rids, rid, created, model, chat,
                              t_start, n_prompt, sampling,
-                             produce_kv=False) -> web.Response:
+                             produce_kv=False,
+                             echo_info=None) -> web.Response:
         tk = self.engine.tokenizer
 
         async def collect(gen, crid):
@@ -1163,6 +1204,16 @@ class EngineServer:
                     )
                 choices.append(choice)
             else:
+                if echo_info is not None:
+                    # echo: prepend the prompt (and, with logprobs, its
+                    # teacher-forced entries — token 0 has no prediction)
+                    pi = idx // n
+                    p_ids = echo_info["ids"][pi]
+                    text = tk.decode(p_ids) + text
+                    if want_lp:
+                        ids = list(p_ids) + list(ids)
+                        lps = ([(None, [])] + echo_info["lps"][pi]
+                               + list(lps))
                 choices.append({
                     "index": idx,
                     "text": text,
@@ -1195,6 +1246,42 @@ class EngineServer:
                 "remote_port": None,
             }
         return web.json_response(payload)
+
+    async def _echo_score_response(self, prompt_ids_list, sampling, rid,
+                                   created, model, t_start) -> web.Response:
+        """completions echo + max_tokens=0: return the prompt itself, with
+        its teacher-forced logprobs when asked — the OpenAI scoring mode
+        (classification/perplexity without generating anything). With n>1
+        the (deterministic) scored choice repeats per the prompt*n choice
+        layout the generation path uses."""
+        tk = self.engine.tokenizer
+        n = max(1, int(sampling.n))
+        choices = []
+        for pi, pids in enumerate(prompt_ids_list):
+            lp_obj = None
+            if sampling.logprobs is not None:
+                entries = await self.async_engine.run_on_engine(
+                    lambda eng, p=pids: eng.prompt_logprobs(p)
+                )
+                lp_obj = _fmt_completion_logprobs(
+                    tk, list(pids), [(None, [])] + entries,
+                    sampling.logprobs,
+                )
+            for j in range(n):
+                choices.append({
+                    "index": pi * n + j,
+                    "text": tk.decode(list(pids)),
+                    "finish_reason": "length",
+                    "logprobs": lp_obj,
+                })
+        n_prompt = sum(len(p) for p in prompt_ids_list)
+        self.metrics.observe_request(t_start, None, time.monotonic(), 0)
+        return web.json_response({
+            "id": rid, "object": "text_completion", "created": created,
+            "model": model, "choices": choices,
+            "usage": {"prompt_tokens": n_prompt, "completion_tokens": 0,
+                      "total_tokens": n_prompt},
+        })
 
     async def _guided_choice_response(self, request, guided, prompt_ids_list,
                                       sampling, rid, created, model,

@@ -153,6 +153,102 @@ def test_logprobs_validation(server):
     assert run(with_client(server, fn))
 
 
+def test_echo_with_logprobs(server):
+    """completions echo=true: prompt text + entries prepended; the first
+    prompt token has no prediction (null logprob)."""
+    async def fn(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello", "max_tokens": 3,
+            "temperature": 0, "logprobs": 2, "echo": True,
+            "ignore_eos": True,
+        })
+        assert r.status == 200
+        body = await r.json()
+        choice = body["choices"][0]
+        assert choice["text"].startswith("hello")
+        lp = choice["logprobs"]
+        # byte tokenizer: BOS + 5 chars = 6 prompt tokens, + 3 generated
+        assert len(lp["tokens"]) == 6 + 3
+        assert lp["token_logprobs"][0] is None
+        assert lp["top_logprobs"][0] is None
+        assert all(v is not None for v in lp["token_logprobs"][1:])
+        # prompt scoring and generation use the same raw-logits convention:
+        # every non-null entry is a valid logprob
+        assert all(v <= 0.0 for v in lp["token_logprobs"][1:])
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_echo_score_only(server):
+    """echo + max_tokens=0 scores the prompt without generating — the
+    OpenAI classification idiom."""
+    async def fn(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "abcd", "max_tokens": 0,
+            "echo": True, "logprobs": 1,
+        })
+        assert r.status == 200
+        body = await r.json()
+        assert body["usage"]["completion_tokens"] == 0
+        choice = body["choices"][0]
+        assert choice["text"] == "abcd"
+        lp = choice["logprobs"]
+        assert len(lp["tokens"]) == 5  # BOS + 4 chars
+        assert lp["token_logprobs"][0] is None
+        assert all(v <= 0.0 for v in lp["token_logprobs"][1:])
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_echo_without_logprobs_and_validation(server):
+    async def fn(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "xy", "max_tokens": 2,
+            "temperature": 0, "echo": True, "ignore_eos": True,
+        })
+        body = await r.json()
+        assert body["choices"][0]["text"].startswith("xy")
+        assert body["choices"][0]["logprobs"] is None
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "xy", "echo": True,
+            "stream": True,
+        })
+        assert r.status == 400
+        return True
+
+    assert run(with_client(server, fn))
+
+
+def test_prompt_logprobs_consistency(server):
+    """Teacher-forced prompt scoring must agree with generation: generate
+    greedily from a prefix, then score prefix+output — the scored
+    logprobs of the generated tokens must match the generation-time
+    logprobs (same raw-logits convention, dense vs paged path). Compared
+    at the token-id level: re-tokenizing decoded TEXT is lossy for ids
+    that decode to empty/identical strings."""
+    from production_stack_tpu.engine.sampling import SamplingParams
+
+    eng = server.engine
+    sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True,
+                        logprobs=0)
+    eng.add_request("lp-consistency", prompt_token_ids=[5, 6, 7],
+                    sampling=sp)
+    toks, lps = [], []
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.request_id != "lp-consistency":
+                continue
+            toks.extend(o.new_token_ids)
+            if o.new_logprobs:
+                lps.extend(lp for lp, _ in o.new_logprobs)
+    entries = eng.prompt_logprobs([5, 6, 7] + toks)
+    assert len(toks) == len(lps) == 3
+    for a, (b, _top) in zip(lps, entries[-3:]):
+        assert a == pytest.approx(b, abs=2e-3)
+
+
 def test_logprobs_rejected_with_pipeline_parallelism():
     """The staged runner has no logprob programs: requests must 400/raise
     up-front, and warmup must not emit logprob requests there."""
