@@ -416,6 +416,12 @@ class EngineConfig:
     # multi-LoRA bank: slot 0 is the base model, adapters occupy 1..max-1
     max_loras: int = 4
     max_lora_rank: int = 16
+    # constrained-decoding grammar bank (engine/grammar.py): distinct
+    # concurrent grammars and the per-grammar DFA state budget. HBM cost
+    # when first used: max_grammars x max_grammar_states x vocab x 2 B
+    # (int16 transition tables; 8 x 128 x 128k = 256 MB)
+    max_grammars: int = 8
+    max_grammar_states: int = 128
 
     @staticmethod
     def for_model(name: str, **kw) -> "EngineConfig":

@@ -104,6 +104,14 @@ class LLMEngine:
         self._ctrl_ids = np.full((B, MAX_TOKEN_CONTROLS), -1, np.int32)
         self._ctrl_vals = np.zeros((B, MAX_TOKEN_CONTROLS), np.float32)
         self._ctrl_mode = np.zeros(B, np.int32)
+        self._g_ids = np.full(B, -1, np.int32)
+        self._g_states = np.zeros(B, np.int32)
+        # constrained decoding: compiled grammars keyed by pattern, device
+        # bank slots refcounted; evicted (refs == 0) only when slots run out
+        self._grammar_cache: dict = {}
+        self._grammar_by_slot: dict = {}
+        self._grammar_free = list(range(config.max_grammars - 1, -1, -1))
+        self._token_bytes = None  # lazy per-vocab byte images
         self._count_reset_slots: list[Sequence] = []
         self._slot_seq: dict[int, Sequence] = {}
         # deferred prefill resolution: (prefills, device sampled array).
@@ -199,6 +207,16 @@ class LLMEngine:
                        adapter_slot=adapter_slot,
                        token_ctrl=make_token_controls(
                            sampling, self.config.model.vocab_size))
+        if sampling.guided_regex is not None or sampling.guided_json is not None:
+            if not hasattr(self.runner, "register_grammar"):
+                raise ValueError(
+                    "guided decoding is not supported with pipeline "
+                    "parallelism"
+                )
+            ent = self._acquire_grammar(sampling)
+            seq.grammar_slot = ent["slot"]
+            seq.fsm = ent["fsm"]
+            seq.fsm_state = 0
         self.scheduler.add(seq)
         self.total_prompt_tokens += len(prompt_token_ids)
         return seq
@@ -207,7 +225,59 @@ class LLMEngine:
         seq = self.scheduler.abort(request_id)
         if seq is not None and seq.slot in self._slot_seq:
             del self._slot_seq[seq.slot]
+        if seq is not None:
+            self._release_grammar(seq)
         return seq is not None
+
+    # -- constrained decoding (engine/grammar.py) ---------------------------
+    def _acquire_grammar(self, sampling: SamplingParams) -> dict:
+        import json as _json
+
+        from production_stack_tpu.engine import grammar as G
+
+        if sampling.guided_regex is not None:
+            key = ("re", sampling.guided_regex)
+            pattern = sampling.guided_regex
+        else:
+            key = ("json", _json.dumps(sampling.guided_json, sort_keys=True))
+            pattern = G.schema_to_regex(sampling.guided_json)
+        ent = self._grammar_cache.get(key)
+        if ent is None:
+            dfa = G.compile_regex(
+                pattern, max_states=self.config.max_grammar_states
+            )
+            if self._token_bytes is None:
+                self._token_bytes = G.token_byte_images(
+                    self.tokenizer, self.config.model.vocab_size
+                )
+            fsm = G.build_token_fsm(dfa, self._token_bytes)
+            if not self._grammar_free:
+                for k, e in list(self._grammar_cache.items()):
+                    if e["refs"] == 0:  # evict a cold grammar's slot
+                        self._grammar_free.append(e["slot"])
+                        del self._grammar_cache[k]
+                        del self._grammar_by_slot[e["slot"]]
+                        break
+            if not self._grammar_free:
+                raise ValueError(
+                    f"too many concurrent guided grammars "
+                    f"(max {self.config.max_grammars})"
+                )
+            slot = self._grammar_free.pop()
+            self.runner.register_grammar(slot, fsm)
+            ent = {"slot": slot, "fsm": fsm, "refs": 0, "key": key}
+            self._grammar_cache[key] = ent
+            self._grammar_by_slot[slot] = ent
+        ent["refs"] += 1
+        return ent
+
+    def _release_grammar(self, seq: Sequence) -> None:
+        if seq.grammar_slot < 0:
+            return
+        ent = self._grammar_by_slot.get(seq.grammar_slot)
+        if ent is not None and ent["refs"] > 0:
+            ent["refs"] -= 1
+        seq.grammar_slot = -1
 
     def has_unfinished(self) -> bool:
         return self.scheduler.has_work()
@@ -252,6 +322,7 @@ class LLMEngine:
             and not s.sampling.frequency_penalty
             and s.token_ctrl is None
             and s.sampling.logprobs is None  # verify emits argmax only
+            and s.grammar_slot < 0  # verify has no FSM mask
             for s in decodes
         )
 
@@ -494,6 +565,7 @@ class LLMEngine:
         top_ks = np.full(P, -1, np.int32)
         seeds = np.zeros(P, np.uint32)
         adapter_ids = np.zeros(P, np.int32)
+        g_ids = np.full(P, -1, np.int32)
 
         for i, sp in enumerate(prefills):
             seq = sp.seq
@@ -515,6 +587,10 @@ class LLMEngine:
             top_ks[i] = s.top_k
             seeds[i] = s.seed or 0
             adapter_ids[i] = seq.adapter_slot
+            # the grammar constrains the FIRST sampled token only when this
+            # chunk completes the prompt
+            if seq.grammar_slot >= 0 and sp.chunk_start + sp.chunk_len >= seq.prefill_target:
+                g_ids[i] = seq.grammar_slot
 
         greedy_only = all(sp.seq.sampling.temperature <= 0.0 for sp in prefills)
         use_lora = any(sp.seq.adapter_slot for sp in prefills)
@@ -531,11 +607,13 @@ class LLMEngine:
                 if sp.seq.token_ctrl is not None:
                     c_ids[i], c_vals[i], c_mode[i] = sp.seq.token_ctrl
             ctrl = (c_ids, c_vals, c_mode)
+        use_grammar = bool((g_ids >= 0).any())
         sampled_dev = self.runner.prefill(
             tokens, positions, tables, context_lens, slot_mapping.reshape(-1),
             last_idx, temps, top_ps, top_ks, seeds, greedy_only=greedy_only,
             adapter_ids=adapter_ids if use_lora else None,
             ctrl=ctrl,
+            g_ids=g_ids if use_grammar else None,
             fetch=False,
         )
 
@@ -575,6 +653,8 @@ class LLMEngine:
             token = int(sampled[i])
             seq.first_token_time = time.monotonic()
             seq.output_token_ids.append(token)
+            if seq.grammar_slot >= 0 and seq.fsm is not None:
+                seq.fsm_state = int(seq.fsm.trans[0, token])
             self.total_output_tokens += 1
             finished_prompts.append(seq)
             first_tokens.append([token])
@@ -592,9 +672,11 @@ class LLMEngine:
             getattr(self.runner, "supports_logprobs", False)
             and any(s.sampling.logprobs is not None for s in decodes)
         )
+        use_grammar = any(s.grammar_slot >= 0 for s in decodes)
         can_chain = (self.config.scheduler.chain_decode
                      and getattr(self.runner, "supports_chaining", False)
-                     and not use_logprobs)  # chained results stay on device
+                     and not use_logprobs  # chained results stay on device
+                     and not use_grammar)  # host mirrors the FSM state
         pending = self._pending_decode
         if pending is not None:
             # identity check on request ids, not slots: a freed slot can
@@ -642,6 +724,8 @@ class LLMEngine:
                 self._ctrl_ids[i] = -1
                 self._ctrl_vals[i] = 0.0
                 self._ctrl_mode[i] = 0
+            self._g_ids[i] = seq.grammar_slot
+            self._g_states[i] = max(seq.fsm_state, 0)
 
         # multi_step fused decode+sample iterations in one dispatch; sampled
         # tokens come back (K, B) and are appended until a stop fires
@@ -668,6 +752,8 @@ class LLMEngine:
             ctrl=((self._ctrl_ids, self._ctrl_vals, self._ctrl_mode)
                   if use_controls else None),
             tokens_dev=(pending["next_tok"] if chain else None),
+            g_ids=self._g_ids if use_grammar else None,
+            g_states=self._g_states if use_grammar else None,
             fetch=not can_chain,
             want_logprobs=use_logprobs,
         )
@@ -730,6 +816,13 @@ class LLMEngine:
                     seq.num_computed_tokens += 1
                 seq.output_token_ids.append(t)
                 new_toks.append(t)
+                if seq.grammar_slot >= 0 and seq.fsm is not None:
+                    # mirror the device-side FSM advance (kept tokens only:
+                    # stop-discarded surplus must not move the state)
+                    if 0 <= t < seq.fsm.trans.shape[1]:
+                        seq.fsm_state = int(
+                            seq.fsm.trans[max(seq.fsm_state, 0), t]
+                        )
                 if want_lp:
                     new_lps.append(
                         _lp_row((lp[0][k], lp[1][k], lp[2][k]), slot)
@@ -754,6 +847,7 @@ class LLMEngine:
                     self._host_offload_finished(seq)
                 self.scheduler.finish(seq, status)
                 self._slot_seq.pop(seq.slot, None)
+                self._release_grammar(seq)
                 seq.finish_time = time.monotonic()
             outputs.append(
                 RequestOutput(
@@ -1019,6 +1113,23 @@ class LLMEngine:
                              sampling=sp)
             while self.has_unfinished():
                 self.step()
+        # guided-decoding variants (static use_grammar flag): prefill's
+        # first-token mask + the fused decode FSM advance, greedy and
+        # sampled. Also pays the one-time vocab byte-image build here
+        # instead of on the first live guided request.
+        if hasattr(self.runner, "register_grammar"):
+            for temp in (0.0, 0.7):
+                sp = SamplingParams(
+                    temperature=temp, guided_regex="[ -~]*",
+                    max_tokens=max(sched.multi_step, 1) + 1,
+                    ignore_eos=True,
+                )
+                self.add_request(f"warmup-gram-{time.monotonic_ns()}",
+                                 prompt_token_ids=rng.integers(
+                                     1, vocab, 8).tolist(),
+                                 sampling=sp)
+                while self.has_unfinished():
+                    self.step()
         # penalised decode variant (static use_penalties flag)
         sp = SamplingParams(temperature=0.0, presence_penalty=0.5,
                             max_tokens=max(sched.multi_step, 1) + 1,

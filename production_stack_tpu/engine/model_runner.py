@@ -96,11 +96,17 @@ class ModelRunner:
         # (they read whole windows; tables are 0-padded past the live blocks)
         mbs = -(-self.cfg.max_model_len // config.cache.block_size)
         self.max_blocks_per_seq = (mbs + 7) // 8 * 8
+        from production_stack_tpu.engine.tokenizer import get_tokenizer
+
+        # bound into the compiled programs: grammar masking must know where
+        # EOS lives (allowed exactly in accepting FSM states)
+        self._eos_id = get_tokenizer(config.model.tokenizer).eos_id
 
         self._prefill = jax.jit(
-            functools.partial(_prefill_step, self.cfg, self._attend_prefill),
+            functools.partial(_prefill_step, self.cfg, self._attend_prefill,
+                              self._eos_id),
             donate_argnums=(1,),
-            static_argnames=("greedy_only", "use_controls"),
+            static_argnames=("greedy_only", "use_controls", "use_grammar"),
         )
         self._decode = jax.jit(
             functools.partial(_decode_step, self.cfg, self._attend_decode),
@@ -109,11 +115,12 @@ class ModelRunner:
         self._decode_multi = jax.jit(
             functools.partial(
                 _decode_multi_step, self.cfg, self._attend_decode,
-                max(config.scheduler.multi_step, 1),
+                max(config.scheduler.multi_step, 1), self._eos_id,
             ),
             donate_argnums=(1,),
             static_argnames=("block_size", "greedy_only", "use_penalties",
-                             "use_controls", "want_logprobs"),
+                             "use_controls", "want_logprobs",
+                             "use_grammar"),
         )
         self._sample = jax.jit(sample_tokens)
         if config.scheduler.spec_ngram_k > 0:
@@ -144,6 +151,12 @@ class ModelRunner:
         # multi-LoRA bank: target -> (A (L, N, in, R), B (L, N, R, *out));
         # slot 0 stays zeros (base model)
         self.lora_bank: Optional[dict] = None
+        # constrained-decoding grammar bank: (G, S, V) int16 token
+        # transition tables + (G, S) accept flags, lazily allocated on the
+        # first guided request (engine/grammar.py). The FSM advances INSIDE
+        # the fused decode loop — zero host round trips per token.
+        self.grammar_bank = None
+        self.grammar_accept = None
 
     # -- sizing ------------------------------------------------------------
     def _prefill_temp_bytes(self) -> int:
@@ -314,6 +327,7 @@ class ModelRunner:
                 seeds: np.ndarray, greedy_only: bool = True,
                 adapter_ids: Optional[np.ndarray] = None,
                 ctrl: Optional[tuple] = None,
+                g_ids: Optional[np.ndarray] = None,
                 fetch: bool = True):
         """A batch of prefill chunks (shapes padded: tokens (P, S), tables
         (P, M), slot_mapping (P*S,)). Each chunk's next token is sampled in
@@ -326,6 +340,7 @@ class ModelRunner:
         Returns (sampled (P,), tok_lp (P,), top_ids (P, N), top_lps (P, N))
         — logprobs ride every prefill (see _prefill_step)."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
+        use_grammar = g_ids is not None and self.grammar_bank is not None
         with jax.set_mesh(self.mesh):
             self.kv, result = self._prefill(
                 self.params, self.kv,
@@ -339,8 +354,14 @@ class ModelRunner:
                              if use_lora else None),
                 ctrl=(tuple(jnp.asarray(c) for c in ctrl)
                       if ctrl is not None else None),
+                grammar=(
+                    (self.grammar_bank, self.grammar_accept,
+                     jnp.asarray(g_ids, jnp.int32))
+                    if use_grammar else None
+                ),
                 greedy_only=greedy_only,
                 use_controls=ctrl is not None,
+                use_grammar=use_grammar,
             )
         if not fetch:
             return result
@@ -450,6 +471,7 @@ class ModelRunner:
                      greedy_only: bool = False,
                      presence=None, frequency=None,
                      adapter_ids=None, ctrl=None, tokens_dev=None,
+                     g_ids=None, g_states=None,
                      fetch: bool = True, want_logprobs: bool = False):
         """multi_step fused decode+sample iterations; returns sampled tokens
         (num_steps, B) on host — or the un-fetched device array with
@@ -478,6 +500,8 @@ class ModelRunner:
                            else np.array(adapter_ids))
             ctrl = (None if ctrl is None
                     else tuple(np.array(c) for c in ctrl))
+            g_ids = None if g_ids is None else np.array(g_ids)
+            g_states = None if g_states is None else np.array(g_states)
         if use_penalties:
             self._ensure_counts()
             counts = self.token_counts
@@ -488,6 +512,7 @@ class ModelRunner:
             pres = jnp.zeros(tokens.shape[0], jnp.float32)
             freq = pres
         use_lora = adapter_ids is not None and self.lora_bank is not None
+        use_grammar = g_ids is not None and self.grammar_bank is not None
         # tokens_dev is the (B, 1) next-token output of the previous
         # dispatch's program — already shaped, no eager ops on the hot path
         tok_in = (tokens_dev if tokens_dev is not None
@@ -505,11 +530,18 @@ class ModelRunner:
                 (jnp.asarray(adapter_ids, jnp.int32) if use_lora else None),
                 ctrl=(tuple(jnp.asarray(c) for c in ctrl)
                       if ctrl is not None else None),
+                grammar=(
+                    (self.grammar_bank, self.grammar_accept,
+                     jnp.asarray(g_ids, jnp.int32),
+                     jnp.asarray(g_states, jnp.int32))
+                    if use_grammar else None
+                ),
                 block_size=self.config.cache.block_size,
                 greedy_only=greedy_only,
                 use_penalties=use_penalties,
                 use_controls=ctrl is not None,
                 want_logprobs=want_logprobs,
+                use_grammar=use_grammar,
             )
         if use_penalties:
             self.token_counts = new_counts
@@ -693,6 +725,36 @@ class ModelRunner:
             out = self._prompt_lp_fn(self.params, jnp.asarray(tokens))
         return tuple(np.asarray(x) for x in jax.device_get(out))
 
+    # -- constrained-decoding grammar bank -----------------------------------
+    def register_grammar(self, slot: int, fsm) -> None:
+        """Upload one TokenFsm's transition table into bank slot ``slot``
+        (padded to the configured state budget)."""
+        G = self.config.max_grammars
+        S = self.config.max_grammar_states
+        V = self.cfg.vocab_size
+        if fsm.n_states > S:
+            raise ValueError(
+                f"grammar needs {fsm.n_states} states > budget {S}"
+            )
+        if self.grammar_bank is None:
+            with jax.set_mesh(self.mesh):
+                self.grammar_bank = jnp.full((G, S, V), -1, jnp.int16)
+                self.grammar_accept = jnp.zeros((G, S), jnp.bool_)
+            self._set_grammar_fn = jax.jit(
+                lambda b, a, i, t, acc: (b.at[i].set(t), a.at[i].set(acc)),
+                donate_argnums=(0, 1),
+            )
+        table = np.full((S, V), -1, np.int16)
+        table[: fsm.n_states] = fsm.trans.astype(np.int16)
+        acc = np.zeros(S, bool)
+        acc[: fsm.n_states] = fsm.accept
+        with jax.set_mesh(self.mesh):
+            self.grammar_bank, self.grammar_accept = self._set_grammar_fn(
+                self.grammar_bank, self.grammar_accept,
+                jnp.asarray(slot, jnp.int32), jnp.asarray(table),
+                jnp.asarray(acc),
+            )
+
     # -- multi-LoRA bank -----------------------------------------------------
     def register_lora(self, slot: int, bank_np: dict) -> None:
         """Write an adapter's stacked (A, B) pairs into bank slot ``slot``."""
@@ -803,6 +865,24 @@ class ModelRunner:
 # pure device functions (cfg static, attend closed over)
 # ---------------------------------------------------------------------------
 
+def _grammar_mask(logits, bank, accept, g_ids, g_states, eos_id):
+    """Hard-constrain logits to the FSM's outgoing transitions.
+
+    Rows with g_id < 0 pass through. Returns the masked logits and each
+    row's transition row (the sampled token indexes it for the in-loop
+    state advance). EOS is allowed exactly in accepting states."""
+    from production_stack_tpu.engine.sampling import NEG_INF
+
+    gi = jnp.clip(g_ids, 0, None)
+    st = jnp.clip(g_states, 0, None)
+    row_t = bank[gi, st]  # (B, V) int16
+    allowed = row_t >= 0
+    if eos_id is not None:
+        allowed = allowed.at[:, eos_id].max(accept[gi, st])
+    con = (g_ids >= 0)[:, None]
+    return jnp.where(con & ~allowed, NEG_INF, logits), row_t
+
+
 def _make_lora(lora_bank, adapter_ids, T: int):
     """Build the forward-pass lora pytree (or None)."""
     if lora_bank is None or adapter_ids is None:
@@ -813,11 +893,13 @@ def _make_lora(lora_bank, adapter_ids, T: int):
     return {"onehot": onehot, "bank": lora_bank}
 
 
-def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
-                  block_tables, context_lens, slot_mapping, last_idx,
-                  temps, top_ps, top_ks, seeds, lora_bank=None,
-                  adapter_ids=None, ctrl=None, greedy_only: bool = False,
-                  use_controls: bool = False):
+def _prefill_step(cfg: ModelConfig, attend_impl, eos_id, params, kv, tokens,
+                  positions, block_tables, context_lens, slot_mapping,
+                  last_idx, temps, top_ps, top_ks, seeds, lora_bank=None,
+                  adapter_ids=None, ctrl=None, grammar=None,
+                  greedy_only: bool = False,
+                  use_controls: bool = False,
+                  use_grammar: bool = False):
     """Batched prefill chunks + fused first-token sampling.
 
     tokens/positions: (P, S); block_tables (P, M); context_lens (P,) with 0
@@ -847,6 +929,12 @@ def _prefill_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
         from production_stack_tpu.engine.sampling import apply_token_controls
 
         logits = apply_token_controls(logits, *ctrl)
+    if use_grammar:
+        # generation starts at FSM state 0: constrain the first token
+        bank, accept, g_ids = grammar
+        logits, _ = _grammar_mask(
+            logits, bank, accept, g_ids, jnp.zeros_like(g_ids), eos_id
+        )
     if greedy_only:
         sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
@@ -968,15 +1056,18 @@ def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
     return new_kv, logits
 
 
-def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv,
+def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, eos_id,
+                       params, kv,
                        tokens, positions, block_tables, context_lens,
                        slot_mapping, temps, top_ps, top_ks, seeds, steps,
                        token_counts, presence, frequency,
-                       lora_bank=None, adapter_ids=None, ctrl=None, *,
+                       lora_bank=None, adapter_ids=None, ctrl=None,
+                       grammar=None, *,
                        block_size: int, greedy_only: bool = False,
                        use_penalties: bool = False,
                        use_controls: bool = False,
-                       want_logprobs: bool = False):
+                       want_logprobs: bool = False,
+                       use_grammar: bool = False):
     """``num_steps`` fused decode+sample iterations in ONE dispatch.
 
     The token sampled at iteration i feeds iteration i+1 entirely on device;
@@ -990,8 +1081,12 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
     model = get_model(cfg)
     B = tokens.shape[0]
     active = context_lens > 0
+    if use_grammar:
+        g_bank, g_accept, g_ids, g_states0 = grammar
+    else:
+        g_ids = g_states0 = jnp.zeros(B, jnp.int32)  # carry placeholder
 
-    def one(kv, tok, pos, ctx, slots, step_ctr, counts):
+    def one(kv, tok, pos, ctx, slots, step_ctr, counts, g_state):
         def attend(q, k, v, caches, layer_idx):
             return attend_impl(
                 q, k, v, caches, layer_idx, block_tables, ctx, pos[:, None],
@@ -1014,19 +1109,33 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
             )
 
             logits = apply_token_controls(logits, *ctrl)
+        if use_grammar:
+            logits, row_t = _grammar_mask(
+                logits, g_bank, g_accept, g_ids, g_state, eos_id
+            )
         if greedy_only:
             sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
             sampled = sample_tokens(logits, temps, top_ps, top_ks, seeds, step_ctr)
+        if use_grammar:
+            # advance the FSM on device: next dispatch's state comes back
+            # through the host mirror, but within this fused loop the
+            # transition row the token was sampled FROM defines it
+            nxt = jnp.take_along_axis(
+                row_t, sampled[:, None], axis=-1
+            )[:, 0].astype(jnp.int32)
+            g_state = jnp.where((g_ids >= 0) & active, nxt, g_state)
         if want_logprobs:
             from production_stack_tpu.engine.sampling import compute_logprobs
 
-            return kv, (sampled, *compute_logprobs(raw_logits, sampled))
-        return kv, (sampled,)
+            return kv, g_state, (sampled, *compute_logprobs(raw_logits, sampled))
+        return kv, g_state, (sampled,)
 
     def body(carry, _):
-        kv, tok, pos, ctx, slots, step_ctr, counts = carry
-        kv, (sampled, *lp) = one(kv, tok, pos, ctx, slots, step_ctr, counts)
+        kv, tok, pos, ctx, slots, step_ctr, counts, g_state = carry
+        kv, g_state, (sampled, *lp) = one(
+            kv, tok, pos, ctx, slots, step_ctr, counts, g_state
+        )
         new_pos = jnp.where(active, pos + 1, pos)
         new_ctx = jnp.where(active, ctx + 1, ctx)
         block = block_tables[jnp.arange(B), jnp.clip(new_pos, 0, None) // block_size]
@@ -1044,13 +1153,14 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, params, kv
                 active.astype(counts.dtype)
             )
         return (
-            (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1, counts),
+            (kv, tok, new_pos, new_ctx, new_slots, step_ctr + 1, counts,
+             g_state),
             (sampled, *lp),
         )
 
     init = (kv, tokens[:, 0], positions[:, 0], context_lens, slot_mapping,
-            steps, token_counts)
-    (kv, _, _, _, _, _, counts), (sampled, *lp) = jax.lax.scan(
+            steps, token_counts, g_states0)
+    (kv, _, _, _, _, _, counts, _), (sampled, *lp) = jax.lax.scan(
         body, init, None, length=num_steps
     )
     # next_tok comes out of the SAME program: an eager slice on the result

@@ -174,7 +174,7 @@ class StagedModelRunner:
     def prefill(self, tokens, positions, block_tables, context_lens,
                 slot_mapping, last_idx, temps, top_ps, top_ks, seeds,
                 greedy_only: bool = True, adapter_ids=None, ctrl=None,
-                fetch: bool = True):
+                g_ids=None, fetch: bool = True):
         x = jnp.asarray(tokens)  # stage 0 consumes token ids
         common = (
             jnp.asarray(positions), jnp.asarray(block_tables),
@@ -212,7 +212,7 @@ class StagedModelRunner:
                      greedy_only: bool = False,
                      presence=None, frequency=None,
                      adapter_ids=None, ctrl=None, tokens_dev=None,
-                     fetch: bool = True,
+                     g_ids=None, g_states=None, fetch: bool = True,
                      want_logprobs: bool = False) -> np.ndarray:
         """K single decode steps, each relayed through the stages. The host
         advances positions/slots between steps (the sampled token must come

@@ -177,6 +177,7 @@ class Scheduler:
                 if (seq.status is SequenceStatus.PREFILLING
                         and not seq.prefill_done
                         and seq.num_computed_tokens == 0
+                        and seq.grammar_slot < 0  # ring samples unmasked
                         and seq.prefill_target
                         >= self.config.ring_prefill_threshold):
                     out.prefills.append(

@@ -38,6 +38,13 @@ class Sequence:
     adapter_slot: int = 0  # multi-LoRA bank slot; 0 = base model
     # compacted token controls (sampling.make_token_controls): or None
     token_ctrl: Optional[tuple] = None
+    # constrained decoding: device grammar-bank slot (-1 = unconstrained),
+    # current FSM state (generation starts at 0; host mirror of the
+    # device-side advance), and the host TokenFsm (prefill-token advance,
+    # slot release key)
+    grammar_slot: int = -1
+    fsm_state: int = 0
+    fsm: Optional[object] = None
 
     output_token_ids: list[int] = dataclasses.field(default_factory=list)
     status: SequenceStatus = SequenceStatus.WAITING

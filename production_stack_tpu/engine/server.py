@@ -988,6 +988,41 @@ class EngineServer:
                            "type": "invalid_request_error"}},
                 status=400,
             )
+        g_re = body.get("guided_regex")
+        g_js = body.get("guided_json")
+        if g_re is not None or g_js is not None:
+            err = None
+            if g_re is not None and g_js is not None:
+                err = "guided_regex and guided_json are mutually exclusive"
+            elif body.get("guided_choice") is not None:
+                err = "guided_choice cannot combine with other guidance"
+            elif g_re is not None and not isinstance(g_re, str):
+                err = "guided_regex must be a string"
+            elif not hasattr(self.engine.runner, "register_grammar"):
+                err = ("guided decoding is not supported with pipeline "
+                       "parallelism")
+            else:
+                try:  # validate the grammar NOW — a 400, not a mid-stream 500
+                    from production_stack_tpu.engine.grammar import (
+                        compile_regex,
+                        schema_to_regex,
+                    )
+
+                    pat = g_re if g_re is not None else schema_to_regex(g_js)
+                    compile_regex(
+                        pat, max_states=self.config.max_grammar_states
+                    )
+                except ValueError as e:
+                    err = f"invalid guided grammar: {e}"
+            if err is not None:
+                return web.json_response(
+                    {"error": {"message": err,
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            sampling = dataclasses.replace(
+                sampling, guided_regex=g_re, guided_json=g_js
+            )
         if sampling.n < 1 or sampling.n * len(prompts) > MAX_CHOICES:
             return web.json_response(
                 {"error": {"message":
