@@ -20,12 +20,20 @@ vs_baseline normalises against the driver's north-star target of
 2,000 output tok/s/chip (BASELINE.json; defined for Llama-3-8B on v5e-16 —
 this single-chip 3B number is the per-chip proxy the rounds track). The
 north-star p50 TTFT target is 200 ms.
+
+Resilience (driver contract, VERDICT r2 weak #1): the parent process never
+imports jax. It probes the backend in a watchdogged subprocess, runs the
+actual benchmark in a second subprocess, retries once after a cooldown on
+backend failure, and ALWAYS prints a final JSON line — with an ``error``
+field instead of dying on a raw traceback when the chip is unreachable.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -35,8 +43,14 @@ def pctl(xs, p):
     return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
 
 
-def main() -> None:
+def run_bench() -> None:
     import jax
+
+    # honor the env platform in-config: the TPU tunnel's interpreter hook
+    # pins jax_platforms before main code runs, so JAX_PLATFORMS=cpu in the
+    # env would otherwise be silently ignored (CI/dev runs of this bench)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     from production_stack_tpu.engine.config import (
         CacheConfig,
@@ -185,6 +199,88 @@ def main() -> None:
                 list(r2_cached.values()) or [0])),
             "prefix_cache_hit_rate": round(hits / max(queries, 1), 3),
         },
+    }))
+
+
+def _probe_backend(timeout: float) -> tuple[bool, str]:
+    """Initialize the JAX backend in a disposable child; report viability.
+
+    A wedged TPU tunnel hangs backend init forever (it cost round 2 its
+    bench artifact) — the subprocess boundary + timeout turn that hang into
+    a diagnosable failure.
+    """
+    code = (
+        "import os, jax; "
+        "p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "print('BACKEND', jax.default_backend())"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init exceeded {timeout:.0f}s (wedged chip?)"
+    if proc.returncode != 0:
+        tail = "; ".join(proc.stdout.strip().splitlines()[-3:])
+        return False, f"backend init failed rc={proc.returncode}: {tail}"
+    return True, proc.stdout.strip().splitlines()[-1]
+
+
+def _run_child(timeout: float) -> tuple[dict | None, str]:
+    """Run the benchmark in a child; return (parsed last JSON line, diag)."""
+    env = dict(os.environ)
+    env["_PSTPU_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"benchmark exceeded {timeout:.0f}s watchdog"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed, ""
+        except json.JSONDecodeError:
+            continue
+    tail = "; ".join(
+        (proc.stderr.strip() or proc.stdout.strip()).splitlines()[-4:]
+    )
+    return None, f"no JSON line (rc={proc.returncode}): {tail}"
+
+
+def main() -> None:
+    if os.environ.get("_PSTPU_BENCH_CHILD") == "1":
+        run_bench()
+        return
+    probe_timeout = float(os.environ.get("PSTPU_BENCH_PROBE_TIMEOUT", "240"))
+    bench_timeout = float(os.environ.get("PSTPU_BENCH_TIMEOUT", "1800"))
+    cooldown = float(os.environ.get("PSTPU_BENCH_COOLDOWN", "30"))
+    errors = []
+    for attempt in range(2):
+        if attempt:
+            print(f"bench attempt 1 failed ({errors[-1]}); retrying after "
+                  f"{cooldown:.0f}s cooldown", file=sys.stderr, flush=True)
+            time.sleep(cooldown)
+        ok, diag = _probe_backend(probe_timeout)
+        if not ok:
+            errors.append(diag)
+            continue
+        result, diag = _run_child(bench_timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(diag)
+    print(json.dumps({
+        "metric": "output throughput (backend unavailable)",
+        "value": 0.0,
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,
+        "error": " | ".join(errors),
     }))
 
 

@@ -132,6 +132,52 @@ class AsyncEngine:
         self.intake.put(
             ("add", (rid, list(prompt_token_ids), sampling, adapter_slot))
         )
+        async for item in self._consume(rid, q):
+            yield item
+
+    async def admit_batch(
+        self, requests: list
+    ) -> list[AsyncIterator[RequestOutput]]:
+        """Atomically admit requests (rid, prompt_ids, sampling,
+        adapter_slot) on the engine thread — all-or-nothing.
+
+        Unlike generate(), which enqueues the add and surfaces admission
+        failures later on the stream, this waits for admission to complete
+        BEFORE the caller commits to a response. A failure on any request
+        aborts the already-added siblings, deregisters every stream, and
+        re-raises — so the server can map grammar-bank exhaustion /
+        vocab-infeasible grammars to clean HTTP statuses instead of
+        mid-flight errors, and no slot can be stolen between a pre-check
+        and the add (r3 review: check-vs-reserve race)."""
+        qs: dict[str, asyncio.Queue] = {}
+        for rid, *_ in requests:
+            q: asyncio.Queue = asyncio.Queue()
+            qs[rid] = q
+            self.streams[rid] = q  # registered first: no output dropped
+
+        def add_all(eng):
+            added = []
+            try:
+                for rid, ids, sp, slot in requests:
+                    eng.add_request(rid, prompt_token_ids=list(ids),
+                                    sampling=sp, adapter_slot=slot)
+                    added.append(rid)
+            except Exception:
+                for r in added:
+                    eng.abort_request(r)
+                raise
+
+        try:
+            await self.run_on_engine(add_all)
+        except Exception:
+            for rid in qs:
+                self.streams.pop(rid, None)
+            raise
+        return [self._consume(rid, q) for rid, q in qs.items()]
+
+    async def _consume(
+        self, rid: str, q: asyncio.Queue
+    ) -> AsyncIterator[RequestOutput]:
         try:
             while True:
                 item = await q.get()

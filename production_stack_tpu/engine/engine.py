@@ -33,6 +33,23 @@ from production_stack_tpu.engine.tokenizer import get_tokenizer
 from production_stack_tpu.parallel.mesh import build_mesh
 
 
+class GrammarBankFull(ValueError):
+    """Every grammar-bank slot is referenced by a live request.
+
+    A distinct exception type so the server can map admission failure to
+    HTTP 429 (retryable) while other ValueErrors stay 400s."""
+
+
+def _grammar_key(guided_regex, guided_json):
+    """Cache key for a guided grammar — the ONE place it is derived, so
+    admission and any availability checks can never desynchronize."""
+    import json as _json
+
+    if guided_regex is not None:
+        return ("re", guided_regex)
+    return ("json", _json.dumps(guided_json, sort_keys=True))
+
+
 def _lp_row(lp: tuple, i: int):
     """One token's logprob entry from fetched (tok_lp, ids, lps) arrays:
     (token_logprob, [(token_id, logprob) * top-N])."""
@@ -231,15 +248,12 @@ class LLMEngine:
 
     # -- constrained decoding (engine/grammar.py) ---------------------------
     def _acquire_grammar(self, sampling: SamplingParams) -> dict:
-        import json as _json
-
         from production_stack_tpu.engine import grammar as G
 
+        key = _grammar_key(sampling.guided_regex, sampling.guided_json)
         if sampling.guided_regex is not None:
-            key = ("re", sampling.guided_regex)
             pattern = sampling.guided_regex
         else:
-            key = ("json", _json.dumps(sampling.guided_json, sort_keys=True))
             pattern = G.schema_to_regex(sampling.guided_json)
         ent = self._grammar_cache.get(key)
         if ent is None:
@@ -259,7 +273,7 @@ class LLMEngine:
                         del self._grammar_by_slot[e["slot"]]
                         break
             if not self._grammar_free:
-                raise ValueError(
+                raise GrammarBankFull(
                     f"too many concurrent guided grammars "
                     f"(max {self.config.max_grammars})"
                 )
@@ -270,6 +284,20 @@ class LLMEngine:
             self._grammar_by_slot[slot] = ent
         ent["refs"] += 1
         return ent
+
+    def grammar_slot_available(self, guided_regex=None,
+                               guided_json=None) -> bool:
+        """Advisory: could a request with this grammar be admitted now?
+
+        Shares _grammar_key with _acquire_grammar so the two can never
+        desynchronize. NOTE this is a check, not a reservation — real
+        admission control is AsyncEngine.admit_batch, which runs the
+        actual acquire atomically on the engine thread and surfaces
+        GrammarBankFull before the server commits to a response."""
+        key = _grammar_key(guided_regex, guided_json)
+        if key in self._grammar_cache or self._grammar_free:
+            return True
+        return any(e["refs"] == 0 for e in self._grammar_cache.values())
 
     def _release_grammar(self, seq: Sequence) -> None:
         if seq.grammar_slot < 0:

@@ -134,15 +134,20 @@ class _Parser:
         return frag
 
     def _parse_counts(self):
-        j = self.p.index("}", self.i)
+        j = self.p.find("}", self.i)
+        if j < 0:
+            raise RegexError(f"unbalanced {{ at {self.i}")
         body = self.p[self.i + 1 : j]
         self.i = j + 1
-        if "," in body:
-            lo_s, hi_s = body.split(",", 1)
-            lo = int(lo_s or 0)
-            hi = int(hi_s) if hi_s else None
-        else:
-            lo = hi = int(body)
+        try:
+            if "," in body:
+                lo_s, hi_s = body.split(",", 1)
+                lo = int(lo_s or 0)
+                hi = int(hi_s) if hi_s else None
+            else:
+                lo = hi = int(body)
+        except ValueError:
+            raise RegexError(f"bad counts {{{body}}}") from None
         if hi is not None and hi < lo:
             raise RegexError(f"bad counts {{{body}}}")
         if (hi if hi is not None else lo) > 256:
@@ -233,6 +238,10 @@ class _Parser:
         return s, cur
 
     def _escape(self):
+        if self.i >= len(self.p):
+            # a pattern ending in a bare backslash must be a 400-able
+            # RegexError, not an IndexError 500 (r2 advisor)
+            raise RegexError("truncated escape at end of pattern")
         e = self.p[self.i]
         self.i += 1
         if e in _CLASS_ESCAPES:
@@ -240,7 +249,15 @@ class _Parser:
         if e in _LITERAL_ESCAPES:
             return frozenset({_LITERAL_ESCAPES[e]})
         if e == "x":
-            v = int(self.p[self.i : self.i + 2], 16)
+            hex_part = self.p[self.i : self.i + 2]
+            try:
+                if len(hex_part) != 2:
+                    raise ValueError
+                v = int(hex_part, 16)
+            except ValueError:
+                raise RegexError(
+                    f"bad \\x escape at {self.i}"
+                ) from None
             self.i += 2
             return frozenset({v})
         return frozenset(e.encode())  # \. \[ \\ etc (utf-8 single byte ok)
@@ -385,16 +402,123 @@ def build_token_fsm(dfa: ByteDfa, token_bytes: list[bytes]) -> TokenFsm:
     for s in range(dfa.n_states):
         cur = np.full(V, s, np.int32)
         for j in range(L):
-            live = j < lens
-            cur = np.where(live, padded[cur, mat[:, j]], cur)
+            alive = j < lens
+            cur = np.where(alive, padded[cur, mat[:, j]], cur)
         cur[lens == 0] = -1  # specials never advance a grammar
         trans[s] = cur
-    return TokenFsm(trans, dfa.accept.copy())
+    accept = dfa.accept.copy()
+    # Prune token-level dead ends (r2 advisor): with a real vocabulary a
+    # byte-DFA state can be reachable yet have NO whole token continuing
+    # toward acceptance — sampling would mask every logit and argmax would
+    # silently emit token 0, violating the grammar. A state is live iff it
+    # accepts or some token leads to a live state (greatest fixpoint);
+    # edges into dead states are cut, so every reachable state always has
+    # an admissible token or EOS.
+    valid = trans >= 0
+    tgt = np.where(valid, trans, 0)
+    live = accept.copy()
+    while True:
+        new_live = live | (valid & live[tgt]).any(axis=1)
+        if bool((new_live == live).all()):
+            break
+        live = new_live
+    if not live[0]:
+        raise RegexError(
+            "grammar admits no token sequence under this vocabulary"
+        )
+    trans[valid & ~live[tgt]] = -1
+    return TokenFsm(trans, accept)
+
+
+def _gpt2_unicode_to_byte() -> dict:
+    """Inverse of the GPT-2 byte→printable-unicode alphabet.
+
+    Byte-level BPE tokenizers (GPT-2, Llama-3, Qwen, …) store vocab pieces
+    over a 256-char printable alphabet: bytes that are already printable
+    ASCII/latin map to themselves, the rest shift up past U+0100. This is
+    the standard published mapping (the approach outlines/xgrammar use to
+    recover exact byte images); rebuilt here rather than decoding ids one
+    by one, which loses word-leading spaces and mangles partial UTF-8."""
+    keep = (list(range(ord("!"), ord("~") + 1))
+            + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    chars = list(keep)
+    n = 0
+    for b in range(256):
+        if b not in keep:
+            keep.append(b)
+            chars.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(keep, chars)}
+
+
+def _hf_token_byte_images(tk, vocab_size: int) -> list[bytes]:
+    """Byte image per id from the RAW vocab pieces of a HF tokenizer.
+
+    Why not ``decode([i])`` per id: SentencePiece/Metaspace tokenizers
+    strip the word-leading space when a piece is decoded alone
+    (decode('▁Hello') == 'Hello'), and byte-fallback / partial-UTF-8
+    byte-level pieces decode to U+FFFD — either desynchronizes the token
+    FSM from the actually-emitted text (r2 advisor, high). Instead read
+    ``convert_ids_to_tokens`` and undo the piece encoding directly:
+    Metaspace '▁'→' ', byte-level via the GPT-2 unicode↔byte alphabet,
+    ``<0xNN>`` byte-fallback pieces → that raw byte."""
+    n = len(tk)
+    special = set(getattr(tk, "all_special_ids", None) or [])
+    added = {}
+    for i, t in (getattr(tk, "added_tokens_decoder", None) or {}).items():
+        added[int(i)] = getattr(t, "content", str(t))
+    vocab = tk.get_vocab()
+    metaspace = any("▁" in p for p in vocab)
+    byte_level = not metaspace and any("Ġ" in p for p in vocab)
+    u2b = _gpt2_unicode_to_byte() if byte_level else None
+
+    pieces = tk.convert_ids_to_tokens(list(range(n)))
+    images: list[bytes] = []
+    for i in range(vocab_size):
+        if i >= n or i in special:
+            # padded-vocab ids (e.g. phi-3's 32064 vs 32011 real) and
+            # specials never advance a grammar
+            images.append(b"")
+            continue
+        if i in added:
+            # added tokens are stored literally, not piece-encoded
+            images.append(added[i].encode("utf-8"))
+            continue
+        p = pieces[i]
+        if p is None:
+            images.append(b"")
+            continue
+        if (len(p) == 6 and p.startswith("<0x") and p.endswith(">")):
+            try:
+                images.append(bytes([int(p[3:5], 16)]))  # byte fallback
+                continue
+            except ValueError:
+                pass
+        if byte_level:
+            images.append(bytes(u2b[ch] for ch in p if ch in u2b))
+        elif metaspace:
+            images.append(p.replace("▁", " ").encode("utf-8"))
+        else:
+            images.append(p.encode("utf-8"))
+    return images
 
 
 def token_byte_images(tokenizer, vocab_size: int) -> list[bytes]:
-    """Each id's byte contribution to decoded text (id-by-id decode; ids
-    whose decode is empty — specials — get b'')."""
+    """Each id's byte contribution to emitted text.
+
+    HF tokenizers take the raw-vocab-piece path (exact, incl. leading
+    spaces and byte fallback). The dependency-free ByteTokenizer's
+    id-by-id decode is exact by construction (ids ARE bytes)."""
+    from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+    if isinstance(tokenizer, ByteTokenizer):
+        # ids ARE bytes; going through decode() would mangle 0x80-0xFF
+        # into U+FFFD. Specials (bos/eos/pad and any padding) are b''.
+        return ([bytes([i]) for i in range(min(256, vocab_size))]
+                + [b""] * max(0, vocab_size - 256))
+    tk = getattr(tokenizer, "tk", None)
+    if tk is not None and hasattr(tk, "convert_ids_to_tokens"):
+        return _hf_token_byte_images(tk, vocab_size)
     return [
         tokenizer.decode([i]).encode("utf-8", errors="ignore")
         for i in range(vocab_size)

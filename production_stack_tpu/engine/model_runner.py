@@ -879,6 +879,14 @@ def _grammar_mask(logits, bank, accept, g_ids, g_states, eos_id):
     allowed = row_t >= 0
     if eos_id is not None:
         allowed = allowed.at[:, eos_id].max(accept[gi, st])
+        # dead-end guard: build_token_fsm prunes unreachable-acceptance
+        # states, so a fully-masked row should be impossible — but if one
+        # ever appears (tokenizer drift vs a cached FSM), degrade to EOS
+        # instead of letting argmax silently emit token 0 (r2 advisor)
+        dead = ~allowed.any(axis=-1, keepdims=True)
+        allowed = allowed | (
+            dead & (jnp.arange(allowed.shape[-1]) == eos_id)[None, :]
+        )
     con = (g_ids >= 0)[:, None]
     return jnp.where(con & ~allowed, NEG_INF, logits), row_t
 

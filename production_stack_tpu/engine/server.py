@@ -251,6 +251,7 @@ class EngineServer:
     async def _on_stop(self, app) -> None:
         self.async_engine.stop()
         self.metrics.unregister()
+        _release_jax_backend()
 
     # -- infra endpoints ------------------------------------------------------
     async def health(self, request: web.Request) -> web.Response:
@@ -1012,7 +1013,9 @@ class EngineServer:
                     compile_regex(
                         pat, max_states=self.config.max_grammar_states
                     )
-                except ValueError as e:
+                except (ValueError, IndexError, KeyError, TypeError) as e:
+                    # RegexError subclasses ValueError; the extra types
+                    # keep any residual parser edge case a 400, never a 500
                     err = f"invalid guided grammar: {e}"
             if err is not None:
                 return web.json_response(
@@ -1097,7 +1100,7 @@ class EngineServer:
             )
 
         adapter_slot = self.lora.slot_of(model)
-        gens, rids = [], []
+        reqs, rids = [], []
         for pi, prompt_ids in enumerate(prompt_ids_list):
             for j in range(n):
                 idx = pi * n + j
@@ -1110,10 +1113,31 @@ class EngineServer:
                     choice_sampling = dataclasses.replace(
                         sampling, seed=(sampling.seed + idx) & 0xFFFFFFFF
                     )
-                gens.append(self.async_engine.generate(
-                    prompt_ids, choice_sampling, crid,
-                    adapter_slot=adapter_slot,
-                ))
+                reqs.append((crid, prompt_ids, choice_sampling,
+                             adapter_slot))
+        # atomic admission on the engine thread: all requests add or none
+        # do, BEFORE this handler commits to a response. Grammar-bank
+        # exhaustion and vocab-infeasible grammars (which only surface
+        # when the token FSM is built against the real vocabulary) become
+        # clean statuses here instead of mid-flight stream errors.
+        from production_stack_tpu.engine.engine import GrammarBankFull
+
+        try:
+            gens = await self.async_engine.admit_batch(reqs)
+        except GrammarBankFull:
+            return web.json_response(
+                {"error": {"message":
+                           "all guided-decoding grammar slots are in use; "
+                           "retry when in-flight guided requests finish",
+                           "type": "rate_limit_error"}},
+                status=429,
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
         echo_info = None
         if echo:
             lps_list = []
@@ -1620,6 +1644,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "error_rate=0.3,latency_ms=100 (testing/faults.py)")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
+    p.add_argument("--platform", default=None,
+                   help="force the JAX platform (e.g. 'cpu' for a "
+                        "no-TPU dev/CI engine; env PSTPU_PLATFORM). Must be "
+                        "applied before backend init, so it is a server "
+                        "flag rather than plain JAX_PLATFORMS — the TPU "
+                        "tunnel's interpreter hook can pin the platform in "
+                        "jax config before main() runs")
     p.add_argument("--host-offload-blocks", type=int, default=0,
                    help="host-DRAM KV tier capacity (0 = off)")
     p.add_argument("--remote-kv-url", default=None,
@@ -1675,17 +1706,60 @@ def config_from_args(args) -> EngineConfig:
     return cfg
 
 
+def _release_jax_backend() -> None:
+    """Destroy the JAX client so the TPU (tunnel session) is freed.
+
+    A single-chip TPU grants one session at a time: a server that exits
+    without releasing it leaves the chip wedged for every later process
+    (this killed both round-2 driver artifacts). Idempotent; safe to call
+    from cleanup hooks, signal paths, and atexit.
+    """
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception as e:
+        # never raise from a shutdown path — but a silent no-op here would
+        # reintroduce the round-2 wedge invisibly, so say what happened
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "JAX backend release failed (%s: %s) — the chip/tunnel "
+            "session may stay held until process exit", type(e).__name__, e
+        )
+
+
 def main(argv=None) -> None:
+    import atexit
     import os
+    import signal
 
     args = build_parser().parse_args(argv)
+    platform = args.platform or os.environ.get("PSTPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     if args.fault_injection is not None:
         # "" arms the live /debug/faults toggle with no faults injected
         os.environ["FAULT_INJECTION"] = args.fault_injection
     config = config_from_args(args)
+    # run_app's own SIGINT/SIGTERM handlers raise GracefulExit → on_cleanup
+    # (_on_stop) releases the backend. atexit + a pre-loop SIGTERM handler
+    # cover exits that bypass the aiohttp cleanup path (e.g. a signal
+    # delivered during engine construction/warmup, before the loop runs) —
+    # so they are installed before EngineServer() first touches the chip.
+    atexit.register(_release_jax_backend)
+
+    def _early_term(signum, frame):
+        _release_jax_backend()
+        raise SystemExit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _early_term)
     server = EngineServer(config, warmup_on_start=not args.skip_warmup)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
+    _release_jax_backend()
 
 
 if __name__ == "__main__":

@@ -273,6 +273,50 @@ def test_server_guided_endpoints(setup):
     assert asyncio.run(fn())
 
 
+def test_server_429_when_grammar_bank_exhausted(setup):
+    """Bank exhaustion must be refused at validation (429), not surface as
+    a failure after the handler committed (r2 advisor)."""
+    import asyncio
+
+    from production_stack_tpu.engine.server import EngineServer
+
+    cfg, mesh, params = setup  # max_grammars=2
+    eng = LLMEngine(cfg, mesh=mesh, params=params,
+                    num_blocks=cfg.cache.num_blocks)
+    server = EngineServer(cfg, engine=eng)
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    # occupy both slots with live (unfinished) guided requests
+    eng.add_request("hold-1", prompt_token_ids=[1], sampling=dataclasses
+                    .replace(sp, guided_regex="[ab]+"))
+    eng.add_request("hold-2", prompt_token_ids=[2], sampling=dataclasses
+                    .replace(sp, guided_regex="[cd]+"))
+    assert eng.grammar_slot_available(guided_regex="[ab]+")  # cached key
+    assert not eng.grammar_slot_available(guided_regex="[xy]+")
+
+    async def fn():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async with TestClient(TestServer(server.build_app())) as client:
+            r = await client.post("/v1/completions", json={
+                "model": "tiny-llama", "prompt": "x", "max_tokens": 4,
+                "guided_regex": "[xy]+",
+            })
+            assert r.status == 429, await r.text()
+            body = await r.json()
+            assert body["error"]["type"] == "rate_limit_error"
+            # a CACHED grammar is still admissible while slots are full
+            r = await client.post("/v1/completions", json={
+                "model": "tiny-llama", "prompt": "go", "max_tokens": 4,
+                "temperature": 0, "guided_regex": "[ab]+",
+            })
+            assert r.status == 200, await r.text()
+        return True
+
+    assert asyncio.run(fn())
+    while eng.has_unfinished():
+        eng.step()
+
+
 def test_guided_finishes_at_accept_state(setup):
     """A fully-matched pattern with no continuation must force EOS — the
     request finishes by stop, not by max_tokens."""
