@@ -1,0 +1,178 @@
+"""Leader→follower step-plan broadcast for multi-host serving.
+
+In JAX's multi-controller model every process must issue the SAME device
+programs in the SAME order. Serving is asymmetric — only one process sees
+HTTP requests and runs the scheduler — so the leader (process 0) mirrors
+every ModelRunner call to the followers over a tiny length-prefixed
+pickle protocol, and followers replay the identical call against their
+local runner shard. All runner inputs are host numpy arrays that are
+REPLICATED by construction (token ids, block tables, sampling params), so
+replaying the call on each process feeds jit the same global values; the
+sharded params/KV supply each process's local shards.
+
+This replaces the reference's Ray object/RPC control plane for
+cross-node pipeline parallelism (reference:
+helm/templates/ray-cluster.yaml:332-335 — Ray head/worker groups;
+SURVEY.md §2.9 PP row). Data-plane collectives never touch this channel:
+they ride ICI/DCN inside XLA programs. The broadcast carries only step
+plans — a few KB per step.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("!Q")
+
+# methods the leader mirrors: every runner entry point that issues device
+# work. Host-only accessors (num_blocks, tp, ...) are not mirrored.
+MIRRORED_METHODS = (
+    "prefill", "prefill_ring", "verify", "decode", "decode_multi",
+    "sample", "set_count_row", "register_grammar", "register_lora",
+    "unregister_lora", "export_blocks", "import_blocks",
+    "import_blocks_range", "drop_kv", "restore_kv", "drop_params",
+    "restore_params", "pooled_embed", "sequence_logprobs",
+    "prompt_logprobs",
+)
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = io.BytesIO()
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(1 << 20, n - got))
+        if not chunk:
+            return None
+        buf.write(chunk)
+        got += len(chunk)
+    return buf.getvalue()
+
+
+class LeaderBroadcaster:
+    """Accepts one connection per follower, then fans out step plans."""
+
+    def __init__(self, port: int, num_followers: int,
+                 accept_timeout: float = 300.0):
+        self.num_followers = num_followers
+        self.server = socket.create_server(("0.0.0.0", port), backlog=16)
+        self.server.settimeout(accept_timeout)
+        self.conns: list[socket.socket] = []
+        self.lock = threading.Lock()
+
+    def wait_for_followers(self) -> None:
+        while len(self.conns) < self.num_followers:
+            conn, addr = self.server.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            logger.info("follower connected from %s (%d/%d)", addr,
+                        len(self.conns) + 1, self.num_followers)
+            self.conns.append(conn)
+
+    def broadcast(self, method: str, args: tuple, kwargs: dict) -> None:
+        payload = pickle.dumps((method, args, kwargs), protocol=5)
+        with self.lock:
+            for conn in self.conns:
+                _send_msg(conn, payload)
+
+    def close(self) -> None:
+        try:
+            self.broadcast("_shutdown", (), {})
+        except Exception:
+            pass
+        for conn in self.conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self.server.close()
+
+
+class MirroredRunner:
+    """Leader-side runner wrapper: broadcast the call, then run it locally.
+
+    The broadcast happens BEFORE the local dispatch so followers can
+    overlap deserialization with the leader's own host work; ordering per
+    follower is the TCP stream order, which equals the leader's program
+    order — the SPMD contract."""
+
+    def __init__(self, inner, broadcaster: LeaderBroadcaster):
+        self._inner = inner
+        self._bcast = broadcaster
+        for name in MIRRORED_METHODS:
+            if hasattr(inner, name):
+                setattr(self, name, self._make_mirror(name))
+
+    def _make_mirror(self, name: str):
+        fn = getattr(self._inner, name)
+
+        def mirrored(*args, **kwargs):
+            self._bcast.broadcast(name, args, kwargs)
+            return fn(*args, **kwargs)
+
+        mirrored.__name__ = name
+        return mirrored
+
+    def __getattr__(self, name):  # host-only attrs pass straight through
+        return getattr(self._inner, name)
+
+
+def follower_loop(runner, leader_host: str, control_port: int,
+                  connect_timeout: float = 300.0) -> None:
+    """Replay the leader's runner calls against the local shard forever.
+
+    Outputs are discarded — with replicated out_shardings
+    (model_runner.py multihost gate) every result is addressable on the
+    leader, and followers only need to keep the SPMD program order."""
+    deadline = time.monotonic() + connect_timeout
+    sock = None
+    while True:
+        try:
+            sock = socket.create_connection((leader_host, control_port),
+                                            timeout=5.0)
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"could not reach leader at {leader_host}:{control_port}"
+                )
+            time.sleep(0.5)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    logger.info("connected to leader %s:%d", leader_host, control_port)
+    while True:
+        payload = _recv_msg(sock)
+        if payload is None:
+            logger.info("leader closed the control channel; exiting")
+            return
+        method, args, kwargs = pickle.loads(payload)
+        if method == "_shutdown":
+            logger.info("shutdown from leader")
+            return
+        try:
+            # replay EXACTLY (including fetch behavior): with the runner's
+            # multihost replicated out_shardings every output is locally
+            # addressable, so fetches are cheap host copies on followers
+            getattr(runner, method)(*args, **kwargs)
+        except Exception:
+            logger.exception("follower replay of %s failed — the SPMD "
+                             "order is broken; exiting", method)
+            raise

@@ -1,0 +1,88 @@
+"""Multi-host (multi-process) serving: jax.distributed wiring.
+
+The reference serves models bigger than one node with KubeRay +
+``vllm serve --pipeline-parallel-size`` across pods
+(reference: helm/templates/ray-cluster.yaml:332-335,716-717 — a Ray head
+and worker group per engine). The TPU-native equivalent is JAX's
+multi-controller runtime: every pod of a multi-host TPU slice runs the
+SAME program, ``jax.distributed.initialize`` connects them through a
+coordinator, and ``jax.devices()`` becomes the global device list so one
+``Mesh`` spans hosts — XLA then schedules collectives over ICI within a
+host and DCN across hosts. No Ray: the only control plane we add is a
+tiny TCP step-plan broadcast from the serving leader to followers
+(engine/multihost.py).
+
+Process topology comes from the chart (StatefulSet + headless Service):
+pod ordinal = process id, pod 0's stable DNS name = coordinator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """Multi-process topology. All fields default from env so the chart
+    can wire them without touching argv (PSTPU_COORDINATOR,
+    PSTPU_NUM_PROCESSES, PSTPU_PROCESS_ID, PSTPU_CONTROL_PORT)."""
+
+    coordinator: Optional[str] = None  # host:port of process 0
+    num_processes: int = 1
+    process_id: int = 0
+    # leader→follower step-plan channel (engine/multihost.py); the
+    # coordinator port is jax.distributed's, this one is ours
+    control_port: int = 18100
+
+    @classmethod
+    def from_env(cls, coordinator=None, num_processes=None, process_id=None,
+                 control_port=None) -> "DistributedConfig":
+        def pick(arg, env, cast, default):
+            if arg is not None:
+                return arg
+            v = os.environ.get(env)
+            return cast(v) if v else default
+
+        return cls(
+            coordinator=pick(coordinator, "PSTPU_COORDINATOR", str, None),
+            num_processes=pick(num_processes, "PSTPU_NUM_PROCESSES", int, 1),
+            process_id=pick(process_id, "PSTPU_PROCESS_ID", int, 0),
+            control_port=pick(control_port, "PSTPU_CONTROL_PORT", int, 18100),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def coordinator_host(self) -> str:
+        return (self.coordinator or "127.0.0.1").rsplit(":", 1)[0]
+
+
+def initialize_distributed(cfg: DistributedConfig) -> None:
+    """Connect this process to the multi-controller runtime.
+
+    Must run before the first backend touch; afterwards jax.devices() is
+    global and every jit over a multi-host mesh is SPMD across processes
+    (each process must issue the same programs in the same order — the
+    engine guarantees that via the leader's step-plan broadcast)."""
+    if not cfg.enabled:
+        return
+    if cfg.coordinator is None:
+        raise ValueError(
+            "multi-host serving needs --distributed-coordinator "
+            "(host:port of process 0) when num_processes > 1"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
