@@ -202,6 +202,28 @@ def run_bench() -> None:
     }))
 
 
+def _reap_stale_holders() -> None:
+    """Kill leftover TPU-holder processes before touching the backend.
+
+    The single-chip tunnel admits ONE session: any process left over from
+    an earlier run (engine server, bench child, pytest worker) keeps the
+    chip held and every later backend init hangs — that produced the
+    empty BENCH_r02/r03 artifacts. scripts/tpu_reaper.py enumerates and
+    kills exactly those; infrastructure is never touched.
+    PSTPU_BENCH_NO_REAP=1 disables (e.g. when sharing the machine with a
+    live server on purpose)."""
+    if os.environ.get("PSTPU_BENCH_NO_REAP") == "1":
+        return
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from scripts.tpu_reaper import reap
+
+        reap(grace=5.0)
+    except Exception as e:  # reaping is best-effort; the probe still runs
+        print(f"tpu_reaper failed ({type(e).__name__}: {e}); probing anyway",
+              file=sys.stderr, flush=True)
+
+
 def _probe_backend(timeout: float) -> tuple[bool, str]:
     """Initialize the JAX backend in a disposable child; report viability.
 
@@ -260,12 +282,15 @@ def main() -> None:
     probe_timeout = float(os.environ.get("PSTPU_BENCH_PROBE_TIMEOUT", "240"))
     bench_timeout = float(os.environ.get("PSTPU_BENCH_TIMEOUT", "1800"))
     cooldown = float(os.environ.get("PSTPU_BENCH_COOLDOWN", "30"))
+    attempts = int(os.environ.get("PSTPU_BENCH_ATTEMPTS", "3"))
     errors = []
-    for attempt in range(2):
+    for attempt in range(attempts):
         if attempt:
-            print(f"bench attempt 1 failed ({errors[-1]}); retrying after "
-                  f"{cooldown:.0f}s cooldown", file=sys.stderr, flush=True)
+            print(f"bench attempt {attempt} failed ({errors[-1]}); retrying "
+                  f"after {cooldown:.0f}s cooldown",
+                  file=sys.stderr, flush=True)
             time.sleep(cooldown)
+        _reap_stale_holders()
         ok, diag = _probe_backend(probe_timeout)
         if not ok:
             errors.append(diag)

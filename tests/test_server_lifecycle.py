@@ -84,6 +84,42 @@ def test_sigterm_while_serving_exits_promptly():
             proc.wait()
 
 
+import pytest
+
+
+@pytest.mark.parametrize("run", range(3))
+def test_no_orphan_children_after_exit(run):
+    """No descendant process survives the server (chip-hygiene gate).
+
+    An orphaned child holding a JAX backend is exactly what wedges the
+    single-chip tunnel (BENCH_r02/r03: "backend init exceeded 240s").
+    Looped 3x (VERDICT r3 #9 asks for flake-free repetition): descendants
+    are snapshotted via psutil BEFORE SIGTERM, and every one of them must
+    be gone after the parent exits. Determinism: the snapshot is taken
+    after /health returns, so no startup race; psutil.Process identity
+    (pid+create_time) can't confuse pid reuse."""
+    import psutil
+
+    port = _free_port()
+    proc = _spawn_server(port)
+    try:
+        _wait_healthy(port, proc)
+        parent = psutil.Process(proc.pid)
+        children = parent.children(recursive=True)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0, f"expected clean exit, got rc={rc}"
+        gone, alive = psutil.wait_procs(children, timeout=10)
+        assert not alive, (
+            f"orphaned children survived server exit (run {run}): "
+            f"{[(p.pid, ' '.join(p.cmdline())[:80]) for p in alive]}"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
 def test_sigterm_during_startup_exits_promptly():
     """The pre-loop handler covers signals before the aiohttp loop runs."""
     port = _free_port()
