@@ -10,6 +10,7 @@ reference: src/vllm_router/service_discovery.py:757-765).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import queue
 import threading
 import time
@@ -99,9 +100,25 @@ class AsyncEngine:
             elif kind == "call":
                 fn, fut = payload
                 try:
-                    fut.set_result(fn(self.engine))
+                    result = fn(self.engine)
                 except Exception as e:
-                    fut.set_exception(e)
+                    err = e
+                    result = None
+                else:
+                    err = None
+                # the awaiting task may have been cancelled meanwhile
+                # (asyncio.wrap_future propagates cancellation to this
+                # future); set_result would then raise InvalidStateError
+                # and kill the worker thread — every later stream would
+                # hang forever
+                try:
+                    if not fut.cancelled():
+                        if err is not None:
+                            fut.set_exception(err)
+                        else:
+                            fut.set_result(result)
+                except concurrent.futures.InvalidStateError:
+                    pass
             try:
                 item = self.intake.get_nowait()
             except queue.Empty:
@@ -169,9 +186,15 @@ class AsyncEngine:
 
         try:
             await self.run_on_engine(add_all)
-        except Exception:
+        except BaseException:
+            # BaseException: asyncio.CancelledError (client disconnect
+            # mid-admission) must ALSO deregister the streams and abort the
+            # admitted rids — otherwise they run with no consumer forever.
+            # The abort intake items are queued after the add_all call item,
+            # so the worker always processes them in order.
             for rid in qs:
                 self.streams.pop(rid, None)
+                self.abort(rid)
             raise
         return [self._consume(rid, q) for rid, q in qs.items()]
 

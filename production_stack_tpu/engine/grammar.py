@@ -467,6 +467,13 @@ def _hf_token_byte_images(tk, vocab_size: int) -> list[bytes]:
     added = {}
     for i, t in (getattr(tk, "added_tokens_decoder", None) or {}).items():
         added[int(i)] = getattr(t, "content", str(t))
+        # tokens flagged special=True in added_tokens_decoder (Llama-3-style
+        # <|reserved_...|> control tokens) are dropped by
+        # decode(skip_special_tokens=True) even when they're missing from
+        # all_special_ids — a literal byte image would advance the FSM with
+        # text that never appears in output (r3 advisor)
+        if getattr(t, "special", False):
+            special.add(int(i))
     vocab = tk.get_vocab()
     metaspace = any("▁" in p for p in vocab)
     byte_level = not metaspace and any("Ġ" in p for p in vocab)

@@ -85,7 +85,15 @@ def _matches(proc) -> str | None:
         if s in cmd:
             return f"cmdline:{s}"
     if is_python and ("pytest" in cmd or "py.test" in cmd):
-        return "cmdline:pytest"
+        # only pytest runs of THIS repo: an unrelated checkout's (or
+        # colleague's) test run must not be collateral
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            cwd = proc.cwd()
+        except (psutil.Error, OSError):
+            cwd = ""
+        if cwd.startswith(repo) or repo in cmd:
+            return "cmdline:pytest"
     try:
         env = proc.environ()
         for s in _ENV_SIGNALS:
@@ -136,11 +144,17 @@ def _remove_stale_lockfiles(log) -> None:
 
 
 def reap(grace: float = 5.0, dry_run: bool = False,
+         exclude: set[int] | None = None,
          log=lambda m: print(m, file=sys.stderr, flush=True)) -> int:
-    """Kill stale holders; returns how many were found."""
+    """Kill stale holders; returns how many were found.
+
+    Lockfiles are removed only when every holder is confirmed dead — a
+    SIGKILL survivor (e.g. stuck in uninterruptible sleep on the dead
+    tunnel) still owns its lockfile, and deleting it would let a second
+    client bypass libtpu's mutual exclusion."""
     import psutil
 
-    holders = find_stale_holders()
+    holders = find_stale_holders(exclude=exclude)
     if not holders:
         _remove_stale_lockfiles(log)
         return 0
@@ -165,7 +179,12 @@ def reap(grace: float = 5.0, dry_run: bool = False,
             proc.kill()
         except psutil.Error:
             pass
-    psutil.wait_procs(alive, timeout=grace)
+    _, survivors = psutil.wait_procs(alive, timeout=grace)
+    if survivors:
+        log(f"WARNING: {len(survivors)} holder(s) survived SIGKILL "
+            f"(pids {[p.pid for p in survivors]}) — unkillable (D-state?); "
+            "keeping lockfiles, the chip may stay held")
+        return len(holders)
     _remove_stale_lockfiles(log)
     return len(holders)
 

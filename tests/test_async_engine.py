@@ -1,0 +1,119 @@
+"""AsyncEngine facade: admission atomicity and cancellation hygiene.
+
+The r3 advisor found that a client disconnect during ``admit_batch``
+(asyncio.CancelledError while awaiting admission) left the stream queues
+registered forever and the admitted requests running with no consumer.
+These tests pin the BaseException cleanup path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+
+import pytest
+
+from production_stack_tpu.engine.async_engine import AsyncEngine
+from production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.weights import init_or_load
+from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = EngineConfig(
+        model=ModelConfig.from_pretrained("tiny-llama"),
+        cache=CacheConfig(block_size=4, num_blocks=128),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=32,
+            prefill_buckets=(16, 32),
+        ),
+        mesh=MeshConfig(data=1, tensor=1),
+    )
+    mesh = build_mesh(cfg.mesh)
+    params = init_or_load(cfg.model, mesh, seed=0)
+    return cfg, mesh, params
+
+
+def test_admit_batch_cancelled_mid_admission_cleans_up(setup):
+    """Cancel while awaiting admission: streams deregistered, admitted
+    requests aborted (the aborts are queued behind the add on the intake
+    queue, so ordering is deterministic)."""
+    cfg, mesh, params = setup
+    eng = LLMEngine(cfg, mesh=mesh, params=params,
+                    num_blocks=cfg.cache.num_blocks)
+    sp = SamplingParams(temperature=0.0, max_tokens=64, ignore_eos=True)
+
+    async def fn():
+        ae = AsyncEngine(eng)
+        await ae.start()
+        try:
+            # wedge the worker thread so the admission call can't complete
+            # before we cancel
+            release = threading.Event()
+            ae.intake.put((
+                "call",
+                (lambda e: release.wait(10), concurrent.futures.Future()),
+            ))
+            task = asyncio.ensure_future(ae.admit_batch([
+                ("cancelled-1", [1, 2, 3], sp, 0),
+                ("cancelled-2", [4, 5], sp, 0),
+            ]))
+            await asyncio.sleep(0.2)
+            assert set(ae.streams) == {"cancelled-1", "cancelled-2"}
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            # streams deregistered synchronously on the cancel path
+            assert ae.streams == {}
+            release.set()
+            # the worker processes add_all, then the queued aborts: the
+            # engine must end up empty without anyone consuming outputs
+            for _ in range(100):
+                busy = await ae.run_on_engine(
+                    lambda e: e.has_unfinished()
+                )
+                if not busy:
+                    break
+                await asyncio.sleep(0.05)
+            assert not busy
+        finally:
+            ae.stop()
+        return True
+
+    assert asyncio.run(fn())
+
+
+def test_admit_batch_failure_aborts_siblings(setup):
+    """All-or-nothing: a failing request aborts the already-added ones and
+    deregisters every stream."""
+    cfg, mesh, params = setup
+    eng = LLMEngine(cfg, mesh=mesh, params=params,
+                    num_blocks=cfg.cache.num_blocks)
+    good = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    async def fn():
+        ae = AsyncEngine(eng)
+        await ae.start()
+        try:
+            with pytest.raises(Exception):
+                await ae.admit_batch([
+                    ("sib-1", [1, 2], good, 0),
+                    # over-long prompt: add_request rejects it
+                    ("sib-2", list(range(10_000)), good, 0),
+                ])
+            assert ae.streams == {}
+            assert not await ae.run_on_engine(lambda e: e.has_unfinished())
+        finally:
+            ae.stop()
+        return True
+
+    assert asyncio.run(fn())

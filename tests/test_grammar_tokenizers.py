@@ -126,6 +126,29 @@ def test_metaspace_leading_space_token_admitted(metaspace_tok):
     assert nxt >= 0 and fsm.accept[nxt]
 
 
+def test_added_special_token_outside_all_special_ids_gets_empty_image():
+    """Added tokens flagged special=True (Llama-3-style <|reserved_...|>
+    control tokens) are dropped by decode(skip_special_tokens=True) even
+    when they never make it into all_special_ids — a literal byte image
+    would advance the FSM with text that never appears (r3 advisor)."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from transformers import AddedToken, PreTrainedTokenizerFast
+
+    vocab = {"<|end|>": 0, "a": 1, "b": 2}
+    tok = Tokenizer(models.BPE(vocab=vocab, merges=[], unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    hf = PreTrainedTokenizerFast(tokenizer_object=tok, eos_token="<|end|>")
+    hf.add_tokens([AddedToken("<|reserved_0|>", special=True)])
+    hf.add_tokens([AddedToken("<|tool|>", special=False)])
+    rid = hf.convert_tokens_to_ids("<|reserved_0|>")
+    tid = hf.convert_tokens_to_ids("<|tool|>")
+    assert rid not in set(hf.all_special_ids)  # the advisor's precondition
+    imgs = token_byte_images(_wrap(hf), len(hf))
+    assert imgs[rid] == b""                    # dropped from decoded text
+    assert imgs[tid] == b"<|tool|>"            # non-special stays literal
+
+
 def test_byte_tokenizer_images_exact():
     imgs = token_byte_images(ByteTokenizer(), 259)
     assert imgs[0x41] == b"A"

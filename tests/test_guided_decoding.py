@@ -284,8 +284,12 @@ def test_server_429_when_grammar_bank_exhausted(setup):
     eng = LLMEngine(cfg, mesh=mesh, params=params,
                     num_blocks=cfg.cache.num_blocks)
     server = EngineServer(cfg, engine=eng)
-    sp = SamplingParams(temperature=0.0, max_tokens=8)
-    # occupy both slots with live (unfinished) guided requests
+    # occupy both slots with live guided requests that CANNOT finish
+    # before the asserts run (ignore_eos + large max_tokens): with short
+    # holds the worker thread could complete them before the first POST,
+    # freeing the slots and turning the expected 429 into a flaky 200
+    # (r3 advisor). They are explicitly aborted below.
+    sp = SamplingParams(temperature=0.0, max_tokens=512, ignore_eos=True)
     eng.add_request("hold-1", prompt_token_ids=[1], sampling=dataclasses
                     .replace(sp, guided_regex="[ab]+"))
     eng.add_request("hold-2", prompt_token_ids=[2], sampling=dataclasses
@@ -313,6 +317,8 @@ def test_server_429_when_grammar_bank_exhausted(setup):
         return True
 
     assert asyncio.run(fn())
+    eng.abort_request("hold-1")
+    eng.abort_request("hold-2")
     while eng.has_unfinished():
         eng.step()
 
