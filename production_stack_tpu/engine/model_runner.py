@@ -102,15 +102,34 @@ class ModelRunner:
         # EOS lives (allowed exactly in accepting FSM states)
         self._eos_id = get_tokenizer(config.model.tokenizer).eos_id
 
+        # multihost gate (engine/multihost.py contract): with more than one
+        # controller process, every result the leader fetches must come out
+        # fully REPLICATED so jax.device_get is a local host copy on each
+        # process — a partially-sharded output is not addressable from one
+        # controller. The (None, repl) prefix keeps the donated KV pool on
+        # its own sharding (auto) and replicates only the small result
+        # leaves (sampled tokens, logprobs). Single-process: no gate.
+        self._replicate_results = jax.process_count() > 1
+        if self._replicate_results:
+            _repl = NamedSharding(mesh, P())
+            self._mh_gate = {"out_shardings": (None, _repl)}
+            self._mh_gate_all = {"out_shardings": _repl}
+        else:
+            _repl = None
+            self._mh_gate = {}
+            self._mh_gate_all = {}
+
         self._prefill = jax.jit(
             functools.partial(_prefill_step, self.cfg, self._attend_prefill,
                               self._eos_id),
             donate_argnums=(1,),
             static_argnames=("greedy_only", "use_controls", "use_grammar"),
+            **self._mh_gate,
         )
         self._decode = jax.jit(
             functools.partial(_decode_step, self.cfg, self._attend_decode),
             donate_argnums=(1,),
+            **self._mh_gate,
         )
         self._decode_multi = jax.jit(
             functools.partial(
@@ -121,12 +140,14 @@ class ModelRunner:
             static_argnames=("block_size", "greedy_only", "use_penalties",
                              "use_controls", "want_logprobs",
                              "use_grammar"),
+            **self._mh_gate,
         )
         self._sample = jax.jit(sample_tokens)
         if config.scheduler.spec_ngram_k > 0:
             self._verify = jax.jit(
                 functools.partial(_verify_step, self.cfg, self._attend_prefill),
                 donate_argnums=(1,),
+                **self._mh_gate,
             )
         from production_stack_tpu.parallel.mesh import AXIS_SEQ
 
@@ -144,6 +165,7 @@ class ModelRunner:
                 ),
                 donate_argnums=(1,),
                 static_argnames=("greedy_only", "use_controls"),
+                **self._mh_gate,
             )
         # per-slot output-token counts for presence/frequency penalties
         # ((B, V) int32; allocated on first penalised batch)
@@ -191,6 +213,11 @@ class ModelRunner:
             x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
         )
         try:
+            if self._replicate_results:
+                # multihost: every process must size the SAME pool — local
+                # memory_stats can differ across hosts, so use the
+                # deterministic assumption path
+                raise RuntimeError("deterministic multihost sizing")
             stats = jax.local_devices()[0].memory_stats()
             hbm = stats["bytes_limit"]
             used = stats["bytes_in_use"]
@@ -609,7 +636,7 @@ class ModelRunner:
                 pooled = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
                 return pooled / jnp.maximum(jnp.sum(m, axis=1), 1.0)
 
-            self._pooled_fn = jax.jit(_embed)
+            self._pooled_fn = jax.jit(_embed, **self._mh_gate_all)
         with jax.set_mesh(self.mesh):
             out = self._pooled_fn(
                 self.params, jnp.asarray(tokens), jnp.asarray(mask)
@@ -658,7 +685,7 @@ class ModelRunner:
                     picked * cont_mask[:, 1:].astype(jnp.float32), axis=-1
                 )
 
-            self._seqlp_fn = jax.jit(_score)
+            self._seqlp_fn = jax.jit(_score, **self._mh_gate_all)
         with jax.set_mesh(self.mesh):
             out = self._seqlp_fn(
                 self.params, jnp.asarray(tokens), jnp.asarray(cont_mask)
@@ -720,7 +747,7 @@ class ModelRunner:
                         ids.reshape(n, -1)[: S - 1],
                         lps.reshape(n, -1)[: S - 1])
 
-            self._prompt_lp_fn = jax.jit(_score)
+            self._prompt_lp_fn = jax.jit(_score, **self._mh_gate_all)
         with jax.set_mesh(self.mesh):
             out = self._prompt_lp_fn(self.params, jnp.asarray(tokens))
         return tuple(np.asarray(x) for x in jax.device_get(out))
@@ -791,7 +818,8 @@ class ModelRunner:
         """Gather blocks out of HBM → host (L, n, bs, 2KH, D) array."""
         idx = jnp.asarray(block_ids, jnp.int32)
         with jax.set_mesh(self.mesh):
-            data = jax.jit(lambda kv, i: kv[:, i])(self.kv, idx)
+            data = jax.jit(lambda kv, i: kv[:, i],
+                           **self._mh_gate_all)(self.kv, idx)
         return np.asarray(jax.device_get(data))
 
     def _range_fns(self, n_layers: int):
@@ -812,7 +840,7 @@ class ModelRunner:
                                                            axis=0)
 
             cache[n_layers] = (
-                jax.jit(_slice),
+                jax.jit(_slice, **self._mh_gate_all),
                 jax.jit(_scatter, donate_argnums=(0,)),
             )
         return cache[n_layers]

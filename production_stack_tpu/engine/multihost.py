@@ -3,12 +3,12 @@
 In JAX's multi-controller model every process must issue the SAME device
 programs in the SAME order. Serving is asymmetric — only one process sees
 HTTP requests and runs the scheduler — so the leader (process 0) mirrors
-every ModelRunner call to the followers over a tiny length-prefixed
-pickle protocol, and followers replay the identical call against their
-local runner shard. All runner inputs are host numpy arrays that are
-REPLICATED by construction (token ids, block tables, sampling params), so
-replaying the call on each process feeds jit the same global values; the
-sharded params/KV supply each process's local shards.
+every ModelRunner call to the followers over a tiny authenticated
+length-prefixed frame protocol, and followers replay the identical call
+against their local runner shard. All runner inputs are host numpy arrays
+that are REPLICATED by construction (token ids, block tables, sampling
+params), so replaying the call on each process feeds jit the same global
+values; the sharded params/KV supply each process's local shards.
 
 This replaces the reference's Ray object/RPC control plane for
 cross-node pipeline parallelism (reference:
@@ -16,12 +16,30 @@ helm/templates/ray-cluster.yaml:332-335 — Ray head/worker groups;
 SURVEY.md §2.9 PP row). Data-plane collectives never touch this channel:
 they ride ICI/DCN inside XLA programs. The broadcast carries only step
 plans — a few KB per step.
+
+Security (r3 advisor): every frame is authenticated with
+HMAC-SHA256 over a shared secret (``PSTPU_CONTROL_SECRET``, injected by
+the chart from a Kubernetes Secret), payloads are deserialized by a
+restricted unpickler that admits only numpy arrays / scalars / builtin
+containers / ``TokenFsm``, and a per-connection monotonically increasing
+sequence number rejects replayed frames. Multi-host serving REFUSES to
+start without a secret.
+
+Device-resident chaining: the engine's chained decode path passes the
+previous dispatch's un-fetched ``next_tok`` device array as
+``tokens_dev`` (engine.py _run_decode). Device arrays can't cross the
+wire — the leader's mirror replaces them with a sentinel and each
+follower substitutes its OWN cached ``next_tok`` from its replay of the
+previous ``decode_multi`` (identical by the SPMD contract).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import io
 import logging
+import os
 import pickle
 import socket
 import struct
@@ -29,34 +47,98 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("!Q")
+_MAC_BYTES = 32  # HMAC-SHA256
+_HELLO = b"pstpu-multihost-v1"
+# frame-size ceiling: the length header arrives BEFORE authentication, so
+# an unauthenticated peer must not be able to make us buffer unbounded
+# data. Step plans are KBs; KV-import frames reach tens of MB — the cap
+# leaves headroom (overridable for exotic block sizes).
+_MAX_FRAME = int(os.environ.get("PSTPU_CONTROL_MAX_FRAME",
+                                str(256 * 1024 * 1024)))
+_MAX_HELLO = 1024  # pre-auth handshake frames are tiny
+# sentinel for a device-resident arg the follower reconstructs locally
+_CHAINED_NEXT_TOK = "__pstpu_chained_next_tok__"
 
 # methods the leader mirrors: every runner entry point that issues device
 # work. Host-only accessors (num_blocks, tp, ...) are not mirrored.
+# ``sample``/``decode`` are NOT mirrored: their hot-path callers pass
+# device arrays (unpicklable) and the engine never calls them — the fused
+# ``decode_multi`` is the decode path (r3 advisor).
 MIRRORED_METHODS = (
-    "prefill", "prefill_ring", "verify", "decode", "decode_multi",
-    "sample", "set_count_row", "register_grammar", "register_lora",
-    "unregister_lora", "export_blocks", "import_blocks",
-    "import_blocks_range", "drop_kv", "restore_kv", "drop_params",
-    "restore_params", "pooled_embed", "sequence_logprobs",
+    "prefill", "prefill_ring", "verify", "decode_multi",
+    "set_count_row", "register_grammar", "register_lora",
+    "unregister_lora", "export_blocks", "export_blocks_range",
+    "import_blocks", "import_blocks_range", "drop_kv", "restore_kv",
+    "drop_params", "restore_params", "pooled_embed", "sequence_logprobs",
     "prompt_logprobs",
 )
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+def control_secret() -> bytes:
+    """The shared control-plane secret (PSTPU_CONTROL_SECRET).
+
+    Raises when unset: an unauthenticated step-plan channel would hand
+    arbitrary deserialization to any peer that can reach the port."""
+    s = os.environ.get("PSTPU_CONTROL_SECRET", "")
+    if not s:
+        raise ValueError(
+            "multi-host serving needs PSTPU_CONTROL_SECRET (shared "
+            "control-plane secret; the chart injects it from a Kubernetes "
+            "Secret — helm/templates/secrets.yaml)"
+        )
+    return s.encode()
 
 
-def _recv_msg(sock: socket.socket) -> Optional[bytes]:
-    hdr = b""
-    while len(hdr) < _LEN.size:
-        chunk = sock.recv(_LEN.size - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = _LEN.unpack(hdr)
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Admit only the types step plans actually carry."""
+
+    _ALLOWED = {
+        ("builtins", "tuple"), ("builtins", "list"), ("builtins", "dict"),
+        ("builtins", "set"), ("builtins", "frozenset"),
+        ("builtins", "bytes"), ("builtins", "bytearray"),
+        ("builtins", "str"), ("builtins", "int"), ("builtins", "float"),
+        ("builtins", "bool"), ("builtins", "complex"),
+        ("builtins", "slice"), ("builtins", "NoneType"),
+        ("numpy", "ndarray"), ("numpy", "dtype"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.numeric", "_frombuffer"),
+        ("numpy._core.numeric", "_frombuffer"),
+        ("production_stack_tpu.engine.grammar", "TokenFsm"),
+    }
+
+    def find_class(self, module, name):
+        # explicit allowlist ONLY — a module-wide numpy wildcard would
+        # admit callables like np.load(allow_pickle=True), re-opening the
+        # unrestricted-pickle door this class exists to close
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"step-plan payload requested forbidden type {module}.{name}"
+        )
+
+
+def _dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=5)
+
+
+def _loads(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def _send_frame(sock: socket.socket, payload: bytes, secret: bytes) -> None:
+    mac = hmac.new(secret, payload, hashlib.sha256).digest()
+    sock.sendall(_LEN.pack(len(payload)) + mac + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     buf = io.BytesIO()
     got = 0
     while got < n:
@@ -68,30 +150,76 @@ def _recv_msg(sock: socket.socket) -> Optional[bytes]:
     return buf.getvalue()
 
 
+def _recv_frame(sock: socket.socket, secret: bytes,
+                max_len: int = _MAX_FRAME) -> Optional[bytes]:
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > max_len:
+        raise ConnectionError(
+            f"control-plane frame of {n} bytes exceeds the {max_len}-byte "
+            "cap (unauthenticated length header — refusing to buffer)"
+        )
+    mac = _recv_exact(sock, _MAC_BYTES)
+    if mac is None:
+        return None
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    want = hmac.new(secret, payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(mac, want):
+        raise ConnectionError("control-plane frame failed HMAC check")
+    return payload
+
+
 class LeaderBroadcaster:
-    """Accepts one connection per follower, then fans out step plans."""
+    """Accepts one authenticated connection per follower, then fans out
+    step plans with a per-connection sequence number."""
 
     def __init__(self, port: int, num_followers: int,
+                 secret: Optional[bytes] = None,
+                 bind_host: Optional[str] = None,
                  accept_timeout: float = 300.0):
+        self.secret = secret if secret is not None else control_secret()
         self.num_followers = num_followers
-        self.server = socket.create_server(("0.0.0.0", port), backlog=16)
+        bind = (bind_host if bind_host is not None
+                else os.environ.get("PSTPU_CONTROL_BIND", "0.0.0.0"))
+        self.server = socket.create_server((bind, port), backlog=16)
         self.server.settimeout(accept_timeout)
         self.conns: list[socket.socket] = []
         self.lock = threading.Lock()
+        self.seq = 0
 
     def wait_for_followers(self) -> None:
         while len(self.conns) < self.num_followers:
             conn, addr = self.server.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # authenticate before counting: the follower's first frame
+            # must be the HELLO under the shared secret
+            try:
+                conn.settimeout(30.0)
+                hello = _recv_frame(conn, self.secret, max_len=_MAX_HELLO)
+            except (ConnectionError, OSError) as e:
+                logger.warning("rejecting connection from %s: %s", addr, e)
+                conn.close()
+                continue
+            if hello != _HELLO:
+                logger.warning("rejecting connection from %s: bad hello",
+                               addr)
+                conn.close()
+                continue
+            conn.settimeout(None)
             logger.info("follower connected from %s (%d/%d)", addr,
                         len(self.conns) + 1, self.num_followers)
             self.conns.append(conn)
 
     def broadcast(self, method: str, args: tuple, kwargs: dict) -> None:
-        payload = pickle.dumps((method, args, kwargs), protocol=5)
         with self.lock:
+            self.seq += 1
+            payload = _dumps((self.seq, method, args, kwargs))
             for conn in self.conns:
-                _send_msg(conn, payload)
+                _send_frame(conn, payload, self.secret)
 
     def close(self) -> None:
         try:
@@ -104,6 +232,16 @@ class LeaderBroadcaster:
             except Exception:
                 pass
         self.server.close()
+
+
+def _wire_safe(method: str, args: tuple, kwargs: dict) -> tuple:
+    """Strip device-resident args the follower reconstructs locally."""
+    if method == "decode_multi" and kwargs.get("tokens_dev") is not None:
+        td = kwargs["tokens_dev"]
+        if not isinstance(td, np.ndarray):
+            kwargs = dict(kwargs)
+            kwargs["tokens_dev"] = _CHAINED_NEXT_TOK
+    return args, kwargs
 
 
 class MirroredRunner:
@@ -125,7 +263,8 @@ class MirroredRunner:
         fn = getattr(self._inner, name)
 
         def mirrored(*args, **kwargs):
-            self._bcast.broadcast(name, args, kwargs)
+            w_args, w_kwargs = _wire_safe(name, args, kwargs)
+            self._bcast.broadcast(name, w_args, w_kwargs)
             return fn(*args, **kwargs)
 
         mirrored.__name__ = name
@@ -135,13 +274,40 @@ class MirroredRunner:
         return getattr(self._inner, name)
 
 
-def follower_loop(runner, leader_host: str, control_port: int,
-                  connect_timeout: float = 300.0) -> None:
-    """Replay the leader's runner calls against the local shard forever.
+class FollowerReplayer:
+    """Replays mirrored calls against the local runner shard.
 
-    Outputs are discarded — with replicated out_shardings
-    (model_runner.py multihost gate) every result is addressable on the
+    Caches the device-resident ``next_tok`` of each ``decode_multi``
+    replay so the leader's chained dispatches (tokens_dev sentinel)
+    resolve to this process's own copy — identical across processes by
+    the SPMD contract. Other outputs are discarded: with the runner's
+    multihost replicated out_shardings every result is addressable on the
     leader, and followers only need to keep the SPMD program order."""
+
+    def __init__(self, runner):
+        self.runner = runner
+        self._next_tok = None
+
+    def replay(self, method: str, args: tuple, kwargs: dict) -> None:
+        if kwargs.get("tokens_dev") == _CHAINED_NEXT_TOK:
+            if self._next_tok is None:
+                raise RuntimeError(
+                    "chained decode_multi replay without a cached "
+                    "next_tok — the SPMD order is broken"
+                )
+            kwargs = dict(kwargs)
+            kwargs["tokens_dev"] = self._next_tok
+        result = getattr(self.runner, method)(*args, **kwargs)
+        if method == "decode_multi" and not kwargs.get("fetch", True):
+            # fetch=False returns (sampled, next_tok) device arrays
+            self._next_tok = result[1]
+
+
+def follower_loop(runner, leader_host: str, control_port: int,
+                  secret: Optional[bytes] = None,
+                  connect_timeout: float = 300.0) -> None:
+    """Replay the leader's runner calls against the local shard forever."""
+    secret = secret if secret is not None else control_secret()
     deadline = time.monotonic() + connect_timeout
     sock = None
     while True:
@@ -157,21 +323,27 @@ def follower_loop(runner, leader_host: str, control_port: int,
             time.sleep(0.5)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.settimeout(None)
+    _send_frame(sock, _HELLO, secret)
     logger.info("connected to leader %s:%d", leader_host, control_port)
+    replayer = FollowerReplayer(runner)
+    last_seq = 0
     while True:
-        payload = _recv_msg(sock)
+        payload = _recv_frame(sock, secret)
         if payload is None:
             logger.info("leader closed the control channel; exiting")
             return
-        method, args, kwargs = pickle.loads(payload)
+        seq, method, args, kwargs = _loads(payload)
+        if seq <= last_seq:
+            raise ConnectionError(
+                f"control-plane frame replayed or reordered "
+                f"(seq {seq} after {last_seq})"
+            )
+        last_seq = seq
         if method == "_shutdown":
             logger.info("shutdown from leader")
             return
         try:
-            # replay EXACTLY (including fetch behavior): with the runner's
-            # multihost replicated out_shardings every output is locally
-            # addressable, so fetches are cheap host copies on followers
-            getattr(runner, method)(*args, **kwargs)
+            replayer.replay(method, args, kwargs)
         except Exception:
             logger.exception("follower replay of %s failed — the SPMD "
                              "order is broken; exiting", method)

@@ -1655,6 +1655,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host-DRAM KV tier capacity (0 = off)")
     p.add_argument("--remote-kv-url", default=None,
                    help="shared remote KV server URL (kv_server)")
+    # -- multi-host serving (replaces the reference's KubeRay + Ray
+    # executor: helm/templates/ray-cluster.yaml:332-335,716-717 there).
+    # Defaults come from env (PSTPU_COORDINATOR / PSTPU_NUM_PROCESSES /
+    # PSTPU_PROCESS_ID / PSTPU_CONTROL_PORT) so the chart's StatefulSet
+    # wires them without templating argv (parallel/distributed.py).
+    p.add_argument("--distributed-coordinator", default=None,
+                   help="host:port of process 0's jax.distributed "
+                        "coordinator (multi-host serving; env "
+                        "PSTPU_COORDINATOR)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total controller processes in the multi-host "
+                        "group (env PSTPU_NUM_PROCESSES)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's id; 0 serves HTTP and leads, "
+                        ">0 replays step plans (env PSTPU_PROCESS_ID)")
+    p.add_argument("--control-port", type=int, default=None,
+                   help="leader's step-plan broadcast port "
+                        "(engine/multihost.py; env PSTPU_CONTROL_PORT)")
     return p
 
 
@@ -1729,6 +1747,48 @@ def _release_jax_backend() -> None:
         )
 
 
+def _follower_main(config: EngineConfig, dist, http_host: str,
+                   http_port: int) -> None:
+    """Follower process: build the identical runner shard, serve a
+    minimal /health for K8s probes, replay the leader's step plans until
+    the control channel closes."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from production_stack_tpu.engine.model_runner import ModelRunner
+    from production_stack_tpu.engine.multihost import follower_loop
+    from production_stack_tpu.parallel.mesh import build_mesh
+
+    class _Health(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            body = _json.dumps({
+                "status": "follower", "process_id": dist.process_id,
+            }).encode()
+            self.send_response(200 if self.path == "/health" else 404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    httpd = ThreadingHTTPServer((http_host, http_port), _Health)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    # the same runner-construction sequence as LLMEngine.__init__ — each
+    # process must issue the identical device programs in the identical
+    # order (param init, quantization, KV-pool allocation)
+    mesh = build_mesh(config.mesh)
+    runner = ModelRunner(config, mesh, None, None)
+    try:
+        follower_loop(runner, dist.coordinator_host, dist.control_port)
+    finally:
+        httpd.shutdown()
+        _release_jax_backend()
+
+
 def main(argv=None) -> None:
     import atexit
     import os
@@ -1743,6 +1803,39 @@ def main(argv=None) -> None:
     if args.fault_injection is not None:
         # "" arms the live /debug/faults toggle with no faults injected
         os.environ["FAULT_INJECTION"] = args.fault_injection
+
+    from production_stack_tpu.parallel.distributed import (
+        DistributedConfig,
+        initialize_distributed,
+    )
+
+    dist = DistributedConfig.from_env(
+        coordinator=args.distributed_coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        control_port=args.control_port,
+    )
+    if dist.enabled:
+        from production_stack_tpu.engine.multihost import control_secret
+
+        control_secret()  # fail fast: no secret, no multi-host
+        if args.host_offload_blocks or args.remote_kv_url:
+            raise SystemExit(
+                "multi-host serving does not yet compose with the "
+                "host-offload / remote-KV tiers (their device transfers "
+                "run outside the mirrored runner)"
+            )
+        if args.pipeline_parallel_size > 1:
+            raise SystemExit(
+                "multi-host serving does not compose with the staged "
+                "pipeline runner: its per-stage submeshes don't span "
+                "every controller process, so followers outside a stage "
+                "can't address its outputs. Shard across hosts with "
+                "--tensor-parallel-size (GSPMD over ICI+DCN) instead."
+            )
+        # must precede the first backend touch: afterwards jax.devices()
+        # is the GLOBAL device list and one Mesh spans all hosts
+        initialize_distributed(dist)
     config = config_from_args(args)
     # run_app's own SIGINT/SIGTERM handlers raise GracefulExit → on_cleanup
     # (_on_stop) releases the backend. atexit + a pre-loop SIGTERM handler
@@ -1756,9 +1849,37 @@ def main(argv=None) -> None:
         raise SystemExit(128 + signum)
 
     signal.signal(signal.SIGTERM, _early_term)
-    server = EngineServer(config, warmup_on_start=not args.skip_warmup)
+
+    if dist.enabled and not dist.is_leader:
+        _follower_main(config, dist, args.host, args.port)
+        return
+
+    engine = LLMEngine(config)
+    broadcaster = None
+    if dist.enabled:
+        from production_stack_tpu.engine.multihost import (
+            LeaderBroadcaster,
+            MirroredRunner,
+        )
+
+        broadcaster = LeaderBroadcaster(dist.control_port,
+                                        dist.num_processes - 1)
+        import logging
+
+        logging.getLogger(__name__).info(
+            "waiting for %d follower(s) on control port %d",
+            dist.num_processes - 1, dist.control_port,
+        )
+        broadcaster.wait_for_followers()
+        # every later runner call (warmup included) is mirrored
+        engine.runner = MirroredRunner(engine.runner, broadcaster)
+        atexit.register(broadcaster.close)
+    server = EngineServer(config, engine=engine,
+                          warmup_on_start=not args.skip_warmup)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
+    if broadcaster is not None:
+        broadcaster.close()
     _release_jax_backend()
 
 

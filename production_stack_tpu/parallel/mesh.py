@@ -97,6 +97,19 @@ def build_mesh(
         )
     except Exception:
         device_array = np.asarray(devices).reshape(config.shape)
+    if jax.process_count() > 1:
+        # multi-controller: a mesh that omits any process's devices leaves
+        # that process with ZERO addressable shards — even "replicated"
+        # outputs are unfetchable there and its replay loop dies. Fail at
+        # construction, where the shape error is obvious.
+        procs = {d.process_index for d in np.asarray(device_array).flat}
+        if procs != set(range(jax.process_count())):
+            raise ValueError(
+                f"mesh {config.shape} covers processes {sorted(procs)} but "
+                f"the group has {jax.process_count()} — every controller "
+                "process must own a slice of the mesh (use -1 on the data "
+                "axis to absorb all devices)"
+            )
     return Mesh(device_array, MESH_AXES)
 
 

@@ -21,6 +21,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-compile tests"
+    )
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import jax

@@ -330,3 +330,112 @@ def test_operator_webhook_renders():
     client = cfgs[0]["webhooks"][0]["clientConfig"]["service"]
     assert client["name"] == svc[0]["metadata"]["name"]
     assert client["namespace"] == "default"
+
+
+MULTIHOST_VALUES = {
+    "secrets": {"create": True, "controlSecret": "s3cret"},
+    "servingEngineSpec": {"modelSpec": [{
+        "name": "llama70b",
+        "modelRef": "llama-3-70b",
+        "engineConfig": {
+            "maxModelLen": 8192, "maxNumSeqs": 32, "dtype": "bfloat16",
+            # model sharded across hosts by TP (GSPMD over ICI+DCN) — the
+            # staged PP runner does not compose with multihost (its
+            # per-stage submeshes don't span every controller process)
+            "tensorParallelSize": 32,
+        },
+        "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "4x8",
+                "chips": 8},
+        "multihost": {"enabled": True, "numHosts": 4},
+    }]},
+}
+
+
+def test_multihost_renders_statefulset_with_env_contract():
+    """The multi-host group replaces the reference's KubeRay RayCluster
+    (ray-cluster.yaml:332-335,716-717 there): StatefulSet + headless
+    Service, pod ordinal = process id, pod-0 DNS = coordinator — the env
+    contract parallel/distributed.py consumes."""
+    objs = render_objects(HELM, MULTIHOST_VALUES)
+    stss = by_kind(objs, "StatefulSet")
+    assert len(stss) == 1
+    sts = stss[0]
+    assert sts["spec"]["replicas"] == 4
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e for e in c["env"]}
+    assert env["PSTPU_NUM_PROCESSES"]["value"] == "4"
+    # process id from the StatefulSet pod-index label
+    assert (env["PSTPU_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "metadata.labels['apps.kubernetes.io/pod-index']")
+    # coordinator = pod 0's stable DNS through the headless service
+    coord = env["PSTPU_COORDINATOR"]["value"]
+    headless = sts["spec"]["serviceName"]
+    assert coord.startswith(sts["metadata"]["name"] + "-0." + headless)
+    assert coord.endswith(":18200")
+    # HMAC secret comes from the chart Secret, never inline
+    assert (env["PSTPU_CONTROL_SECRET"]["valueFrom"]["secretKeyRef"]["key"]
+            == "control_secret")
+    # multi-host slice topology selector + TPU resources, zero CUDA
+    pod = sts["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x8"
+    assert c["resources"]["requests"]["google.com/tpu"]
+
+
+def test_multihost_headless_service_and_leader_only_api():
+    objs = render_objects(HELM, MULTIHOST_VALUES)
+    svcs = by_kind(objs, "Service")
+    headless = [s for s in svcs if s["metadata"]["name"].endswith("-mh")]
+    assert len(headless) == 1
+    hs = headless[0]["spec"]
+    assert hs["clusterIP"] == "None"
+    assert hs["publishNotReadyAddresses"] is True
+    # the OpenAI-surface engine Service must select ONLY the leader pod
+    api = [s for s in svcs
+           if s["metadata"]["name"].endswith("llama70b-engine")]
+    assert api[0]["spec"]["selector"]["apps.kubernetes.io/pod-index"] == "0"
+    # no Deployment is rendered for a multihost spec
+    assert not [d for d in by_kind(objs, "Deployment")
+                if "llama70b" in d["metadata"]["name"]]
+    # the Secret carries the control_secret key
+    sec = by_kind(objs, "Secret")[0]
+    assert "control_secret" in sec["data"]
+
+
+def test_multihost_sts_flags_are_real_engine_flags():
+    from production_stack_tpu.engine.server import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    objs = render_objects(HELM, MULTIHOST_VALUES)
+    sts = by_kind(objs, "StatefulSet")[0]
+    for arg in sts["spec"]["template"]["spec"]["containers"][0]["args"]:
+        if arg.startswith("--"):
+            assert arg in known, f"chart passes unknown engine flag {arg}"
+
+
+def test_multihost_requires_control_secret():
+    import copy
+
+    import pytest
+
+    vals = copy.deepcopy(MULTIHOST_VALUES)
+    vals["secrets"] = {"create": False, "controlSecret": ""}
+    with pytest.raises(Exception, match="controlSecret"):
+        render_objects(HELM, vals)
+
+
+def test_multihost_spec_gets_no_keda_scaledobject():
+    """A fixed-size process group must never be resized by KEDA — and the
+    Deployment the ScaledObject would target doesn't exist."""
+    import copy
+
+    vals = copy.deepcopy(MULTIHOST_VALUES)
+    vals["autoscaling"] = {"enabled": True}
+    objs = render_objects(HELM, vals)
+    assert not [o for o in objs if o.get("kind") == "ScaledObject"]
+    # a normal (non-multihost) spec still gets one
+    vals["servingEngineSpec"]["modelSpec"][0]["multihost"]["enabled"] = False
+    objs = render_objects(HELM, vals)
+    assert [o for o in objs if o.get("kind") == "ScaledObject"]
