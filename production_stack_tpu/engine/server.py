@@ -169,6 +169,16 @@ def _tokens_covering(tk, token_ids: list, text_len: int) -> int:
     return lo
 
 
+# endpoint families this engine ACTUALLY serves, advertised on the
+# /v1/models card so the router can refuse unsupported modalities
+# (audio/images) with a clean 501 instead of letting them die here
+# (router/request_service.py PATH_CAPABILITY; VERDICT r3 #5)
+ENGINE_CAPABILITIES = (
+    "chat", "completions", "responses", "messages", "embeddings",
+    "score", "rerank", "tokenize",
+)
+
+
 class EngineServer:
     def __init__(self, config: EngineConfig, engine: Optional[LLMEngine] = None,
                  warmup_on_start: bool = False):
@@ -226,6 +236,7 @@ class EngineServer:
         app.router.add_post("/v1/rerank", self.rerank)
         app.router.add_post("/rerank", self.rerank)  # Jina-style alias
         app.router.add_post("/v1/messages", self.messages)
+        app.router.add_post("/v1/responses", self.responses)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/debug/profile", self.profile)
@@ -270,6 +281,7 @@ class EngineServer:
                 "root": self.model_name,
                 "parent": None,
                 "max_model_len": self.config.model.max_model_len,
+                "capabilities": list(ENGINE_CAPABILITIES),
             }
         ]
         for name in self.lora.list_adapters():
@@ -411,6 +423,203 @@ class EngineServer:
             "usage": {"input_tokens": len(prompt_ids),
                       "output_tokens": len(token_ids)},
         })
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API, text modality (the reference proxies
+        /v1/responses to engines, main_router.py:51-301 there; here it is
+        served natively — VERDICT r3 #5). Accepts ``input`` as a string or
+        a message-item list plus ``instructions``; emits the Responses
+        object shape, streaming (response.created /
+        response.output_text.delta / response.completed events) or not."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        raw = body.get("input")
+        if raw is None:
+            return web.json_response(
+                {"error": {"message": "'input' is required"}}, status=400
+            )
+        chat = []
+        if body.get("instructions"):
+            chat.append({"role": "system", "content": body["instructions"]})
+        if isinstance(raw, str):
+            chat.append({"role": "user", "content": raw})
+        elif isinstance(raw, list):
+            for item in raw:
+                if not isinstance(item, dict):
+                    return web.json_response(
+                        {"error": {"message": "input items must be objects"}},
+                        status=400,
+                    )
+                if item.get("type") not in (None, "message"):
+                    return web.json_response(
+                        {"error": {
+                            "message": f"unsupported input item type "
+                                       f"{item.get('type')!r}: this engine "
+                                       "serves the text modality only",
+                            "type": "invalid_request_error"}},
+                        status=400,
+                    )
+                content = item.get("content")
+                if isinstance(content, list):
+                    if not all(isinstance(b, dict) for b in content):
+                        return web.json_response(
+                            {"error": {"message": "content parts must be "
+                                       "objects",
+                                       "type": "invalid_request_error"}},
+                            status=400,
+                        )
+                    content = "".join(
+                        b.get("text", "") for b in content
+                        if b.get("type") in ("input_text", "output_text",
+                                             "text")
+                    )
+                chat.append({"role": item.get("role", "user"),
+                             "content": content or ""})
+        else:
+            return web.json_response(
+                {"error": {"message": "'input' must be a string or list"}},
+                status=400,
+            )
+        prompt_ids = self.engine.tokenizer.encode(self._render_chat(chat))
+        if len(prompt_ids) > self.config.model.max_model_len - 1:
+            return web.json_response(
+                {"error": {"message": "input too long"}}, status=400
+            )
+        if body.get("max_output_tokens") is not None:
+            body = dict(body, max_tokens=body["max_output_tokens"])
+        try:
+            sampling = _sampling_from_body(body)
+            make_token_controls(sampling, self.config.model.vocab_size)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid sampling parameter: {e}",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        rid = f"resp_{uuid.uuid4().hex[:24]}"
+        msg_id = f"msg_{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        model = body.get("model", self.model_name)
+        gen = self.async_engine.generate(
+            prompt_ids, sampling, rid,
+            adapter_slot=self.lora.slot_of(body.get("model", "")),
+        )
+        tk = self.engine.tokenizer
+
+        def response_obj(status, text, n_out, incomplete=None):
+            return {
+                "id": rid, "object": "response", "created_at": created,
+                "status": status, "model": model, "error": None,
+                "incomplete_details": incomplete,
+                "instructions": body.get("instructions"),
+                "max_output_tokens": body.get("max_output_tokens"),
+                "output": [{
+                    "type": "message", "id": msg_id, "status": status,
+                    "role": "assistant",
+                    "content": [{"type": "output_text", "text": text,
+                                 "annotations": []}],
+                }],
+                "temperature": sampling.temperature,
+                "top_p": sampling.top_p,
+                "usage": {"input_tokens": len(prompt_ids),
+                          "output_tokens": n_out,
+                          "total_tokens": len(prompt_ids) + n_out},
+            }
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            seq = 0
+
+            async def ev(name, payload):
+                nonlocal seq
+                payload = dict(payload, type=name, sequence_number=seq)
+                seq += 1
+                await resp.write(
+                    f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+
+            await ev("response.created",
+                     {"response": response_obj("in_progress", "", 0)})
+            await ev("response.output_item.added", {
+                "output_index": 0,
+                "item": {"type": "message", "id": msg_id,
+                         "status": "in_progress", "role": "assistant",
+                         "content": []},
+            })
+            # stop sequences can span step boundaries: hold back enough
+            # trailing chars that a stop prefix is never streamed before
+            # it is confirmed not to be one (same mechanism as the
+            # chat/completions stream path)
+            holdback = max((len(s) for s in sampling.stop), default=1) - 1
+            token_ids, sent = [], 0
+            n_out = 0
+            text = ""
+            incomplete = None
+            hit_stop = False
+            async for out in gen:
+                token_ids.extend(out.new_token_ids)
+                text = tk.decode(token_ids)
+                stopped = self._check_stop_str(text, sampling)
+                if stopped is not None:
+                    self.async_engine.abort(rid)
+                    text = stopped
+                    n_out = _tokens_covering(tk, token_ids, len(stopped))
+                    hit_stop = True
+                else:
+                    n_out = len(token_ids)
+                done = out.finished or hit_stop
+                limit = (len(text) if done or not holdback
+                         else max(sent, len(text) - holdback))
+                if limit > sent:
+                    await ev("response.output_text.delta", {
+                        "item_id": msg_id, "output_index": 0,
+                        "content_index": 0, "delta": text[sent:limit],
+                    })
+                    sent = limit
+                if hit_stop:
+                    break
+                if out.finished and out.finish_reason == "length":
+                    incomplete = {"reason": "max_output_tokens"}
+            await ev("response.output_text.done", {
+                "item_id": msg_id, "output_index": 0, "content_index": 0,
+                "text": text,
+            })
+            final = response_obj(
+                "incomplete" if incomplete else "completed", text, n_out,
+                incomplete,
+            )
+            await ev("response.completed", {"response": final})
+            await resp.write_eof()
+            return resp
+
+        token_ids = []
+        text = ""
+        incomplete = None
+        n_out = 0
+        async for out in gen:
+            token_ids.extend(out.new_token_ids)
+            text = tk.decode(token_ids)
+            stopped = self._check_stop_str(text, sampling)
+            if stopped is not None:
+                self.async_engine.abort(rid)
+                text = stopped
+                # usage counts only the tokens whose text survived the
+                # stop-string cut (same as the completions path)
+                n_out = _tokens_covering(tk, token_ids, len(stopped))
+                break
+            n_out = len(token_ids)
+            if out.finished and out.finish_reason == "length":
+                incomplete = {"reason": "max_output_tokens"}
+        return web.json_response(response_obj(
+            "incomplete" if incomplete else "completed", text,
+            n_out, incomplete,
+        ))
 
     def _encode_ids(self, text) -> list[int]:
         """Shared encoder-input pipeline for embeddings/score/rerank:

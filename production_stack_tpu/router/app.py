@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--static-model-labels", default="",
                    help="comma-separated label per backend (prefill/decode/...)")
     p.add_argument("--static-backend-health-checks", action="store_true")
+    p.add_argument("--static-query-models", action="store_true",
+                   help="probe each static backend's /v1/models for served "
+                        "models + capabilities (enables modality filtering "
+                        "— audio/images requests get a clean 501 when no "
+                        "backend advertises the capability)")
     p.add_argument("--health-check-interval", type=float, default=10.0)
     p.add_argument("--k8s-namespace", default="default")
     p.add_argument("--k8s-label-selector", default="")
@@ -187,6 +192,7 @@ class RouterApp:
                     urls, models, labels,
                     health_check=args.static_backend_health_checks,
                     health_check_interval=args.health_check_interval,
+                    query_models=args.static_query_models,
                 )
             )
         elif args.service_discovery in ("k8s_pod_ip", "k8s_service_name"):

@@ -30,9 +30,21 @@ class EndpointInfo:
     namespace: Optional[str] = None
     added_timestamp: float = dataclasses.field(default_factory=time.time)
     sleep: bool = False
+    # endpoint families the engine advertises in its /v1/models card
+    # ("chat", "embeddings", "audio.transcriptions", ...). None = the
+    # backend doesn't advertise (external vLLM/whisper pods) — no
+    # filtering, preserving proxy-through behavior. Engines that DO
+    # advertise get requests for unsupported modalities refused at the
+    # router with a clean 501 instead of dying at the engine.
+    capabilities: Optional[frozenset[str]] = None
 
     def serves(self, model: str) -> bool:
         return model in self.model_names
+
+    def supports(self, capability: Optional[str]) -> bool:
+        if capability is None or self.capabilities is None:
+            return True
+        return capability in self.capabilities
 
 
 @dataclasses.dataclass

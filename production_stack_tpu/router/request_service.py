@@ -44,6 +44,32 @@ def sanitize_headers(headers) -> dict[str, str]:
     return {k: v for k, v in headers.items() if k.lower() not in HOP_BY_HOP}
 
 
+# endpoint path → capability family an engine must advertise to receive it
+# (reference surface: src/vllm_router/routers/main_router.py:51-301 — there
+# every path is proxied blind and an incapable vLLM pod 404s mid-request;
+# here engines advertise capabilities in /v1/models and the router refuses
+# up front with a clean 501). Backends that don't advertise (capabilities
+# None) are never filtered.
+PATH_CAPABILITY = {
+    "/v1/chat/completions": "chat",
+    "/v1/completions": "completions",
+    "/v1/embeddings": "embeddings",
+    "/v1/rerank": "rerank",
+    "/rerank": "rerank",
+    "/v1/score": "score",
+    "/score": "score",
+    "/v1/responses": "responses",
+    "/v1/messages": "messages",
+    "/v1/audio/transcriptions": "audio.transcriptions",
+    "/v1/audio/translations": "audio.translations",
+    "/v1/audio/speech": "audio.speech",
+    "/v1/images/generations": "images.generations",
+    "/v1/images/edits": "images.edits",
+    "/pooling": "pooling",
+    "/classify": "classify",
+}
+
+
 class RequestService:
     """Bound to the router app; owns the shared backend client session."""
 
@@ -133,6 +159,21 @@ class RequestService:
                            "type": "NotFoundError"}},
                 status=404,
             )
+
+        capability = PATH_CAPABILITY.get(endpoint_path)
+        capable = [e for e in endpoints if e.supports(capability)]
+        if not capable:
+            return web.json_response(
+                {"error": {
+                    "message": f"no backend serving {resolved!r} supports "
+                               f"{endpoint_path} (requires the "
+                               f"{capability!r} capability)",
+                    "type": "NotImplementedError",
+                    "code": "unsupported_endpoint",
+                }},
+                status=501,
+            )
+        endpoints = capable
 
         router = get_routing_logic()
         if isinstance(router, DisaggregatedPrefillOrchestratedRouter):

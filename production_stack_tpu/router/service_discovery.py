@@ -79,6 +79,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.sleeping: set[str] = set()
         self._task: Optional[asyncio.Task] = None
         self._queried_models: dict[str, list[str]] = {}
+        self._queried_caps: dict[str, frozenset[str]] = {}
         self.known_models.update(models)
 
     def get_endpoint_info(self) -> list[EndpointInfo]:
@@ -94,6 +95,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     model_info={m: ModelInfo(m) for m in models},
                     model_label=self.model_labels[i],
                     sleep=url in self.sleeping,
+                    capabilities=self._queried_caps.get(url),
                 )
             )
         return out
@@ -121,6 +123,19 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     if models:
                         self._queried_models[url] = models
                         self.known_models.update(models)
+                    # re-derive per probe: a backend swap from an
+                    # advertising engine to e.g. an external whisper pod
+                    # must CLEAR the old capability set, or the router
+                    # would 501 the new backend's modalities forever
+                    caps = None
+                    for m in data.get("data", []):
+                        if m.get("capabilities") is not None:
+                            caps = frozenset(m["capabilities"])
+                            break
+                    if caps is None:
+                        self._queried_caps.pop(url, None)
+                    else:
+                        self._queried_caps[url] = caps
         except Exception:
             ok = False
         if ok:
@@ -255,7 +270,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         labels = meta.get("labels", {})
         model_label = labels.get("model")
         try:
-            models, model_info = await self._query_models(session, url)
+            models, model_info, caps = await self._query_models(session, url)
             sleeping = await self._query_sleep(session, url)
         except Exception as e:
             logger.warning("pod %s ready but /v1/models failed: %s", name, e)
@@ -269,6 +284,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
             pod_name=name,
             namespace=self.namespace,
             sleep=sleeping,
+            capabilities=caps,
         )
         logger.info("engine pod %s added at %s serving %s", name, url, models)
 
@@ -279,12 +295,18 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
             resp.raise_for_status()
             data = await resp.json()
         models, info = [], {}
+        caps = None
         for m in data.get("data", []):
             models.append(m["id"])
             info[m["id"]] = ModelInfo(
                 m["id"], parent=m.get("parent"), is_adapter=bool(m.get("parent"))
             )
-        return models, info
+            # engines advertise their endpoint families on the base card;
+            # backends that don't (external vLLM/whisper) stay None =
+            # unfiltered (protocols.EndpointInfo.supports)
+            if caps is None and m.get("capabilities") is not None:
+                caps = frozenset(m["capabilities"])
+        return models, info, caps
 
     async def _query_sleep(self, session, url) -> bool:
         try:
@@ -337,7 +359,7 @@ class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
 
     async def _try_register(self, session, name, url, labels) -> bool:
         try:
-            models, model_info = await self._query_models(session, url)
+            models, model_info, caps = await self._query_models(session, url)
             sleeping = await self._query_sleep(session, url)
         except Exception:
             return False
@@ -345,7 +367,7 @@ class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
         self.endpoints[name] = EndpointInfo(
             url=url, model_names=models, model_info=model_info,
             model_label=labels.get("model"), pod_name=name,
-            namespace=self.namespace, sleep=sleeping,
+            namespace=self.namespace, sleep=sleeping, capabilities=caps,
         )
         logger.info("engine service %s added at %s serving %s", name, url, models)
         return True

@@ -23,12 +23,16 @@ from aiohttp import web
 class FakeEngine:
     def __init__(self, model: str = "fake-model", tokens_per_second: float = 500.0,
                  ttft: float = 0.02, max_tokens_default: int = 32,
-                 kv_hit_tokens: int = 0):
+                 kv_hit_tokens: int = 0,
+                 capabilities: "list[str] | None" = None):
         self.model = model
         self.tps = tokens_per_second
         self.ttft = ttft
         self.max_tokens_default = max_tokens_default
         self.kv_hit_tokens = kv_hit_tokens  # fixed /kv/lookup answer
+        # advertised on the /v1/models card like the real engine; None =
+        # no capabilities field (external-backend behavior: unfiltered)
+        self.capabilities = capabilities
         self.running = 0
         self.total_requests = 0
         self.sleeping = False
@@ -63,11 +67,11 @@ class FakeEngine:
         return web.json_response({"status": "unloaded"})
 
     async def models(self, request):
-        return web.json_response(
-            {"object": "list",
-             "data": [{"id": self.model, "object": "model",
-                       "created": int(self.start), "owned_by": "fake"}]}
-        )
+        card = {"id": self.model, "object": "model",
+                "created": int(self.start), "owned_by": "fake"}
+        if self.capabilities is not None:
+            card["capabilities"] = list(self.capabilities)
+        return web.json_response({"object": "list", "data": [card]})
 
     async def health(self, request):
         return web.json_response({"status": "healthy"})
