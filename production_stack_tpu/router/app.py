@@ -119,6 +119,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--callbacks", default=None,
                    help="module.attribute of a custom callback handler")
     p.add_argument("--semantic-cache-threshold", type=float, default=0.75)
+    p.add_argument("--semantic-cache-encoder", default="auto",
+                   choices=["auto", "engine", "hashed"],
+                   help="'engine' embeds via the fleet's own /v1/embeddings"
+                        " (truly semantic, zero extra deps); 'auto' uses a"
+                        " mounted sentence-transformers model "
+                        "(SEMANTIC_CACHE_MODEL_PATH) or hashed n-grams")
+    p.add_argument("--semantic-cache-embedding-model", default=None,
+                   help="model name for the engine encoder's /v1/embeddings"
+                        " calls (default: the backend's first model)")
     p.add_argument("--otel-endpoint", default=None,
                    help="OTLP gRPC endpoint; W3C propagation is always on")
     p.add_argument("--otel-service-name", default="tpu-router")
@@ -277,10 +286,17 @@ class RouterApp:
         if gates.enabled("SemanticCache"):
             from production_stack_tpu.router.experimental.semantic_cache import (
                 SemanticCache,
+                make_encoder,
             )
 
             self.semantic_cache = SemanticCache(
-                threshold=args.semantic_cache_threshold
+                threshold=args.semantic_cache_threshold,
+                encoder=make_encoder(
+                    getattr(args, "semantic_cache_encoder", "auto"),
+                    getattr(args, "semantic_cache_embedding_model", None),
+                    # reuse the router's shared backend connection pool
+                    session_provider=lambda: self.request_service.session,
+                ),
             )
             self.request_service.post_response = self.semantic_cache.store
         if gates.enabled("PIIDetection"):
@@ -393,6 +409,8 @@ class RouterApp:
     async def _on_stop(self, app) -> None:
         if self.batch_processor is not None:
             await self.batch_processor.stop()
+        if self.semantic_cache is not None:
+            await self.semantic_cache.aclose()
         await get_service_discovery().stop()
         await get_engine_stats_scraper().stop()
         await self.request_service.stop()

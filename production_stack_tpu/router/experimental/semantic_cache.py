@@ -2,15 +2,21 @@
 
 The reference uses sentence-transformers + FAISS
 (src/vllm_router/experimental/semantic_cache/semantic_cache.py:16-346 and
-db_adapters/faiss_adapter.py). Here the encoder is a protocol:
+db_adapters/faiss_adapter.py). Here the encoder is a protocol with three
+backends:
 
+- ``EngineEmbeddingEncoder`` (``--semantic-cache-encoder engine``): embeds
+  through the serving fleet's OWN ``/v1/embeddings`` endpoint — truly
+  semantic vectors (the deployed model's pooled hidden states) with zero
+  extra dependencies or model downloads. This is the TPU-native answer to
+  the reference's sentence-transformers sidecar model: the fleet already
+  holds a language model; use it.
+- ``SentenceTransformerEncoder``: a dedicated embedding model when one is
+  mounted in the image (path via ``SEMANTIC_CACHE_MODEL_PATH``).
 - ``HashedNgramEncoder`` (default): hashed char-3-grams + word 1/2-grams,
-  L2-normalised — no model download (zero-egress TPU image), robust to
-  punctuation/casing/word-order surface variation. Its quality is pinned
-  by a paraphrase hit/miss evaluation in tests/test_semantic_cache.py.
-- ``SentenceTransformerEncoder``: a real embedding model when one is
-  mounted in the image (path via ``SEMANTIC_CACHE_MODEL_PATH``); same
-  interface, drop-in.
+  L2-normalised — dependency-free, robust to surface variation (casing,
+  punctuation, reordering) but lexical: true paraphrases need one of the
+  semantic backends above. Quality pinned in tests/test_semantic_cache.py.
 
 Similarity search is exact brute-force cosine over a normalised numpy
 matrix — for the few-thousand-entry caches a router holds this is faster
@@ -88,7 +94,112 @@ class SentenceTransformerEncoder:
         return vecs / np.maximum(norms, 1e-9)
 
 
-def make_encoder() -> Encoder:
+class EngineEmbeddingEncoder:
+    """Embeds via the serving fleet's native ``/v1/embeddings``.
+
+    Async (``aencode``): the cache awaits it on lookup and schedules the
+    store-side encode as a task. The first embeddings-capable, awake
+    endpoint serves the call; ``model`` pins which served model's vector
+    space to use (default: that endpoint's first model — consistent as
+    long as the fleet serves one embedding-capable model, which is the
+    homogeneous-fleet common case)."""
+
+    # a cache exists to CUT latency: the embeddings call on the lookup
+    # path must be bounded tightly, and repeated failures must open a
+    # breaker instead of taxing every chat request
+    _BREAKER_AFTER = 3
+    _BREAKER_COOLDOWN = 30.0
+
+    def __init__(self, model: Optional[str] = None, timeout: float = 3.0,
+                 session_provider=None):
+        self.model = model
+        self.timeout = timeout
+        # reuse the router's shared backend session when provided
+        # (request_service.session) instead of a second connection pool
+        self._session_provider = session_provider
+        self._session = None
+        self._failures = 0
+        self._retry_at = 0.0
+
+    async def _ensure_session(self):
+        import aiohttp
+
+        if self._session_provider is not None:
+            return self._session_provider()
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def aclose(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def aencode(self, texts: Sequence[str]) -> np.ndarray:
+        import aiohttp
+
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+
+        now = time.time()
+        if self._failures >= self._BREAKER_AFTER and now < self._retry_at:
+            raise RuntimeError("semantic-cache embeddings breaker open")
+        # require an ADVERTISED embeddings capability: capabilities=None
+        # (non-advertising backend) would mean firing doomed
+        # /v1/embeddings calls at chat-only pods on every request
+        eps = [
+            e for e in get_service_discovery().get_endpoint_info()
+            if not e.sleep and e.capabilities is not None
+            and "embeddings" in e.capabilities
+        ]
+        if not eps:
+            self._note_failure()
+            raise RuntimeError(
+                "no backend ADVERTISES the embeddings capability — the "
+                "engine encoder needs capability discovery (e.g. "
+                "--static-query-models with --static-backend-health-checks)"
+            )
+        ep = eps[0]
+        if self.model is None:
+            # pin the vector space on first resolve: re-resolving per call
+            # would mix hidden sizes across heterogeneous fleets
+            self.model = ep.model_names[0]
+        try:
+            session = await self._ensure_session()
+            async with session.post(
+                f"{ep.url}/v1/embeddings",
+                json={"model": self.model, "input": list(texts)},
+                timeout=aiohttp.ClientTimeout(total=self.timeout),
+            ) as resp:
+                resp.raise_for_status()
+                data = await resp.json()
+        except Exception:
+            self._note_failure()
+            raise
+        self._failures = 0
+        vecs = np.asarray([d["embedding"] for d in data["data"]], np.float32)
+        norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+        return vecs / np.maximum(norms, 1e-9)
+
+    def _note_failure(self) -> None:
+        self._failures += 1
+        if self._failures >= self._BREAKER_AFTER:
+            self._retry_at = time.time() + self._BREAKER_COOLDOWN
+
+
+def make_encoder(kind: str = "auto",
+                 embedding_model: Optional[str] = None,
+                 session_provider=None) -> Encoder:
+    """auto → SEMANTIC_CACHE_MODEL_PATH sentence-transformers if set, else
+    hashed n-grams; "engine" → fleet /v1/embeddings; "hashed" → n-grams."""
+    if kind == "engine" or (kind == "auto"
+                            and os.environ.get("SEMANTIC_CACHE_ENCODER")
+                            == "engine"):
+        logger.info("semantic cache: engine-embeddings encoder")
+        return EngineEmbeddingEncoder(model=embedding_model,
+                                      session_provider=session_provider)
+    if kind == "hashed":
+        return HashedNgramEncoder()
     path = os.environ.get("SEMANTIC_CACHE_MODEL_PATH")
     if path:
         try:
@@ -117,11 +228,28 @@ class SemanticCache:
         self.max_entries = max_entries
         self.ttl = ttl_seconds
         self.encoder = encoder or make_encoder()
-        dim = getattr(self.encoder, "dim", _DIM)
-        self.vectors = np.zeros((0, dim), np.float32)
+        # dim is lazy: engine-backed encoders only know it after the first
+        # embedding call (it is the served model's hidden size)
+        dim = getattr(self.encoder, "dim", None)
+        self.vectors = (np.zeros((0, dim), np.float32)
+                        if dim is not None else None)
         self.entries: list[dict] = []
         self.hits = 0
         self.misses = 0
+        # strong refs to in-flight store tasks: the loop keeps only weak
+        # ones, so a fire-and-forget task could be GC'd mid-await
+        self._store_tasks: set = set()
+
+    async def _encode_one(self, text: str) -> np.ndarray:
+        aenc = getattr(self.encoder, "aencode", None)
+        if aenc is not None:
+            return (await aenc([text]))[0]
+        return self.encoder.encode([text])[0]
+
+    async def aclose(self) -> None:
+        aclose = getattr(self.encoder, "aclose", None)
+        if aclose is not None:
+            await aclose()
 
     @staticmethod
     def _prompt_of(body: dict) -> str:
@@ -149,7 +277,26 @@ class SemanticCache:
         if not prompt or not self.entries:
             self.misses += 1
             return None
-        q = self.encoder.encode([prompt])[0]
+        try:
+            q = await self._encode_one(prompt)
+        except Exception as e:
+            # an encoder outage (no embeddings-capable backend yet) must
+            # degrade to a miss, never fail the request
+            logger.warning("semantic cache encoder failed on lookup: %s", e)
+            self.misses += 1
+            return None
+        if len(q) != self.vectors.shape[1]:
+            # encoder vector space changed (backend swap to a model with
+            # a different hidden size): stale entries can't be compared
+            logger.warning(
+                "semantic cache: encoder dim changed %d -> %d; dropping "
+                "%d stale entries", self.vectors.shape[1], len(q),
+                len(self.entries),
+            )
+            self.entries = []
+            self.vectors = np.zeros((0, len(q)), np.float32)
+            self.misses += 1
+            return None
         sims = self.vectors @ q
         # mask to the requested model BEFORE argmax: another model's entry
         # being the single global best must not shadow a valid hit
@@ -166,6 +313,9 @@ class SemanticCache:
         return None
 
     def store(self, body: dict, response_tail: bytes) -> None:
+        """Sync entry point (request_service post_response hook). Async
+        encoders get the encode scheduled as a task on the running loop —
+        the hot response path never waits on an embeddings call."""
         if body.get("stream"):
             return
         prompt = self._prompt_of(body)
@@ -177,7 +327,31 @@ class SemanticCache:
             return
         if "choices" not in response:
             return
-        vec = self.encoder.encode([prompt])[0]
+        if getattr(self.encoder, "aencode", None) is not None:
+            import asyncio
+
+            task = asyncio.get_running_loop().create_task(
+                self._store_async(body, prompt, response)
+            )
+            self._store_tasks.add(task)
+            task.add_done_callback(self._store_tasks.discard)
+            return
+        self._commit(body, response, self.encoder.encode([prompt])[0])
+
+    async def _store_async(self, body: dict, prompt: str,
+                           response: dict) -> None:
+        try:
+            vec = await self._encode_one(prompt)
+        except Exception as e:
+            logger.warning("semantic cache encoder failed on store: %s", e)
+            return
+        self._commit(body, response, vec)
+
+    def _commit(self, body: dict, response: dict, vec: np.ndarray) -> None:
+        if self.vectors is None:
+            self.vectors = np.zeros((0, len(vec)), np.float32)
+        elif len(vec) != self.vectors.shape[1]:
+            return  # stale vector space (backend swap mid-flight); drop
         self.entries.append(
             {"model": body.get("model"), "response": response, "ts": time.time()}
         )
