@@ -159,7 +159,10 @@ class EngineEmbeddingEncoder:
                 "engine encoder needs capability discovery (e.g. "
                 "--static-query-models with --static-backend-health-checks)"
             )
-        ep = eps[0]
+        # rotate across capable endpoints: pinning everything to eps[0]
+        # would make one pod the fleet-wide embeddings hotspot
+        self._rr = getattr(self, "_rr", 0) + 1
+        ep = eps[self._rr % len(eps)]
         if self.model is None:
             # pin the vector space on first resolve: re-resolving per call
             # would mix hidden sizes across heterogeneous fleets
@@ -239,6 +242,9 @@ class SemanticCache:
         # strong refs to in-flight store tasks: the loop keeps only weak
         # ones, so a fire-and-forget task could be GC'd mid-await
         self._store_tasks: set = set()
+        # lookup→store vector handoff: a miss already embedded the prompt;
+        # the store must not pay a second embeddings RPC for it
+        self._recent_vecs: dict[str, np.ndarray] = {}
 
     async def _encode_one(self, text: str) -> np.ndarray:
         aenc = getattr(self.encoder, "aencode", None)
@@ -246,7 +252,19 @@ class SemanticCache:
             return (await aenc([text]))[0]
         return self.encoder.encode([text])[0]
 
+    def _remember_vec(self, prompt: str, vec: np.ndarray) -> None:
+        self._recent_vecs[prompt] = vec
+        while len(self._recent_vecs) > 256:
+            self._recent_vecs.pop(next(iter(self._recent_vecs)))
+
     async def aclose(self) -> None:
+        # settle in-flight store tasks BEFORE closing the encoder/session,
+        # or they race teardown and log spurious failures
+        if self._store_tasks:
+            import asyncio
+
+            await asyncio.gather(*list(self._store_tasks),
+                                 return_exceptions=True)
         aclose = getattr(self.encoder, "aclose", None)
         if aclose is not None:
             await aclose()
@@ -274,11 +292,16 @@ class SemanticCache:
             return None
         prompt = self._prompt_of(body)
         self._evict_expired()
-        if not prompt or not self.entries:
+        model = body.get("model")
+        if (not prompt or not self.entries
+                # no entry for this model => a guaranteed miss; don't pay
+                # an embeddings RPC to prove it
+                or not any(e["model"] == model for e in self.entries)):
             self.misses += 1
             return None
         try:
             q = await self._encode_one(prompt)
+            self._remember_vec(prompt, q)
         except Exception as e:
             # an encoder outage (no embeddings-capable backend yet) must
             # degrade to a miss, never fail the request
@@ -300,7 +323,6 @@ class SemanticCache:
         sims = self.vectors @ q
         # mask to the requested model BEFORE argmax: another model's entry
         # being the single global best must not shadow a valid hit
-        model = body.get("model")
         mask = np.asarray([e["model"] == model for e in self.entries])
         sims = np.where(mask, sims, -1.0)
         best = int(np.argmax(sims))
@@ -340,11 +362,14 @@ class SemanticCache:
 
     async def _store_async(self, body: dict, prompt: str,
                            response: dict) -> None:
-        try:
-            vec = await self._encode_one(prompt)
-        except Exception as e:
-            logger.warning("semantic cache encoder failed on store: %s", e)
-            return
+        vec = self._recent_vecs.pop(prompt, None)  # miss already embedded it
+        if vec is None:
+            try:
+                vec = await self._encode_one(prompt)
+            except Exception as e:
+                logger.warning("semantic cache encoder failed on store: %s",
+                               e)
+                return
         self._commit(body, response, vec)
 
     def _commit(self, body: dict, response: dict, vec: np.ndarray) -> None:

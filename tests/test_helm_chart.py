@@ -439,3 +439,136 @@ def test_multihost_spec_gets_no_keda_scaledobject():
     vals["servingEngineSpec"]["modelSpec"][0]["multihost"]["enabled"] = False
     objs = render_objects(HELM, vals)
     assert [o for o in objs if o.get("kind") == "ScaledObject"]
+
+
+# ---- the five BASELINE.json scenario configs, rendered for real --------
+
+ASSETS = os.path.join(os.path.dirname(__file__), "..", "tutorials", "assets")
+
+
+def render_asset(name):
+    with open(os.path.join(ASSETS, name)) as f:
+        overrides = yaml.safe_load(f)
+    return render_objects(HELM, overrides)
+
+
+def engine_deployments(objs):
+    return [d for d in by_kind(objs, "Deployment")
+            if d["metadata"]["labels"].get("app.kubernetes.io/component")
+            == "serving-engine"]
+
+
+def router_args(objs):
+    router = [d for d in by_kind(objs, "Deployment")
+              if d["metadata"]["name"].endswith("-router")][0]
+    return container_args(router)
+
+
+def test_scenario_01_minimal_renders():
+    objs = render_asset("values-01-minimal.yaml")
+    eng = engine_deployments(objs)
+    assert len(eng) == 1
+    assert eng[0]["spec"]["replicas"] == 1
+
+
+def test_scenario_08_llama8b_roundrobin_renders():
+    objs = render_asset("values-08-llama8b-roundrobin.yaml")
+    eng = engine_deployments(objs)[0]
+    assert eng["spec"]["replicas"] == 2
+    args = router_args(objs)
+    assert args[args.index("--routing-logic") + 1] == "roundrobin"
+    c = eng["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"]
+
+
+def test_scenario_09_prefix_kvaware_renders():
+    objs = render_asset("values-09-prefix-kvaware.yaml")
+    eng = engine_deployments(objs)[0]
+    assert eng["spec"]["replicas"] == 4
+    args = router_args(objs)
+    assert args[args.index("--routing-logic") + 1] in (
+        "kvaware", "prefixaware")
+    # KV-reuse routing scenario mounts the model PVC
+    assert [p for p in by_kind(objs, "PersistentVolumeClaim")
+            if p["metadata"]["name"].endswith("-models")]
+
+
+def test_scenario_10_disagg_prefill_renders():
+    objs = render_asset("values-10-disagg-prefill.yaml")
+    eng = engine_deployments(objs)
+    labels = {d["spec"]["template"]["metadata"]["labels"].get("model-label")
+              for d in eng}
+    assert {"prefill", "decode"} <= labels
+    args = router_args(objs)
+    assert args[args.index("--routing-logic") + 1].startswith(
+        "disaggregated_prefill")
+
+
+def test_scenario_04_multi_model_keda_renders():
+    objs = render_asset("values-04-multi-model-keda.yaml")
+    eng = engine_deployments(objs)
+    assert len(eng) == 2
+    sos = by_kind(objs, "ScaledObject")
+    assert len(sos) == 2
+    for so in sos:
+        q = so["spec"]["triggers"][0]["metadata"]["query"]
+        assert "num_requests_waiting" in q
+
+
+def test_per_modelspec_overrides_render():
+    """Per-modelSpec probes/tolerations/pdb/securityContext/extraVolumes
+    override the servingEngineSpec globals (VERDICT r3 #7 depth)."""
+    objs = render_objects(HELM, {"servingEngineSpec": {"modelSpec": [{
+        "name": "ov", "modelRef": "llama-3-8b",
+        "engineConfig": {"maxModelLen": 2048, "maxNumSeqs": 8,
+                         "dtype": "bfloat16", "tensorParallelSize": 1},
+        "startupProbe": {"failureThreshold": 7, "periodSeconds": 3},
+        "tolerations": [{"key": "custom", "operator": "Exists"}],
+        "affinity": {"nodeAffinity": {"x": "y"}},
+        "securityContext": {"runAsUser": 1000},
+        "containerSecurityContext": {"readOnlyRootFilesystem": True},
+        "priorityClassName": "high",
+        "pdb": {"enabled": True, "minAvailable": 1},
+        "extraVolumes": [{"name": "scratch", "emptyDir": {}}],
+        "extraVolumeMounts": [{"name": "scratch", "mountPath": "/scratch"}],
+    }]}})
+    eng = engine_deployments(objs)[0]
+    pod = eng["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["startupProbe"]["failureThreshold"] == 7
+    assert pod["tolerations"][0]["key"] == "custom"
+    assert pod["affinity"]["nodeAffinity"] == {"x": "y"}
+    assert pod["securityContext"]["runAsUser"] == 1000
+    assert c["securityContext"]["readOnlyRootFilesystem"] is True
+    assert pod["priorityClassName"] == "high"
+    assert {"name": "scratch", "emptyDir": {}} in pod["volumes"]
+    assert {"name": "scratch", "mountPath": "/scratch"} in c["volumeMounts"]
+    pdbs = by_kind(objs, "PodDisruptionBudget")
+    assert pdbs and pdbs[0]["spec"]["minAvailable"] == 1
+
+
+def test_keda_fallback_and_router_depth():
+    objs = render_objects(HELM, {
+        "autoscaling": {"enabled": True,
+                        "fallback": {"enabled": True, "replicas": 3}},
+        "routerSpec": {
+            "env": [{"name": "LOG_LEVEL", "value": "debug"}],
+            "serviceType": "NodePort", "nodePort": 30123,
+            "serviceAnnotations": {"a": "b"},
+            "containerSecurityContext": {"runAsNonRoot": True},
+            "extraVolumes": [{"name": "t", "emptyDir": {}}],
+            "extraVolumeMounts": [{"name": "t", "mountPath": "/t"}],
+        },
+    })
+    so = by_kind(objs, "ScaledObject")[0]
+    assert so["spec"]["fallback"] == {"failureThreshold": 3, "replicas": 3}
+    router = [d for d in by_kind(objs, "Deployment")
+              if d["metadata"]["name"].endswith("-router")][0]
+    c = router["spec"]["template"]["spec"]["containers"][0]
+    assert {"name": "LOG_LEVEL", "value": "debug"} in c["env"]
+    assert c["securityContext"]["runAsNonRoot"] is True
+    assert {"name": "t", "mountPath": "/t"} in c["volumeMounts"]
+    svc = [s for s in by_kind(objs, "Service")
+           if s["metadata"]["name"].endswith("-router")][0]
+    assert svc["metadata"]["annotations"] == {"a": "b"}
+    assert svc["spec"]["ports"][0]["nodePort"] == 30123
