@@ -21,7 +21,9 @@
 
 #include <cctype>
 #include <cmath>
+#include <cstdlib>
 #include <cstring>
+#include <cstdio>
 #include <map>
 #include <memory>
 #include <string>
@@ -124,16 +126,58 @@ struct Parser {
                     case 'r': out += '\r'; break;
                     case 'b': out += '\b'; break;
                     case 'f': out += '\f'; break;
-                    case 'u':
-                        // keep the escape VERBATIM (digits included) — we
-                        // only need equality, not decoding, but dropping
-                        // the digits would make distinct strings equal
-                        out += "\\u";
-                        if (end - p >= 6) {
-                            out.append(p + 2, 4);
-                            p += 4;
+                    case 'u': {
+                        // decode to UTF-8 (incl. surrogate pairs): the
+                        // serializer re-emits these strings into built
+                        // manifests, so verbatim-kept escapes would leak
+                        // literal backslash-u text into K8s objects
+                        // (json.dumps upstream uses ensure_ascii=True)
+                        if (end - p < 6) {
+                            ok = false;
+                            break;
+                        }
+                        auto hex4 = [&](const char* q) {
+                            unsigned v = 0;
+                            for (int i = 0; i < 4; ++i) {
+                                char h = q[i];
+                                v <<= 4;
+                                if (h >= '0' && h <= '9') v |= h - '0';
+                                else if (h >= 'a' && h <= 'f')
+                                    v |= h - 'a' + 10;
+                                else if (h >= 'A' && h <= 'F')
+                                    v |= h - 'A' + 10;
+                                else ok = false;
+                            }
+                            return v;
+                        };
+                        unsigned cp = hex4(p + 2);
+                        p += 4;
+                        if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 8 &&
+                            p[2] == '\\' && p[3] == 'u') {
+                            unsigned lo = hex4(p + 4);
+                            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                                cp = 0x10000 + ((cp - 0xD800) << 10) +
+                                     (lo - 0xDC00);
+                                p += 6;
+                            }
+                        }
+                        if (cp < 0x80) {
+                            out += (char)cp;
+                        } else if (cp < 0x800) {
+                            out += (char)(0xC0 | (cp >> 6));
+                            out += (char)(0x80 | (cp & 0x3F));
+                        } else if (cp < 0x10000) {
+                            out += (char)(0xE0 | (cp >> 12));
+                            out += (char)(0x80 | ((cp >> 6) & 0x3F));
+                            out += (char)(0x80 | (cp & 0x3F));
+                        } else {
+                            out += (char)(0xF0 | (cp >> 18));
+                            out += (char)(0x80 | ((cp >> 12) & 0x3F));
+                            out += (char)(0x80 | ((cp >> 6) & 0x3F));
+                            out += (char)(0x80 | (cp & 0x3F));
                         }
                         break;
+                    }
                     default: out += c;
                 }
                 p += 2;
@@ -223,6 +267,561 @@ bool drifted(const Value& desired, const Value& live) {
     return live.kind != Value::Null;  // desired null: live must be null
 }
 
+// ---------------------------------------------------------------------------
+// JSON serializer (deterministic: object keys in std::map order)
+// ---------------------------------------------------------------------------
+
+void serialize(const Value& v, std::string& out) {
+    switch (v.kind) {
+        case Value::Null:
+            out += "null";
+            break;
+        case Value::Bool:
+            out += v.b ? "true" : "false";
+            break;
+        case Value::Num: {
+            double r = std::round(v.num);
+            char buf[64];
+            if (std::fabs(v.num - r) < 1e-9 && std::fabs(v.num) < 1e15) {
+                snprintf(buf, sizeof buf, "%lld", (long long)r);
+            } else {
+                snprintf(buf, sizeof buf, "%.17g", v.num);
+            }
+            out += buf;
+            break;
+        }
+        case Value::Str: {
+            out += '"';
+            for (char c : v.str) {
+                switch (c) {
+                    case '"': out += "\\\""; break;
+                    case '\\': out += "\\\\"; break;
+                    case '\n': out += "\\n"; break;
+                    case '\t': out += "\\t"; break;
+                    case '\r': out += "\\r"; break;
+                    case '\b': out += "\\b"; break;
+                    case '\f': out += "\\f"; break;
+                    default:
+                        if ((unsigned char)c < 0x20) {
+                            char buf[8];
+                            snprintf(buf, sizeof buf, "\\u%04x", c);
+                            out += buf;
+                        } else {
+                            out += c;
+                        }
+                }
+            }
+            out += '"';
+            break;
+        }
+        case Value::Arr: {
+            out += '[';
+            bool first = true;
+            for (const auto& e : v.arr) {
+                if (!first) out += ',';
+                first = false;
+                serialize(*e, out);
+            }
+            out += ']';
+            break;
+        }
+        case Value::Obj: {
+            out += '{';
+            bool first = true;
+            for (const auto& kv : v.obj) {
+                if (!first) out += ',';
+                first = false;
+                Value k;
+                k.kind = Value::Str;
+                k.str = kv.first;
+                serialize(k, out);
+                out += ':';
+                serialize(*kv.second, out);
+            }
+            out += '}';
+            break;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// manifest builders (parity with operator/controller.py build_* — the
+// reference builds these in compiled Go: deploymentForVLLMRuntime,
+// vllmruntime_controller.go:389; router vllmrouter_controller.go:61;
+// cache server cacheserver_controller.go:54)
+// ---------------------------------------------------------------------------
+
+const char* GROUP = "serving.tpu.io";
+
+ValuePtr mk(Value::Kind k) {
+    auto v = std::make_unique<Value>();
+    v->kind = k;
+    return v;
+}
+
+ValuePtr S(const std::string& s) {
+    auto v = mk(Value::Str);
+    v->str = s;
+    return v;
+}
+
+ValuePtr N(double d) {
+    auto v = mk(Value::Num);
+    v->num = d;
+    return v;
+}
+
+ValuePtr B(bool b) {
+    auto v = mk(Value::Bool);
+    v->b = b;
+    return v;
+}
+
+ValuePtr copy_value(const Value& v) {
+    auto out = mk(v.kind);
+    out->b = v.b;
+    out->num = v.num;
+    out->str = v.str;
+    for (const auto& e : v.arr) out->arr.push_back(copy_value(*e));
+    for (const auto& kv : v.obj) out->obj[kv.first] = copy_value(*kv.second);
+    return out;
+}
+
+const Value* get(const Value& obj, const std::string& key) {
+    if (obj.kind != Value::Obj) return nullptr;
+    auto it = obj.obj.find(key);
+    if (it == obj.obj.end() || it->second->kind == Value::Null)
+        return nullptr;
+    return it->second.get();
+}
+
+std::string get_str(const Value& obj, const std::string& key,
+                    const std::string& dflt = "") {
+    // unified field semantics (matched by the Python builders): missing,
+    // null, and empty-string all mean "use the default"
+    const Value* v = get(obj, key);
+    return (v && v->kind == Value::Str && !v->str.empty()) ? v->str : dflt;
+}
+
+// Python truthiness of obj.get(key): present, non-null, and non-falsy
+bool present_truthy(const Value& obj, const std::string& key) {
+    const Value* v = get(obj, key);
+    if (!v) return false;
+    switch (v->kind) {
+        case Value::Bool: return v->b;
+        case Value::Num: return v->num != 0;
+        case Value::Str: return !v->str.empty();
+        case Value::Arr: return !v->arr.empty();
+        case Value::Obj: return !v->obj.empty();
+        default: return false;
+    }
+}
+
+// Python str() of a scalar CR field (ints print without a decimal point)
+std::string py_str(const Value& v) {
+    if (v.kind == Value::Str) return v.str;
+    if (v.kind == Value::Bool) return v.b ? "True" : "False";
+    if (v.kind == Value::Num) {
+        std::string out;
+        serialize(v, out);
+        return out;
+    }
+    return "";
+}
+
+ValuePtr owner_ref(const Value& cr) {
+    auto o = mk(Value::Obj);
+    o->obj["apiVersion"] = S(std::string(GROUP) + "/v1alpha1");
+    o->obj["kind"] = S(get_str(cr, "kind"));
+    const Value* meta = get(cr, "metadata");
+    o->obj["name"] = S(meta ? get_str(*meta, "name") : "");
+    o->obj["uid"] = S(meta ? get_str(*meta, "uid") : "");
+    o->obj["controller"] = B(true);
+    o->obj["blockOwnerDeletion"] = B(true);
+    auto arr = mk(Value::Arr);
+    arr->arr.push_back(std::move(o));
+    return arr;
+}
+
+ValuePtr http_probe(const char* path, int port, int period, int failures) {
+    auto p = mk(Value::Obj);
+    auto hg = mk(Value::Obj);
+    hg->obj["path"] = S(path);
+    hg->obj["port"] = N(port);
+    p->obj["httpGet"] = std::move(hg);
+    p->obj["periodSeconds"] = N(period);
+    if (failures > 0) p->obj["failureThreshold"] = N(failures);
+    return p;
+}
+
+void push_args(Value& args, const std::string& a, const std::string& b) {
+    args.arr.push_back(S(a));
+    args.arr.push_back(S(b));
+}
+
+ValuePtr build_engine_deployment(const Value& cr,
+                                 const std::string& image) {
+    const Value* specp = get(cr, "spec");
+    static const Value empty_obj = [] {
+        Value v;
+        v.kind = Value::Obj;
+        return v;
+    }();
+    const Value& spec = specp ? *specp : empty_obj;
+    const Value& meta = *get(cr, "metadata");
+    std::string name = get_str(meta, "name");
+    std::string ns = get_str(meta, "namespace");
+    const Value* tpu = get(spec, "tpu");
+    const Value* ec = get(spec, "engineConfig");
+
+    auto args = mk(Value::Arr);
+    push_args(*args, "--model", get_str(spec, "model"));
+    push_args(*args, "--port", "8000");
+    if (present_truthy(spec, "servedModelName"))
+        push_args(*args, "--served-model-name",
+                  get_str(spec, "servedModelName"));
+    static const std::pair<const char*, const char*> FLAGS[] = {
+        {"--max-model-len", "maxModelLen"},
+        {"--max-num-seqs", "maxNumSeqs"},
+        {"--dtype", "dtype"},
+        {"--tensor-parallel-size", "tensorParallelSize"},
+        {"--block-size", "blockSize"},
+        {"--num-scheduler-steps", "multiStep"},
+    };
+    for (const auto& f : FLAGS) {
+        const Value* v = ec ? get(*ec, f.second) : nullptr;
+        if (v) push_args(*args, f.first, py_str(*v));
+    }
+    const Value* extra = ec ? get(*ec, "extraArgs") : nullptr;
+    if (extra && extra->kind == Value::Arr)
+        for (const auto& e : extra->arr) args->arr.push_back(copy_value(*e));
+
+    auto labels = mk(Value::Obj);
+    labels->obj["app.kubernetes.io/component"] = S("serving-engine");
+    labels->obj[std::string(GROUP) + "/model"] = S(name);
+    labels->obj["environment"] = S("serving");
+    if (present_truthy(spec, "modelLabel"))
+        labels->obj["model"] = S(get_str(spec, "modelLabel"));
+
+    std::string chips = "8";
+    if (tpu && present_truthy(*tpu, "chips"))
+        chips = py_str(*get(*tpu, "chips"));
+    auto resources = mk(Value::Obj);
+    auto req = mk(Value::Obj);
+    req->obj["google.com/tpu"] = S(chips);
+    auto lim = mk(Value::Obj);
+    lim->obj["google.com/tpu"] = S(chips);
+    resources->obj["requests"] = std::move(req);
+    resources->obj["limits"] = std::move(lim);
+
+    auto container = mk(Value::Obj);
+    container->obj["name"] = S("engine");
+    std::string img = get_str(spec, "image");
+    container->obj["image"] = S(img.empty() ? image : img);
+    auto cmd = mk(Value::Arr);
+    cmd->arr.push_back(S("python"));
+    cmd->arr.push_back(S("-m"));
+    cmd->arr.push_back(S("production_stack_tpu.engine.server"));
+    container->obj["command"] = std::move(cmd);
+    container->obj["args"] = std::move(args);
+    auto ports = mk(Value::Arr);
+    auto port = mk(Value::Obj);
+    port->obj["name"] = S("http");
+    port->obj["containerPort"] = N(8000);
+    ports->arr.push_back(std::move(port));
+    container->obj["ports"] = std::move(ports);
+    container->obj["resources"] = std::move(resources);
+    container->obj["startupProbe"] = http_probe("/health", 8000, 10, 120);
+    container->obj["readinessProbe"] = http_probe("/health", 8000, 5, 0);
+
+    auto node_sel = mk(Value::Obj);
+    std::string accel = "tpu-v5-lite-podslice", topo = "2x4";
+    if (tpu) {
+        accel = get_str(*tpu, "accelerator", accel);
+        topo = get_str(*tpu, "topology", topo);
+    }
+    node_sel->obj["cloud.google.com/gke-tpu-accelerator"] = S(accel);
+    node_sel->obj["cloud.google.com/gke-tpu-topology"] = S(topo);
+
+    auto tol = mk(Value::Obj);
+    tol->obj["key"] = S("google.com/tpu");
+    tol->obj["operator"] = S("Exists");
+    tol->obj["effect"] = S("NoSchedule");
+    auto tols = mk(Value::Arr);
+    tols->arr.push_back(std::move(tol));
+
+    auto pod_spec = mk(Value::Obj);
+    pod_spec->obj["nodeSelector"] = std::move(node_sel);
+    pod_spec->obj["tolerations"] = std::move(tols);
+
+    if (present_truthy(spec, "pvcStorage")) {
+        auto vm = mk(Value::Obj);
+        vm->obj["name"] = S("models");
+        vm->obj["mountPath"] = S("/models");
+        auto vms = mk(Value::Arr);
+        vms->arr.push_back(std::move(vm));
+        container->obj["volumeMounts"] = std::move(vms);
+        auto vol = mk(Value::Obj);
+        vol->obj["name"] = S("models");
+        auto claim = mk(Value::Obj);
+        claim->obj["claimName"] = S(name + "-models");
+        vol->obj["persistentVolumeClaim"] = std::move(claim);
+        auto vols = mk(Value::Arr);
+        vols->arr.push_back(std::move(vol));
+        pod_spec->obj["volumes"] = std::move(vols);
+    }
+    auto containers = mk(Value::Arr);
+    containers->arr.push_back(std::move(container));
+    pod_spec->obj["containers"] = std::move(containers);
+
+    auto dep = mk(Value::Obj);
+    dep->obj["apiVersion"] = S("apps/v1");
+    dep->obj["kind"] = S("Deployment");
+    auto dmeta = mk(Value::Obj);
+    dmeta->obj["name"] = S(name + "-engine");
+    dmeta->obj["namespace"] = S(ns);
+    dmeta->obj["labels"] = copy_value(*labels);
+    dmeta->obj["ownerReferences"] = owner_ref(cr);
+    dep->obj["metadata"] = std::move(dmeta);
+    auto dspec = mk(Value::Obj);
+    const Value* reps = get(spec, "replicas");
+    dspec->obj["replicas"] = reps ? copy_value(*reps) : N(1);
+    auto sel = mk(Value::Obj);
+    auto ml = mk(Value::Obj);
+    ml->obj[std::string(GROUP) + "/model"] = S(name);
+    sel->obj["matchLabels"] = std::move(ml);
+    dspec->obj["selector"] = std::move(sel);
+    auto tmpl = mk(Value::Obj);
+    auto tmeta = mk(Value::Obj);
+    tmeta->obj["labels"] = std::move(labels);
+    tmpl->obj["metadata"] = std::move(tmeta);
+    tmpl->obj["spec"] = std::move(pod_spec);
+    dspec->obj["template"] = std::move(tmpl);
+    dep->obj["spec"] = std::move(dspec);
+    return dep;
+}
+
+ValuePtr build_engine_service(const Value& cr) {
+    const Value& meta = *get(cr, "metadata");
+    std::string name = get_str(meta, "name");
+    auto svc = mk(Value::Obj);
+    svc->obj["apiVersion"] = S("v1");
+    svc->obj["kind"] = S("Service");
+    auto smeta = mk(Value::Obj);
+    smeta->obj["name"] = S(name + "-engine");
+    smeta->obj["namespace"] = S(get_str(meta, "namespace"));
+    auto labels = mk(Value::Obj);
+    labels->obj[std::string(GROUP) + "/model"] = S(name);
+    smeta->obj["labels"] = std::move(labels);
+    smeta->obj["ownerReferences"] = owner_ref(cr);
+    svc->obj["metadata"] = std::move(smeta);
+    auto sspec = mk(Value::Obj);
+    sspec->obj["clusterIP"] = S("None");
+    auto sel = mk(Value::Obj);
+    sel->obj[std::string(GROUP) + "/model"] = S(name);
+    sspec->obj["selector"] = std::move(sel);
+    auto ports = mk(Value::Arr);
+    auto port = mk(Value::Obj);
+    port->obj["name"] = S("http");
+    port->obj["port"] = N(8000);
+    ports->arr.push_back(std::move(port));
+    sspec->obj["ports"] = std::move(ports);
+    svc->obj["spec"] = std::move(sspec);
+    return svc;
+}
+
+ValuePtr build_pvc(const Value& cr) {
+    const Value& meta = *get(cr, "metadata");
+    std::string name = get_str(meta, "name");
+    auto pvc = mk(Value::Obj);
+    pvc->obj["apiVersion"] = S("v1");
+    pvc->obj["kind"] = S("PersistentVolumeClaim");
+    auto pmeta = mk(Value::Obj);
+    pmeta->obj["name"] = S(name + "-models");
+    pmeta->obj["namespace"] = S(get_str(meta, "namespace"));
+    pmeta->obj["ownerReferences"] = owner_ref(cr);
+    pvc->obj["metadata"] = std::move(pmeta);
+    auto pspec = mk(Value::Obj);
+    auto modes = mk(Value::Arr);
+    modes->arr.push_back(S("ReadWriteOnce"));
+    pspec->obj["accessModes"] = std::move(modes);
+    auto res = mk(Value::Obj);
+    auto req = mk(Value::Obj);
+    const Value* spec = get(cr, "spec");
+    const Value* storage = spec ? get(*spec, "pvcStorage") : nullptr;
+    req->obj["storage"] = storage ? copy_value(*storage) : S("");
+    res->obj["requests"] = std::move(req);
+    pspec->obj["resources"] = std::move(res);
+    pvc->obj["spec"] = std::move(pspec);
+    return pvc;
+}
+
+ValuePtr build_router_deployment(const Value& cr, const std::string& image) {
+    const Value* specp = get(cr, "spec");
+    static const Value empty_obj = [] {
+        Value v;
+        v.kind = Value::Obj;
+        return v;
+    }();
+    const Value& spec = specp ? *specp : empty_obj;
+    const Value& meta = *get(cr, "metadata");
+    std::string name = get_str(meta, "name");
+    std::string ns = get_str(meta, "namespace");
+
+    auto args = mk(Value::Arr);
+    push_args(*args, "--port", "8001");
+    push_args(*args, "--service-discovery", "k8s_pod_ip");
+    push_args(*args, "--k8s-namespace", ns);
+    push_args(*args, "--k8s-label-selector",
+              get_str(spec, "k8sLabelSelector",
+                      "app.kubernetes.io/component=serving-engine"));
+    push_args(*args, "--k8s-port",
+              present_truthy(spec, "enginePort")
+                  ? py_str(*get(spec, "enginePort")) : "8000");
+    push_args(*args, "--routing-logic",
+              get_str(spec, "routingLogic", "roundrobin"));
+    const Value* mfa = get(spec, "maxFailoverAttempts");
+    push_args(*args, "--max-instance-failover-reroute-attempts",
+              mfa ? py_str(*mfa) : "2");
+    if (present_truthy(spec, "sessionKey"))
+        push_args(*args, "--session-key", get_str(spec, "sessionKey"));
+    const Value* extra = get(spec, "extraArgs");
+    if (extra && extra->kind == Value::Arr)
+        for (const auto& e : extra->arr) args->arr.push_back(copy_value(*e));
+
+    auto labels = mk(Value::Obj);
+    labels->obj["app.kubernetes.io/component"] = S("router");
+    labels->obj[std::string(GROUP) + "/router"] = S(name);
+
+    auto container = mk(Value::Obj);
+    container->obj["name"] = S("router");
+    std::string img = get_str(spec, "image");
+    container->obj["image"] = S(img.empty() ? image : img);
+    auto cmd = mk(Value::Arr);
+    cmd->arr.push_back(S("python"));
+    cmd->arr.push_back(S("-m"));
+    cmd->arr.push_back(S("production_stack_tpu.router.app"));
+    container->obj["command"] = std::move(cmd);
+    container->obj["args"] = std::move(args);
+    auto ports = mk(Value::Arr);
+    auto port = mk(Value::Obj);
+    port->obj["name"] = S("http");
+    port->obj["containerPort"] = N(8001);
+    ports->arr.push_back(std::move(port));
+    container->obj["ports"] = std::move(ports);
+    auto rp = mk(Value::Obj);
+    auto hg = mk(Value::Obj);
+    hg->obj["path"] = S("/health");
+    hg->obj["port"] = N(8001);
+    rp->obj["httpGet"] = std::move(hg);
+    container->obj["readinessProbe"] = std::move(rp);
+
+    auto pod_spec = mk(Value::Obj);
+    pod_spec->obj["serviceAccountName"] = S(name + "-router");
+    auto containers = mk(Value::Arr);
+    containers->arr.push_back(std::move(container));
+    pod_spec->obj["containers"] = std::move(containers);
+
+    auto dep = mk(Value::Obj);
+    dep->obj["apiVersion"] = S("apps/v1");
+    dep->obj["kind"] = S("Deployment");
+    auto dmeta = mk(Value::Obj);
+    dmeta->obj["name"] = S(name + "-router");
+    dmeta->obj["namespace"] = S(ns);
+    dmeta->obj["labels"] = copy_value(*labels);
+    dmeta->obj["ownerReferences"] = owner_ref(cr);
+    dep->obj["metadata"] = std::move(dmeta);
+    auto dspec = mk(Value::Obj);
+    const Value* reps = get(spec, "replicas");
+    dspec->obj["replicas"] = reps ? copy_value(*reps) : N(1);
+    auto sel = mk(Value::Obj);
+    auto ml = mk(Value::Obj);
+    ml->obj[std::string(GROUP) + "/router"] = S(name);
+    sel->obj["matchLabels"] = std::move(ml);
+    dspec->obj["selector"] = std::move(sel);
+    auto tmpl = mk(Value::Obj);
+    auto tmeta = mk(Value::Obj);
+    tmeta->obj["labels"] = std::move(labels);
+    tmpl->obj["metadata"] = std::move(tmeta);
+    tmpl->obj["spec"] = std::move(pod_spec);
+    dspec->obj["template"] = std::move(tmpl);
+    dep->obj["spec"] = std::move(dspec);
+    return dep;
+}
+
+ValuePtr build_cache_server_deployment(const Value& cr,
+                                       const std::string& image) {
+    const Value* specp = get(cr, "spec");
+    static const Value empty_obj = [] {
+        Value v;
+        v.kind = Value::Obj;
+        return v;
+    }();
+    const Value& spec = specp ? *specp : empty_obj;
+    const Value& meta = *get(cr, "metadata");
+    std::string name = get_str(meta, "name");
+
+    const Value* portv =
+        present_truthy(spec, "port") ? get(spec, "port") : nullptr;
+    std::string port_s = portv ? py_str(*portv) : "8100";
+    double port_n = portv && portv->kind == Value::Num ? portv->num : 8100;
+    const Value* capv = present_truthy(spec, "capacityBlocks")
+                            ? get(spec, "capacityBlocks") : nullptr;
+
+    auto container = mk(Value::Obj);
+    container->obj["name"] = S("cacheserver");
+    std::string img = get_str(spec, "image");
+    container->obj["image"] = S(img.empty() ? image : img);
+    auto cmd = mk(Value::Arr);
+    cmd->arr.push_back(S("python"));
+    cmd->arr.push_back(S("-m"));
+    cmd->arr.push_back(S("production_stack_tpu.kv_server"));
+    container->obj["command"] = std::move(cmd);
+    auto args = mk(Value::Arr);
+    push_args(*args, "--port", port_s);
+    push_args(*args, "--capacity-blocks", capv ? py_str(*capv) : "65536");
+    container->obj["args"] = std::move(args);
+    auto ports = mk(Value::Arr);
+    auto port = mk(Value::Obj);
+    port->obj["containerPort"] =
+        portv ? copy_value(*portv) : N(port_n);
+    ports->arr.push_back(std::move(port));
+    container->obj["ports"] = std::move(ports);
+
+    auto labels = mk(Value::Obj);
+    labels->obj[std::string(GROUP) + "/cacheserver"] = S(name);
+
+    auto dep = mk(Value::Obj);
+    dep->obj["apiVersion"] = S("apps/v1");
+    dep->obj["kind"] = S("Deployment");
+    auto dmeta = mk(Value::Obj);
+    dmeta->obj["name"] = S(name + "-cacheserver");
+    dmeta->obj["namespace"] = S(get_str(meta, "namespace"));
+    dmeta->obj["labels"] = copy_value(*labels);
+    dmeta->obj["ownerReferences"] = owner_ref(cr);
+    dep->obj["metadata"] = std::move(dmeta);
+    auto dspec = mk(Value::Obj);
+    const Value* reps = get(spec, "replicas");
+    dspec->obj["replicas"] = reps ? copy_value(*reps) : N(1);
+    auto sel = mk(Value::Obj);
+    auto ml = mk(Value::Obj);
+    ml->obj[std::string(GROUP) + "/cacheserver"] = S(name);
+    sel->obj["matchLabels"] = std::move(ml);
+    dspec->obj["selector"] = std::move(sel);
+    auto tmpl = mk(Value::Obj);
+    auto tmeta = mk(Value::Obj);
+    tmeta->obj["labels"] = std::move(labels);
+    tmpl->obj["metadata"] = std::move(tmeta);
+    auto pod_spec = mk(Value::Obj);
+    auto containers = mk(Value::Arr);
+    containers->arr.push_back(std::move(container));
+    pod_spec->obj["containers"] = std::move(containers);
+    tmpl->obj["spec"] = std::move(pod_spec);
+    dspec->obj["template"] = std::move(tmpl);
+    dep->obj["spec"] = std::move(dspec);
+    return dep;
+}
+
 }  // namespace
 
 extern "C" {
@@ -234,5 +833,41 @@ int rc_subset_drifted(const char* desired_json, const char* live_json) {
     if (!pd.ok || !pl.ok) return -1;
     return drifted(*d, *l) ? 1 : 0;
 }
+
+// Build the child manifests for one CR. kind: "engine" (TPURuntime:
+// deployment+service[+pvc]), "router" (TPURouter), "cacheserver"
+// (CacheServer). Returns a malloc'd JSON object string the caller frees
+// with rc_free(), or NULL on parse/shape error.
+char* rc_build_manifests(const char* kind, const char* cr_json,
+                         const char* default_image) {
+    Parser pc(cr_json);
+    ValuePtr cr = pc.parse();
+    if (!pc.ok || cr->kind != Value::Obj || !get(*cr, "metadata"))
+        return nullptr;
+    std::string image = default_image ? default_image : "";
+    auto out = mk(Value::Obj);
+    std::string k = kind ? kind : "";
+    if (k == "engine") {
+        out->obj["deployment"] = build_engine_deployment(*cr, image);
+        out->obj["service"] = build_engine_service(*cr);
+        const Value* spec = get(*cr, "spec");
+        if (spec && present_truthy(*spec, "pvcStorage"))
+            out->obj["pvc"] = build_pvc(*cr);
+    } else if (k == "router") {
+        out->obj["deployment"] = build_router_deployment(*cr, image);
+    } else if (k == "cacheserver") {
+        out->obj["deployment"] = build_cache_server_deployment(*cr, image);
+    } else {
+        return nullptr;
+    }
+    std::string s;
+    serialize(*out, s);
+    char* buf = (char*)malloc(s.size() + 1);
+    if (!buf) return nullptr;
+    memcpy(buf, s.c_str(), s.size() + 1);
+    return buf;
+}
+
+void rc_free(char* p) { free(p); }
 
 }  // extern "C"

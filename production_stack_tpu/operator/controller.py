@@ -51,6 +51,15 @@ def _owner_ref(cr: dict) -> dict:
 # manifest builders
 # ---------------------------------------------------------------------------
 
+def _nn(spec: dict, key: str, default):
+    """Null-safe get: missing OR explicit null -> default (0 is a value).
+
+    Unified field semantics shared with the compiled builders
+    (native/reconciler/reconcile_core.cpp get()/present_truthy): a CR
+    field that is missing, null, or an empty string means "default"."""
+    v = spec.get(key)
+    return v if v is not None else default
+
 def build_engine_deployment(cr: dict, image: str) -> dict:
     spec = cr.get("spec", {})
     name = cr["metadata"]["name"]
@@ -83,8 +92,8 @@ def build_engine_deployment(cr: dict, image: str) -> dict:
         "args": args,
         "ports": [{"name": "http", "containerPort": 8000}],
         "resources": {
-            "requests": {"google.com/tpu": str(tpu.get("chips", 8))},
-            "limits": {"google.com/tpu": str(tpu.get("chips", 8))},
+            "requests": {"google.com/tpu": str(tpu.get("chips") or 8)},
+            "limits": {"google.com/tpu": str(tpu.get("chips") or 8)},
         },
         "startupProbe": {
             "httpGet": {"path": "/health", "port": 8000},
@@ -96,9 +105,9 @@ def build_engine_deployment(cr: dict, image: str) -> dict:
     }
     pod_spec = {
         "nodeSelector": {
-            "cloud.google.com/gke-tpu-accelerator": tpu.get(
-                "accelerator", "tpu-v5-lite-podslice"),
-            "cloud.google.com/gke-tpu-topology": tpu.get("topology", "2x4"),
+            "cloud.google.com/gke-tpu-accelerator": (
+                tpu.get("accelerator") or "tpu-v5-lite-podslice"),
+            "cloud.google.com/gke-tpu-topology": tpu.get("topology") or "2x4",
         },
         "tolerations": [
             {"key": "google.com/tpu", "operator": "Exists",
@@ -120,7 +129,7 @@ def build_engine_deployment(cr: dict, image: str) -> dict:
             "ownerReferences": [_owner_ref(cr)],
         },
         "spec": {
-            "replicas": spec.get("replicas", 1),
+            "replicas": _nn(spec, "replicas", 1),
             "selector": {"matchLabels": {f"{GROUP}/model": name}},
             "template": {"metadata": {"labels": labels}, "spec": pod_spec},
         },
@@ -170,11 +179,12 @@ def build_router_deployment(cr: dict, image: str) -> dict:
         "--service-discovery", "k8s_pod_ip",
         "--k8s-namespace", ns,
         "--k8s-label-selector",
-        spec.get("k8sLabelSelector", "app.kubernetes.io/component=serving-engine"),
-        "--k8s-port", str(spec.get("enginePort", 8000)),
-        "--routing-logic", spec.get("routingLogic", "roundrobin"),
+        spec.get("k8sLabelSelector")
+        or "app.kubernetes.io/component=serving-engine",
+        "--k8s-port", str(spec.get("enginePort") or 8000),
+        "--routing-logic", spec.get("routingLogic") or "roundrobin",
         "--max-instance-failover-reroute-attempts",
-        str(spec.get("maxFailoverAttempts", 2)),
+        str(_nn(spec, "maxFailoverAttempts", 2)),
     ]
     if spec.get("sessionKey"):
         args += ["--session-key", spec["sessionKey"]]
@@ -188,7 +198,7 @@ def build_router_deployment(cr: dict, image: str) -> dict:
             "ownerReferences": [_owner_ref(cr)],
         },
         "spec": {
-            "replicas": spec.get("replicas", 1),
+            "replicas": _nn(spec, "replicas", 1),
             "selector": {"matchLabels": {f"{GROUP}/router": name}},
             "template": {
                 "metadata": {"labels": labels},
@@ -224,7 +234,7 @@ def build_cache_server_deployment(cr: dict, image: str) -> dict:
             "ownerReferences": [_owner_ref(cr)],
         },
         "spec": {
-            "replicas": spec.get("replicas", 1),
+            "replicas": _nn(spec, "replicas", 1),
             "selector": {"matchLabels": {f"{GROUP}/cacheserver": name}},
             "template": {
                 "metadata": {"labels": {f"{GROUP}/cacheserver": name}},
@@ -233,14 +243,61 @@ def build_cache_server_deployment(cr: dict, image: str) -> dict:
                     "image": spec.get("image") or image,
                     "command": ["python", "-m",
                                 "production_stack_tpu.kv_server"],
-                    "args": ["--port", str(spec.get("port", 8100)),
+                    "args": ["--port", str(spec.get("port") or 8100),
                              "--capacity-blocks",
-                             str(spec.get("capacityBlocks", 65536))],
-                    "ports": [{"containerPort": spec.get("port", 8100)}],
+                             str(spec.get("capacityBlocks") or 65536)],
+                    "ports": [{"containerPort": spec.get("port") or 8100}],
                 }]},
             },
         },
     }
+
+
+# ---------------------------------------------------------------------------
+# compiled-first manifest dispatch: the C++ builders in
+# native/reconciler/reconcile_core.cpp (rc_build_manifests — the operator
+# parity for the reference's compiled Go deploymentForVLLMRuntime,
+# vllmruntime_controller.go:389) are preferred; the Python builders above
+# are the behaviour-identical fallback, pinned byte-equal by
+# tests/test_operator.py::test_native_manifest_parity.
+# ---------------------------------------------------------------------------
+
+def engine_manifests(cr: dict, image: str):
+    """(deployment, service, pvc-or-None) for a TPURuntime CR."""
+    from production_stack_tpu.operator.native_manifests import (
+        build_manifests_native,
+    )
+
+    out = build_manifests_native("engine", cr, image)
+    if out is not None:
+        return out["deployment"], out["service"], out.get("pvc")
+    return (
+        build_engine_deployment(cr, image),
+        build_engine_service(cr),
+        build_pvc(cr) if cr["spec"].get("pvcStorage") else None,
+    )
+
+
+def router_manifest(cr: dict, image: str) -> dict:
+    from production_stack_tpu.operator.native_manifests import (
+        build_manifests_native,
+    )
+
+    out = build_manifests_native("router", cr, image)
+    if out is not None:
+        return out["deployment"]
+    return build_router_deployment(cr, image)
+
+
+def cacheserver_manifest(cr: dict, image: str) -> dict:
+    from production_stack_tpu.operator.native_manifests import (
+        build_manifests_native,
+    )
+
+    out = build_manifests_native("cacheserver", cr, image)
+    if out is not None:
+        return out["deployment"]
+    return build_cache_server_deployment(cr, image)
 
 
 # ---------------------------------------------------------------------------
@@ -421,10 +478,11 @@ class Operator:
         deploys = f"/apis/apps/v1/namespaces/{self.ns}/deployments"
         services = f"/api/v1/namespaces/{self.ns}/services"
         pvcs = f"/api/v1/namespaces/{self.ns}/persistentvolumeclaims"
-        await self._ensure(deploys, build_engine_deployment(cr, self.engine_image))
-        await self._ensure(services, build_engine_service(cr))
-        if cr["spec"].get("pvcStorage"):
-            await self._ensure(pvcs, build_pvc(cr))
+        dep, svc, pvc = engine_manifests(cr, self.engine_image)
+        await self._ensure(deploys, dep)
+        await self._ensure(services, svc)
+        if pvc is not None:
+            await self._ensure(pvcs, pvc)
         autoscaling = cr["spec"].get("autoscaling") or {}
         scaled = f"/apis/keda.sh/v1alpha1/namespaces/{self.ns}/scaledobjects"
         if autoscaling and autoscaling.get("enabled", True):
@@ -463,7 +521,7 @@ class Operator:
         name = cr["metadata"]["name"]
         deploys = f"/apis/apps/v1/namespaces/{self.ns}/deployments"
         services = f"/api/v1/namespaces/{self.ns}/services"
-        await self._ensure(deploys, build_router_deployment(cr, self.router_image))
+        await self._ensure(deploys, router_manifest(cr, self.router_image))
         await self._ensure(services, {
             "apiVersion": "v1", "kind": "Service",
             "metadata": {"name": f"{name}-router", "namespace": self.ns,
@@ -481,7 +539,7 @@ class Operator:
         deploys = f"/apis/apps/v1/namespaces/{self.ns}/deployments"
         services = f"/api/v1/namespaces/{self.ns}/services"
         await self._ensure(
-            deploys, build_cache_server_deployment(cr, self.engine_image)
+            deploys, cacheserver_manifest(cr, self.engine_image)
         )
         await self._ensure(services, {
             "apiVersion": "v1", "kind": "Service",

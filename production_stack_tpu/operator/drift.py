@@ -23,7 +23,11 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _load() -> Optional[ctypes.CDLL]:
+def load_reconcile_lib() -> Optional[ctypes.CDLL]:
+    """The ONE loader for libreconcile.so, shared by every binding module
+    (drift here, manifest builders in operator/native_manifests.py) so the
+    path and fallback policy can't diverge. Registers all C-ABI symbol
+    signatures once."""
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
@@ -36,10 +40,19 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(os.path.abspath(so))
         lib.rc_subset_drifted.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.rc_subset_drifted.restype = ctypes.c_int
+        lib.rc_build_manifests.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.rc_build_manifests.restype = ctypes.c_void_p  # freed via rc_free
+        lib.rc_free.argtypes = [ctypes.c_void_p]
+        lib.rc_free.restype = None
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
         _LIB = None
     return _LIB
+
+
+_load = load_reconcile_lib
 
 
 def _py_subset_drifted(desired: Any, live: Any) -> bool:

@@ -84,6 +84,22 @@ assert lib.rc_subset_drifted(b'{{"a": [1, {{"b": "x"}}]}}',
                              b'{{"a": [1, {{"b": "x"}}], "c": 2}}') == 0
 assert lib.rc_subset_drifted(b'{{"a": 1}}', b'{{"a": 2}}') == 1
 assert lib.rc_subset_drifted(b'not json', b'{{}}') == -1
+lib.rc_build_manifests.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_char_p]
+lib.rc_build_manifests.restype = ctypes.c_void_p
+lib.rc_free.argtypes = [ctypes.c_void_p]
+import json
+cr = {{"kind": "TPURuntime",
+      "metadata": {{"name": "a", "namespace": "d", "uid": "u"}},
+      "spec": {{"model": "m", "pvcStorage": "1Gi",
+               "engineConfig": {{"maxNumSeqs": 8,
+                                "extraArgs": ["--x", "y"]}}}}}}
+ptr = lib.rc_build_manifests(b"engine", json.dumps(cr).encode(), b"img")
+assert ptr
+out = json.loads(ctypes.string_at(ptr).decode())
+lib.rc_free(ptr)
+assert out["deployment"]["kind"] == "Deployment" and "pvc" in out
+assert lib.rc_build_manifests(b"bogus", json.dumps(cr).encode(), b"i") in (None, 0)
 print("SMOKE-OK")
 """
     env = dict(os.environ, LD_PRELOAD=_sanitizer_runtime("asan"),

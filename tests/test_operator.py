@@ -423,3 +423,130 @@ def test_admission_webhook_validation():
             assert (await r.json())["response"]["allowed"]
 
     asyncio.run(main())
+
+
+def test_native_manifest_parity():
+    """The compiled manifest builders (rc_build_manifests,
+    native/reconciler/reconcile_core.cpp — VERDICT r3 #8) must produce
+    byte-identical objects to the Python fallbacks for a matrix of CR
+    shapes: every field difference would be drift the operator then
+    fights itself over."""
+    from production_stack_tpu.operator.controller import (
+        build_cache_server_deployment,
+        build_engine_deployment,
+        build_engine_service,
+        build_pvc,
+        build_router_deployment,
+    )
+    from production_stack_tpu.operator.native_manifests import (
+        build_manifests_native,
+        native_available,
+    )
+
+    assert native_available(), "libreconcile.so must be built in-repo"
+
+    runtimes = [
+        {  # minimal
+            "kind": "TPURuntime",
+            "metadata": {"name": "mini", "namespace": "default", "uid": "u1"},
+            "spec": {"model": "llama-3-8b"},
+        },
+        {  # full
+            "kind": "TPURuntime",
+            "metadata": {"name": "full", "namespace": "prod", "uid": "u2"},
+            "spec": {
+                "model": "/models/llama-3-70b",
+                "servedModelName": "llama-3-70b",
+                "replicas": 4,
+                "modelLabel": "decode",
+                "image": "custom/engine:2",
+                "pvcStorage": "200Gi",
+                "tpu": {"accelerator": "tpu-v6e-slice", "topology": "4x4",
+                        "chips": 16},
+                "engineConfig": {
+                    "maxModelLen": 32768, "maxNumSeqs": 64,
+                    "dtype": "bfloat16", "tensorParallelSize": 16,
+                    "blockSize": 32, "multiStep": 8,
+                    "extraArgs": ["--quantization", "int8"],
+                },
+            },
+        },
+    ]
+    for cr in runtimes:
+        native = build_manifests_native("engine", cr, "img:latest")
+        assert native is not None
+        assert native["deployment"] == build_engine_deployment(
+            cr, "img:latest")
+        assert native["service"] == build_engine_service(cr)
+        if cr["spec"].get("pvcStorage"):
+            assert native["pvc"] == build_pvc(cr)
+        else:
+            assert "pvc" not in native
+
+    router_cr = {
+        "kind": "TPURouter",
+        "metadata": {"name": "rt", "namespace": "prod", "uid": "u3"},
+        "spec": {"routingLogic": "session", "sessionKey": "x-user-id",
+                 "replicas": 2, "maxFailoverAttempts": 3,
+                 "enginePort": 9000, "extraArgs": ["--log-level", "debug"]},
+    }
+    native = build_manifests_native("router", router_cr, "r:1")
+    assert native["deployment"] == build_router_deployment(router_cr, "r:1")
+
+    cs_cr = {
+        "kind": "CacheServer",
+        "metadata": {"name": "kv", "namespace": "default", "uid": "u4"},
+        "spec": {"port": 9100, "capacityBlocks": 1024, "replicas": 2},
+    }
+    native = build_manifests_native("cacheserver", cs_cr, "c:1")
+    assert native["deployment"] == build_cache_server_deployment(cs_cr, "c:1")
+
+    # adversarial field shapes (r4 review): non-ASCII survives the JSON
+    # round trip; empty-string and explicit-null optional fields mean
+    # "absent" on BOTH sides (unified semantics, controller._nn)
+    tricky = {
+        "kind": "TPURuntime",
+        "metadata": {"name": "caf\u00e9", "namespace": "d\u00e9fault",
+                     "uid": "u5"},
+        "spec": {
+            "model": "mod\u00e8le-\U0001f680",   # non-BMP: surrogate pair
+            "servedModelName": "",                 # empty: no flag emitted
+            "modelLabel": None,                    # null: no label
+            "pvcStorage": "",                      # empty: NO pvc
+            "replicas": None,                      # null: default 1
+            "tpu": {"accelerator": "", "topology": None, "chips": 0},
+        },
+    }
+    native = build_manifests_native("engine", tricky, "img")
+    assert native is not None
+    py_dep = build_engine_deployment(tricky, "img")
+    assert native["deployment"] == py_dep
+    assert "pvc" not in native
+    args = py_dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--model") + 1] == "mod\u00e8le-\U0001f680"
+    assert "--served-model-name" not in args
+    assert py_dep["spec"]["replicas"] == 1
+    sel = py_dep["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+
+    router_null = {
+        "kind": "TPURouter",
+        "metadata": {"name": "rn", "namespace": "n", "uid": "u6"},
+        "spec": {"routingLogic": None, "sessionKey": "",
+                 "enginePort": None, "maxFailoverAttempts": 0},
+    }
+    native = build_manifests_native("router", router_null, "r:1")
+    py_dep = build_router_deployment(router_null, "r:1")
+    assert native["deployment"] == py_dep
+    args = py_dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--routing-logic") + 1] == "roundrobin"
+    assert args[args.index("--k8s-port") + 1] == "8000"
+    # 0 is a VALUE for failover attempts, not a missing field
+    assert args[args.index(
+        "--max-instance-failover-reroute-attempts") + 1] == "0"
+    assert "--session-key" not in args
+
+    # malformed input: clean None, not a crash
+    assert build_manifests_native("engine", {"no": "metadata"}, "x") is None
+    assert build_manifests_native("bogus-kind", runtimes[0], "x") is None
