@@ -239,3 +239,81 @@ def test_allocator_out_of_blocks():
 def test_slot_mapping():
     slots = slot_mapping_for([5, 9], start=2, count=4, block_size=4)
     np.testing.assert_array_equal(slots, [22, 23, 36, 37])
+
+
+def test_pallas_decode_poisoned_tail_blocks_ignored():
+    """Per-block DMA predication (r4: the roofline's 1.8x over-read fix)
+    must not let NaN/Inf in never-read tail blocks reach the output: tail
+    blocks past each context are poisoned and outputs must still match."""
+    rng = np.random.default_rng(7)
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_pallas,
+    )
+
+    kh, d, H = 4, 16, 8
+    B, N, M, layers = 2, 32, 8, 1
+    lens = np.array([9, 21], np.int32)  # partial blocks at BS=4
+    cache = np.array(build_random_cache(rng, layers, N, kh, d))
+    tables = np.arange(B * M, dtype=np.int32).reshape(B, M)
+    # poison every block slot past each row's live context
+    for b in range(B):
+        live_blocks = -(-int(lens[b]) // BS)
+        for m in range(live_blocks, M):
+            cache[0, tables[b, m]] = np.nan
+        # ...and the tail of the last partial block
+        tail = int(lens[b]) % BS
+        if tail:
+            cache[0, tables[b, live_blocks - 1], tail:] = np.inf
+    q = rng.standard_normal((B, H, d), dtype=np.float32)
+    got = paged_decode_attention_pallas(
+        jnp.asarray(q), jnp.asarray(cache), jnp.asarray(tables),
+        jnp.asarray(lens), 0, windows=2, interpret=True,
+    )
+    assert np.isfinite(np.asarray(got)).all()
+    want = paged_attention(
+        jnp.asarray(q)[:, None],
+        jnp.asarray(np.nan_to_num(cache, posinf=0.0))[0],
+        jnp.asarray(tables), jnp.asarray(lens),
+        jnp.asarray(lens - 1)[:, None],
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_prefill_poisoned_tail_blocks_ignored():
+    """Same hazard pin for the PREFILL kernel: table blocks past a tile's
+    causal reach are never DMA'd; poison must not leak into outputs."""
+    rng = np.random.default_rng(8)
+    from production_stack_tpu.ops.paged_attention_pallas import (
+        paged_prefill_attention_pallas,
+    )
+
+    kh, d, H = 4, 16, 8
+    N, M, layers = 16, 8, 1
+    S_pad, chunk, q_start = 8, 6, 5  # ctx = 11: partial block at BS=4
+    ctx = q_start + chunk
+    cache = np.array(build_random_cache(rng, layers, N, kh, d))
+    table = np.arange(M, dtype=np.int32)
+    live_blocks = -(-ctx // BS)
+    for m in range(live_blocks, M):
+        cache[0, table[m]] = np.nan     # never-read whole blocks
+    if ctx % BS:
+        cache[0, table[live_blocks - 1], ctx % BS:] = np.inf  # in-block tail
+    q = rng.standard_normal((1, S_pad, H, d), dtype=np.float32)
+    got = paged_prefill_attention_pallas(
+        jnp.asarray(q), jnp.asarray(cache), jnp.asarray(table[None]),
+        jnp.asarray([q_start], jnp.int32), jnp.asarray([ctx], jnp.int32),
+        0, q_tile=8, windows=2, interpret=True,
+    )
+    assert np.isfinite(np.asarray(got[0, :chunk])).all()
+    positions = np.full((1, S_pad), -1, np.int32)
+    positions[0, :chunk] = np.arange(q_start, ctx)
+    want = paged_attention(
+        jnp.asarray(q), jnp.asarray(np.nan_to_num(cache, posinf=0.0))[0],
+        jnp.asarray(table[None]), jnp.asarray([ctx], jnp.int32),
+        jnp.asarray(positions),
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(got[0, :chunk]), np.asarray(want[:chunk]),
+        rtol=2e-4, atol=2e-4,
+    )
