@@ -175,7 +175,7 @@ def _tokens_covering(tk, token_ids: list, text_len: int) -> int:
 # (router/request_service.py PATH_CAPABILITY; VERDICT r3 #5)
 ENGINE_CAPABILITIES = (
     "chat", "completions", "responses", "messages", "embeddings",
-    "score", "rerank", "tokenize",
+    "score", "rerank", "pooling", "tokenize",
 )
 
 
@@ -237,6 +237,7 @@ class EngineServer:
         app.router.add_post("/rerank", self.rerank)  # Jina-style alias
         app.router.add_post("/v1/messages", self.messages)
         app.router.add_post("/v1/responses", self.responses)
+        app.router.add_post("/pooling", self.pooling)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/debug/profile", self.profile)
@@ -755,7 +756,11 @@ class EngineServer:
             "usage": {"total_tokens": total},
         })
 
-    async def embeddings(self, request: web.Request) -> web.Response:
+    async def _embed_batch(self, request: web.Request, item_of):
+        """Shared /v1/embeddings + /pooling implementation: validate the
+        OpenAI ``input`` shapes (str | [str,...] | [int,...] | [[int],..]),
+        mean-pool each prompt through the engine, and format items via
+        ``item_of(index, vector)``. Returns the response (400s included)."""
         try:
             body = await request.json()
         except Exception:
@@ -770,7 +775,17 @@ class EngineServer:
             inputs = [inputs]
         elif isinstance(inputs, list) and inputs and isinstance(inputs[0], int):
             inputs = [inputs]  # a single pre-tokenized prompt
-        tk = self.engine.tokenizer
+        if not isinstance(inputs, list) or not all(
+            isinstance(t, str)
+            or (isinstance(t, list) and all(isinstance(x, int) for x in t))
+            for t in inputs
+        ):
+            return web.json_response(
+                {"error": {"message": "invalid 'input': expected string, "
+                           "token list, or a list thereof",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
         data = []
         total_tokens = 0
         for i, text in enumerate(inputs):
@@ -779,10 +794,7 @@ class EngineServer:
             vec = await self.async_engine.run_on_engine(
                 lambda eng, ids=ids: eng.embed(ids)
             )
-            data.append(
-                {"object": "embedding", "index": i,
-                 "embedding": [float(x) for x in vec]}
-            )
+            data.append(item_of(i, vec))
         return web.json_response(
             {
                 "object": "list",
@@ -791,6 +803,24 @@ class EngineServer:
                 "usage": {"prompt_tokens": total_tokens,
                           "total_tokens": total_tokens},
             }
+        )
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        return await self._embed_batch(
+            request,
+            lambda i, vec: {"object": "embedding", "index": i,
+                            "embedding": [float(x) for x in vec]},
+        )
+
+    async def pooling(self, request: web.Request) -> web.Response:
+        """vLLM-style /pooling: raw pooled hidden states (the reference
+        router proxies this path to vLLM pods, main_router.py there; here
+        it's native — same encoder as /v1/embeddings, vLLM's response
+        shape with ``data`` holding the vectors)."""
+        return await self._embed_batch(
+            request,
+            lambda i, vec: {"object": "pooling", "index": i,
+                            "data": [float(x) for x in vec]},
         )
 
     # -- LoRA (reference operator contract: loadadapter_controller.go:553) --

@@ -566,3 +566,31 @@ def test_responses_stop_string_holdback_and_usage(server):
         return True
 
     assert run(with_client(server, fn))
+
+
+def test_pooling_endpoint_native(server):
+    """vLLM /pooling served natively (was: proxied to a 404)."""
+    async def fn(client):
+        r = await client.post("/pooling", json={
+            "model": "tiny-llama", "input": ["alpha", "beta gamma"]})
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert body["object"] == "list" and len(body["data"]) == 2
+        assert body["data"][0]["object"] == "pooling"
+        assert len(body["data"][0]["data"]) == 128  # hidden size
+        assert body["usage"]["prompt_tokens"] > 0
+        r = await client.post("/pooling", json={"model": "tiny-llama"})
+        assert r.status == 400
+        # non-string/non-list input is a 400, not a 500 (r4 review)
+        r = await client.post("/pooling", json={"model": "tiny-llama",
+                                                "input": 123})
+        assert r.status == 400
+        r = await client.post("/v1/embeddings", json={"model": "tiny-llama",
+                                                      "input": {"x": 1}})
+        assert r.status == 400
+        # capability advertised so the router routes /pooling here
+        r = await client.get("/v1/models")
+        assert "pooling" in (await r.json())["data"][0]["capabilities"]
+        return True
+
+    assert run(with_client(server, fn))
