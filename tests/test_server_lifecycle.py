@@ -87,7 +87,7 @@ def test_sigterm_while_serving_exits_promptly():
 import pytest
 
 
-@pytest.mark.parametrize("run", range(3))
+@pytest.mark.parametrize("run", range(5))
 def test_no_orphan_children_after_exit(run):
     """No descendant process survives the server (chip-hygiene gate).
 
