@@ -139,7 +139,58 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/tpu_router_files")
     p.add_argument("--batch-db-path", default="/tmp/tpu_router_batches.db")
+    p.add_argument("--config", default=None,
+                   help="YAML file of flag values (keys = flag names, dash "
+                        "or underscore spelling); explicit CLI flags win "
+                        "(reference: parsers/yaml_utils.py there)")
     return p
+
+
+def parse_args(argv=None):
+    """Two-phase parse: an optional --config YAML supplies flag values,
+    the command line overrides them (same precedence as the reference's
+    yaml-config support). YAML entries are rewritten into synthetic argv
+    PREPENDED to the real one, so argparse's own type/choices validation
+    applies to file values exactly as it does to CLI flags."""
+    import sys
+
+    parser = build_parser()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    pre, _ = parser.parse_known_args(argv)
+    if not pre.config:
+        return parser.parse_args(argv)
+    import yaml
+
+    try:
+        with open(pre.config) as f:
+            loaded = yaml.safe_load(f) or {}
+    except (OSError, yaml.YAMLError) as e:
+        parser.error(f"--config {pre.config}: {e}")
+    if not isinstance(loaded, dict):
+        parser.error(f"--config {pre.config}: expected a mapping")
+    actions = {a.dest: a for a in parser._actions
+               if a.dest not in ("config", "help")}
+    synthetic: list[str] = []
+    for key, value in loaded.items():
+        dest = str(key).replace("-", "_")
+        action = actions.get(dest)
+        if action is None:
+            parser.error(f"--config {pre.config}: unknown option {key!r}")
+        flag = action.option_strings[-1]
+        if action.const is True:  # store_true flags: presence = True
+            if not isinstance(value, bool):
+                parser.error(f"--config {pre.config}: {key!r} expects a "
+                             "boolean")
+            if value:
+                synthetic.append(flag)
+        elif isinstance(value, dict):
+            import json
+
+            synthetic += [flag, json.dumps(value)]
+        else:
+            synthetic += [flag, str(value)]
+    # file values first, CLI last: later occurrences win in argparse
+    return parser.parse_args(synthetic + argv)
 
 
 class RouterApp:
@@ -587,7 +638,7 @@ class RouterApp:
 
 
 def main(argv=None) -> None:
-    args = build_parser().parse_args(argv)
+    args = parse_args(argv)
     router = RouterApp(args)
     logger.info("tpu-router %s starting on %s:%d", __version__, args.host, args.port)
     web.run_app(router.build_app(), host=args.host, port=args.port, access_log=None)

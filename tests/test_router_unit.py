@@ -203,3 +203,49 @@ def test_sentry_flag_gated_on_sdk():
     ])
     app = RouterApp(args)
     app.initialize()  # must not raise (sdk absent in this image)
+
+
+def test_yaml_config_file_defaults_and_cli_precedence(tmp_path):
+    """--config YAML supplies flag defaults; explicit CLI flags win; typo'd
+    keys are rejected (reference parsers/yaml_utils.py parity)."""
+    import pytest
+
+    from production_stack_tpu.router.app import parse_args
+
+    cfg = tmp_path / "router.yaml"
+    cfg.write_text(
+        "routing-logic: session\n"
+        "session_key: x-user-id\n"        # underscore spelling works too
+        "max-instance-failover-reroute-attempts: 5\n"
+    )
+    args = parse_args(["--config", str(cfg)])
+    assert args.routing_logic == "session"
+    assert args.session_key == "x-user-id"
+    assert args.max_instance_failover_reroute_attempts == 5
+    # CLI beats the file
+    args = parse_args(["--config", str(cfg), "--routing-logic", "roundrobin"])
+    assert args.routing_logic == "roundrobin"
+    assert args.session_key == "x-user-id"
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("routng-logic: session\n")
+    with pytest.raises(SystemExit):
+        parse_args(["--config", str(bad)])
+    # typo'd VALUES hit argparse's own choices validation (r4 review)
+    badval = tmp_path / "badval.yaml"
+    badval.write_text("service-discovery: k8s_podip\n")
+    with pytest.raises(SystemExit):
+        parse_args(["--config", str(badval)])
+    # nested config: rejected loudly, not silently ignored
+    nested = tmp_path / "nested.yaml"
+    nested.write_text("config: other.yaml\n")
+    with pytest.raises(SystemExit):
+        parse_args(["--config", str(nested)])
+    # missing file: clean parser error, not a raw traceback
+    with pytest.raises(SystemExit):
+        parse_args(["--config", str(tmp_path / "missing.yaml")])
+    # store_true booleans work from the file
+    flags = tmp_path / "flags.yaml"
+    flags.write_text("enable-batch-api: true\nstatic-query-models: false\n")
+    args = parse_args(["--config", str(flags)])
+    assert args.enable_batch_api is True
+    assert args.static_query_models is False
