@@ -1912,6 +1912,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--control-port", type=int, default=None,
                    help="leader's step-plan broadcast port "
                         "(engine/multihost.py; env PSTPU_CONTROL_PORT)")
+    p.add_argument("--config", default=None,
+                   help="YAML file of flag values (keys = flag names); "
+                        "explicit CLI flags win (yaml_args.py)")
     return p
 
 
@@ -2033,7 +2036,9 @@ def main(argv=None) -> None:
     import os
     import signal
 
-    args = build_parser().parse_args(argv)
+    from production_stack_tpu.yaml_args import parse_with_yaml_config
+
+    args = parse_with_yaml_config(build_parser(), argv)
     platform = args.platform or os.environ.get("PSTPU_PLATFORM")
     if platform:
         import jax

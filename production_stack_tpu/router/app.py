@@ -147,50 +147,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def parse_args(argv=None):
-    """Two-phase parse: an optional --config YAML supplies flag values,
-    the command line overrides them (same precedence as the reference's
-    yaml-config support). YAML entries are rewritten into synthetic argv
-    PREPENDED to the real one, so argparse's own type/choices validation
-    applies to file values exactly as it does to CLI flags."""
-    import sys
+    """parser.parse_args with --config YAML support (CLI flags win;
+    file values get argparse's own type/choices validation —
+    production_stack_tpu/yaml_args.py)."""
+    from production_stack_tpu.yaml_args import parse_with_yaml_config
 
-    parser = build_parser()
-    argv = list(sys.argv[1:] if argv is None else argv)
-    pre, _ = parser.parse_known_args(argv)
-    if not pre.config:
-        return parser.parse_args(argv)
-    import yaml
-
-    try:
-        with open(pre.config) as f:
-            loaded = yaml.safe_load(f) or {}
-    except (OSError, yaml.YAMLError) as e:
-        parser.error(f"--config {pre.config}: {e}")
-    if not isinstance(loaded, dict):
-        parser.error(f"--config {pre.config}: expected a mapping")
-    actions = {a.dest: a for a in parser._actions
-               if a.dest not in ("config", "help")}
-    synthetic: list[str] = []
-    for key, value in loaded.items():
-        dest = str(key).replace("-", "_")
-        action = actions.get(dest)
-        if action is None:
-            parser.error(f"--config {pre.config}: unknown option {key!r}")
-        flag = action.option_strings[-1]
-        if action.const is True:  # store_true flags: presence = True
-            if not isinstance(value, bool):
-                parser.error(f"--config {pre.config}: {key!r} expects a "
-                             "boolean")
-            if value:
-                synthetic.append(flag)
-        elif isinstance(value, dict):
-            import json
-
-            synthetic += [flag, json.dumps(value)]
-        else:
-            synthetic += [flag, str(value)]
-    # file values first, CLI last: later occurrences win in argparse
-    return parser.parse_args(synthetic + argv)
+    return parse_with_yaml_config(build_parser(), argv)
 
 
 class RouterApp:

@@ -1,0 +1,61 @@
+"""YAML config files for argparse CLIs (reference: parsers/yaml_utils.py
+there — the router and engines both accept ``--config file.yaml``).
+
+File entries are rewritten into synthetic argv PREPENDED to the real
+one, so argparse's own type/choices validation applies to file values
+exactly as to CLI flags, and explicit CLI flags win (later occurrences
+override earlier ones in argparse).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+
+def parse_with_yaml_config(parser: argparse.ArgumentParser,
+                           argv: Optional[list] = None):
+    """Like ``parser.parse_args(argv)`` but honoring a ``--config`` flag.
+
+    The parser must define ``--config``. Unknown keys, non-boolean values
+    for store_true flags, unreadable files, and non-mapping documents all
+    fail through ``parser.error`` (clean usage message, exit 2).
+    """
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    pre, _ = parser.parse_known_args(argv)
+    if not getattr(pre, "config", None):
+        return parser.parse_args(argv)
+    import yaml
+
+    try:
+        with open(pre.config) as f:
+            loaded = yaml.safe_load(f) or {}
+    except (OSError, yaml.YAMLError) as e:
+        parser.error(f"--config {pre.config}: {e}")
+    if not isinstance(loaded, dict):
+        parser.error(f"--config {pre.config}: expected a mapping")
+    actions = {a.dest: a for a in parser._actions
+               if a.dest not in ("config", "help")}
+    synthetic: list[str] = []
+    for key, value in loaded.items():
+        dest = str(key).replace("-", "_")
+        action = actions.get(dest)
+        if action is None:
+            parser.error(f"--config {pre.config}: unknown option {key!r}")
+        flag = action.option_strings[-1]
+        if action.const is True:  # store_true flags: presence = True
+            if not isinstance(value, bool):
+                parser.error(f"--config {pre.config}: {key!r} expects a "
+                             "boolean")
+            if value:
+                synthetic.append(flag)
+        elif isinstance(value, dict):
+            import json
+
+            synthetic += [flag, json.dumps(value)]
+        else:
+            synthetic += [flag, str(value)]
+    # file values first, CLI last: later occurrences win in argparse
+    return parser.parse_args(synthetic + argv)

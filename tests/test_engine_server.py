@@ -594,3 +594,29 @@ def test_pooling_endpoint_native(server):
         return True
 
     assert run(with_client(server, fn))
+
+
+def test_engine_yaml_config_file(tmp_path):
+    """Engine server accepts --config YAML (same shared helper as the
+    router; file values validated like CLI flags, CLI wins)."""
+    import pytest
+
+    from production_stack_tpu.engine.server import build_parser
+    from production_stack_tpu.yaml_args import parse_with_yaml_config
+
+    cfg = tmp_path / "engine.yaml"
+    cfg.write_text(
+        "model: tiny-llama\nmax-num-seqs: 16\nskip-warmup: true\n"
+        "quantization: int8\n"
+    )
+    args = parse_with_yaml_config(build_parser(),
+                                  ["--config", str(cfg)])
+    assert args.model == "tiny-llama" and args.max_num_seqs == 16
+    assert args.skip_warmup is True and args.quantization == "int8"
+    args = parse_with_yaml_config(
+        build_parser(), ["--config", str(cfg), "--max-num-seqs", "4"])
+    assert args.max_num_seqs == 4
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("quantization: int4\n")  # not a valid choice
+    with pytest.raises(SystemExit):
+        parse_with_yaml_config(build_parser(), ["--config", str(bad)])
