@@ -213,15 +213,16 @@ def _reap_stale_holders() -> None:
     PSTPU_BENCH_NO_REAP=1 disables (e.g. when sharing the machine with a
     live server on purpose)."""
     if os.environ.get("PSTPU_BENCH_NO_REAP") == "1":
-        return
+        return 0
     try:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from scripts.tpu_reaper import reap
 
-        reap(grace=5.0)
+        return reap(grace=5.0)
     except Exception as e:  # reaping is best-effort; the probe still runs
         print(f"tpu_reaper failed ({type(e).__name__}: {e}); probing anyway",
               file=sys.stderr, flush=True)
+        return 0
 
 
 def _probe_backend(timeout: float) -> tuple[bool, str]:
@@ -243,7 +244,13 @@ def _probe_backend(timeout: float) -> tuple[bool, str]:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
     except subprocess.TimeoutExpired:
-        return False, f"backend init exceeded {timeout:.0f}s (wedged chip?)"
+        # distinguishes the failure modes for the round artifact: with no
+        # local holder left to reap, a hang here is the axon client's
+        # /v1/claim retry loop getting no grant from the POOL side —
+        # infra-side wedge, not a leaked local process
+        return False, (f"backend init exceeded {timeout:.0f}s "
+                       "(no grant from the TPU pool: /v1/claim retry loop "
+                       "— pool-side wedge or lease held remotely)")
     if proc.returncode != 0:
         tail = "; ".join(proc.stdout.strip().splitlines()[-3:])
         return False, f"backend init failed rc={proc.returncode}: {tail}"
@@ -290,8 +297,10 @@ def main() -> None:
                   f"after {cooldown:.0f}s cooldown",
                   file=sys.stderr, flush=True)
             time.sleep(cooldown)
-        _reap_stale_holders()
+        reaped = _reap_stale_holders()
         ok, diag = _probe_backend(probe_timeout)
+        if not ok and reaped:
+            diag += f" [reaped {reaped} local holder(s) first]"
         if not ok:
             errors.append(diag)
             continue
