@@ -202,7 +202,7 @@ def run_bench() -> None:
     }))
 
 
-def _reap_stale_holders() -> None:
+def _reap_stale_holders() -> int:
     """Kill leftover TPU-holder processes before touching the backend.
 
     The single-chip tunnel admits ONE session: any process left over from
@@ -211,7 +211,7 @@ def _reap_stale_holders() -> None:
     empty BENCH_r02/r03 artifacts. scripts/tpu_reaper.py enumerates and
     kills exactly those; infrastructure is never touched.
     PSTPU_BENCH_NO_REAP=1 disables (e.g. when sharing the machine with a
-    live server on purpose)."""
+    live server on purpose). Returns how many holders were reaped."""
     if os.environ.get("PSTPU_BENCH_NO_REAP") == "1":
         return 0
     try:
@@ -244,13 +244,7 @@ def _probe_backend(timeout: float) -> tuple[bool, str]:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
     except subprocess.TimeoutExpired:
-        # distinguishes the failure modes for the round artifact: with no
-        # local holder left to reap, a hang here is the axon client's
-        # /v1/claim retry loop getting no grant from the POOL side —
-        # infra-side wedge, not a leaked local process
-        return False, (f"backend init exceeded {timeout:.0f}s "
-                       "(no grant from the TPU pool: /v1/claim retry loop "
-                       "— pool-side wedge or lease held remotely)")
+        return False, f"backend init exceeded {timeout:.0f}s"
     if proc.returncode != 0:
         tail = "; ".join(proc.stdout.strip().splitlines()[-3:])
         return False, f"backend init failed rc={proc.returncode}: {tail}"
@@ -299,8 +293,15 @@ def main() -> None:
             time.sleep(cooldown)
         reaped = _reap_stale_holders()
         ok, diag = _probe_backend(probe_timeout)
-        if not ok and reaped:
-            diag += f" [reaped {reaped} local holder(s) first]"
+        if not ok and "exceeded" in diag:
+            # attribute the hang for the round artifact: a just-reaped
+            # local holder may still hold its lease (local cause); with
+            # nothing to reap, the axon client's /v1/claim retry loop is
+            # getting no grant from the POOL side (infra cause)
+            diag += (f" (reaped {reaped} local holder(s); their lease may "
+                     "not have released yet)" if reaped else
+                     " (no local holder to reap: /v1/claim retry loop "
+                     "got no grant — pool-side wedge or remote lease)")
         if not ok:
             errors.append(diag)
             continue

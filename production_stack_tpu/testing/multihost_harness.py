@@ -61,12 +61,27 @@ def generate_greedy(engine) -> dict:
         engine.add_request(f"mh-{i}", prompt_token_ids=list(toks),
                            sampling=sp)
     out: dict = {}
-    steps = 0
-    while engine.has_unfinished() and steps < 64:
-        for o in engine.step():
-            out.setdefault(o.request_id, []).extend(o.new_token_ids)
-        steps += 1
-    assert not engine.has_unfinished(), "generation did not finish"
+
+    def drain(what: str) -> None:
+        steps = 0
+        while engine.has_unfinished() and steps < 64:
+            for o in engine.step():
+                out.setdefault(o.request_id, []).extend(o.new_token_ids)
+            steps += 1
+        assert not engine.has_unfinished(), f"{what} did not finish"
+
+    drain("generation")
+    # guided decoding exercises the control-plane's richest payload: the
+    # TokenFsm (numpy transition tables) crosses the authenticated wire
+    # via register_grammar, and per-step FSM states ride every decode plan
+    sp_g = SamplingParams(temperature=0.0, max_tokens=4,
+                          guided_regex="[ab]+")
+    engine.add_request("mh-guided", prompt_token_ids=[5, 3], sampling=sp_g)
+    drain("guided generation")
+    # KV block export smokes the replicated-output gate on the gather path
+    # (disaggregated-prefill's building block under multihost)
+    blocks = engine.export_kv([0, 1])
+    out["kv-export-shape"] = list(blocks.shape)
     return out
 
 
