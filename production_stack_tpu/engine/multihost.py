@@ -17,13 +17,16 @@ SURVEY.md §2.9 PP row). Data-plane collectives never touch this channel:
 they ride ICI/DCN inside XLA programs. The broadcast carries only step
 plans — a few KB per step.
 
-Security (r3 advisor): every frame is authenticated with
-HMAC-SHA256 over a shared secret (``PSTPU_CONTROL_SECRET``, injected by
+Security (r3+r4 advisors): the handshake exchanges fresh nonces (HELLO
+carries the follower's, the leader answers with its own) and every
+subsequent frame is authenticated with HMAC-SHA256 under the derived
+per-session key (shared secret ``PSTPU_CONTROL_SECRET``, injected by
 the chart from a Kubernetes Secret), payloads are deserialized by a
 restricted unpickler that admits only numpy arrays / scalars / builtin
-containers / ``TokenFsm``, and a per-connection monotonically increasing
-sequence number rejects replayed frames. Multi-host serving REFUSES to
-start without a secret.
+containers / ``TokenFsm``, a per-connection monotonically increasing
+sequence number rejects replayed frames within a session, and the
+session key rejects frames recorded from any OTHER session. Multi-host
+serving REFUSES to start without a secret.
 
 Device-resident chaining: the engine's chained decode path passes the
 previous dispatch's un-fetched ``next_tok`` device array as
@@ -53,7 +56,8 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("!Q")
 _MAC_BYTES = 32  # HMAC-SHA256
-_HELLO = b"pstpu-multihost-v1"
+_HELLO = b"pstpu-multihost-v2"
+_NONCE_BYTES = 16
 # frame-size ceiling: the length header arrives BEFORE authentication, so
 # an unauthenticated peer must not be able to make us buffer unbounded
 # data. Step plans are KBs; KV-import frames reach tens of MB — the cap
@@ -63,6 +67,11 @@ _MAX_FRAME = int(os.environ.get("PSTPU_CONTROL_MAX_FRAME",
 _MAX_HELLO = 1024  # pre-auth handshake frames are tiny
 # sentinel for a device-resident arg the follower reconstructs locally
 _CHAINED_NEXT_TOK = "__pstpu_chained_next_tok__"
+# third handshake frame, MAC'd under the DERIVED session key: proves the
+# follower computed it (knows the secret AND saw this session's nonces).
+# Without it, a recorded HELLO replayed at a fresh leader would be
+# counted as a live follower and receive step-plan payloads.
+_CONFIRM = b"pstpu-mh-confirm"
 
 # methods the leader mirrors: every runner entry point that issues device
 # work. Host-only accessors (num_blocks, tp, ...) are not mirrored.
@@ -123,6 +132,19 @@ class _RestrictedUnpickler(pickle.Unpickler):
         raise pickle.UnpicklingError(
             f"step-plan payload requested forbidden type {module}.{name}"
         )
+
+
+def _session_key(secret: bytes, follower_nonce: bytes,
+                 leader_nonce: bytes) -> bytes:
+    """Per-session frame-MAC key (r4 advisor: replay across sessions).
+
+    BOTH sides contribute a nonce: a leader-only nonce would still let an
+    on-path attacker replay a recorded leader stream (nonce frame
+    included) at a freshly started follower. Mixing the follower's fresh
+    nonce in means recorded frames can never authenticate to a new
+    session in either direction."""
+    return hmac.new(secret, b"pstpu-mh-skey|" + follower_nonce +
+                    leader_nonce, hashlib.sha256).digest()
 
 
 def _dumps(obj) -> bytes:
@@ -187,7 +209,8 @@ class LeaderBroadcaster:
                 else os.environ.get("PSTPU_CONTROL_BIND", "0.0.0.0"))
         self.server = socket.create_server((bind, port), backlog=16)
         self.server.settimeout(accept_timeout)
-        self.conns: list[socket.socket] = []
+        # (socket, per-session frame-MAC key) — see _session_key
+        self.conns: list[tuple[socket.socket, bytes]] = []
         self.lock = threading.Lock()
         self.seq = 0
 
@@ -196,7 +219,9 @@ class LeaderBroadcaster:
             conn, addr = self.server.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # authenticate before counting: the follower's first frame
-            # must be the HELLO under the shared secret
+            # must be HELLO || follower-nonce under the shared secret;
+            # we answer with our nonce and both sides derive the
+            # session key (recorded sessions can't replay — r4 advisor)
             try:
                 conn.settimeout(30.0)
                 hello = _recv_frame(conn, self.secret, max_len=_MAX_HELLO)
@@ -204,29 +229,48 @@ class LeaderBroadcaster:
                 logger.warning("rejecting connection from %s: %s", addr, e)
                 conn.close()
                 continue
-            if hello != _HELLO:
+            if (hello is None
+                    or len(hello) != len(_HELLO) + _NONCE_BYTES
+                    or not hmac.compare_digest(hello[:len(_HELLO)], _HELLO)):
                 logger.warning("rejecting connection from %s: bad hello",
                                addr)
+                conn.close()
+                continue
+            f_nonce = hello[len(_HELLO):]
+            l_nonce = os.urandom(_NONCE_BYTES)
+            key = _session_key(self.secret, f_nonce, l_nonce)
+            try:
+                _send_frame(conn, l_nonce, self.secret)
+                # the confirm frame verifies under the session key ONLY
+                # if the peer derived it — a replayed HELLO can't
+                confirm = _recv_frame(conn, key, max_len=_MAX_HELLO)
+            except (ConnectionError, OSError) as e:
+                logger.warning("handshake to %s failed: %s", addr, e)
+                conn.close()
+                continue
+            if confirm != _CONFIRM:
+                logger.warning("rejecting connection from %s: bad session "
+                               "confirm (replayed HELLO?)", addr)
                 conn.close()
                 continue
             conn.settimeout(None)
             logger.info("follower connected from %s (%d/%d)", addr,
                         len(self.conns) + 1, self.num_followers)
-            self.conns.append(conn)
+            self.conns.append((conn, key))
 
     def broadcast(self, method: str, args: tuple, kwargs: dict) -> None:
         with self.lock:
             self.seq += 1
             payload = _dumps((self.seq, method, args, kwargs))
-            for conn in self.conns:
-                _send_frame(conn, payload, self.secret)
+            for conn, key in self.conns:
+                _send_frame(conn, payload, key)
 
     def close(self) -> None:
         try:
             self.broadcast("_shutdown", (), {})
         except Exception:
             pass
-        for conn in self.conns:
+        for conn, _key in self.conns:
             try:
                 conn.close()
             except Exception:
@@ -289,7 +333,12 @@ class FollowerReplayer:
         self._next_tok = None
 
     def replay(self, method: str, args: tuple, kwargs: dict) -> None:
-        if kwargs.get("tokens_dev") == _CHAINED_NEXT_TOK:
+        # isinstance gate first: _wire_safe passes host np.ndarray
+        # tokens_dev through verbatim, and ndarray == str is an
+        # elementwise comparison (ambiguous-truth ValueError under
+        # numpy>=1.25) — r4 advisor
+        td = kwargs.get("tokens_dev")
+        if isinstance(td, str) and td == _CHAINED_NEXT_TOK:
             if self._next_tok is None:
                 raise RuntimeError(
                     "chained decode_multi replay without a cached "
@@ -322,13 +371,20 @@ def follower_loop(runner, leader_host: str, control_port: int,
                 )
             time.sleep(0.5)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    f_nonce = os.urandom(_NONCE_BYTES)
+    sock.settimeout(30.0)
+    _send_frame(sock, _HELLO + f_nonce, secret)
+    l_nonce = _recv_frame(sock, secret, max_len=_MAX_HELLO)
+    if l_nonce is None or len(l_nonce) != _NONCE_BYTES:
+        raise ConnectionError("leader handshake returned no session nonce")
+    key = _session_key(secret, f_nonce, l_nonce)
+    _send_frame(sock, _CONFIRM, key)  # prove we derived the session key
     sock.settimeout(None)
-    _send_frame(sock, _HELLO, secret)
     logger.info("connected to leader %s:%d", leader_host, control_port)
     replayer = FollowerReplayer(runner)
     last_seq = 0
     while True:
-        payload = _recv_frame(sock, secret)
+        payload = _recv_frame(sock, key)
         if payload is None:
             logger.info("leader closed the control channel; exiting")
             return

@@ -45,6 +45,11 @@ def parse_with_yaml_config(parser: argparse.ArgumentParser,
         if action is None:
             parser.error(f"--config {pre.config}: unknown option {key!r}")
         flag = action.option_strings[-1]
+        if value is None:
+            # an explicit null (`model:` with nothing after it) means
+            # "leave at default" — str(None) would inject the literal
+            # string "None" as the flag value (r4 advisor)
+            continue
         if action.const is True:  # store_true flags: presence = True
             if not isinstance(value, bool):
                 parser.error(f"--config {pre.config}: {key!r} expects a "

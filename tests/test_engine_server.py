@@ -620,3 +620,9 @@ def test_engine_yaml_config_file(tmp_path):
     bad.write_text("quantization: int4\n")  # not a valid choice
     with pytest.raises(SystemExit):
         parse_with_yaml_config(build_parser(), ["--config", str(bad)])
+    # an explicit null means "leave at default", not the string "None"
+    # (r4 advisor)
+    nul = tmp_path / "null.yaml"
+    nul.write_text("model:\nmax-num-seqs: 16\n")
+    args = parse_with_yaml_config(build_parser(), ["--config", str(nul)])
+    assert args.model != "None" and args.max_num_seqs == 16

@@ -426,6 +426,24 @@ def test_multihost_requires_control_secret():
         render_objects(HELM, vals)
 
 
+def test_multihost_refuses_pipeline_parallel_at_render_time():
+    """engine/server.py main() hard-refuses multihost + PP>1; the chart
+    must fail the RENDER, not ship a crash-looping StatefulSet (r4
+    advisor)."""
+    import copy
+
+    import pytest
+
+    vals = copy.deepcopy(MULTIHOST_VALUES)
+    spec = vals["servingEngineSpec"]["modelSpec"][0]
+    spec["engineConfig"]["pipelineParallelSize"] = 2
+    with pytest.raises(Exception, match="pipelineParallelSize"):
+        render_objects(HELM, vals)
+    # PP=1 stays renderable (explicit 1 is the harmless spelling)
+    spec["engineConfig"]["pipelineParallelSize"] = 1
+    assert by_kind(render_objects(HELM, vals), "StatefulSet")
+
+
 def test_multihost_spec_gets_no_keda_scaledobject():
     """A fixed-size process group must never be resized by KEDA — and the
     Deployment the ScaledObject would target doesn't exist."""

@@ -122,10 +122,13 @@ def test_leader_rejects_wrong_secret_and_accepts_right_one():
     import threading
 
     from production_stack_tpu.engine.multihost import (
+        _CONFIRM,
         _HELLO,
+        _NONCE_BYTES,
         LeaderBroadcaster,
         _recv_frame,
         _send_frame,
+        _session_key,
     )
 
     port = _free_port()
@@ -136,21 +139,128 @@ def test_leader_rejects_wrong_secret_and_accepts_right_one():
     try:
         # wrong secret: frame fails HMAC, connection dropped
         bad = socket.create_connection(("127.0.0.1", port), timeout=5)
-        _send_frame(bad, _HELLO, b"wrong")
+        _send_frame(bad, _HELLO + b"\x00" * _NONCE_BYTES, b"wrong")
         assert bad.recv(1) == b""  # leader closed on us
         bad.close()
-        # right secret: accepted, receives an authenticated broadcast
+        # right secret: accepted, nonce exchanged, receives a broadcast
+        # authenticated under the derived SESSION key (not the base
+        # secret — r4 advisor: cross-session replay)
         good = socket.create_connection(("127.0.0.1", port), timeout=5)
-        _send_frame(good, _HELLO, b"right")
+        f_nonce = b"\x01" * _NONCE_BYTES
+        _send_frame(good, _HELLO + f_nonce, b"right")
+        good.settimeout(5)
+        l_nonce = _recv_frame(good, b"right")
+        assert l_nonce is not None and len(l_nonce) == _NONCE_BYTES
+        key = _session_key(b"right", f_nonce, l_nonce)
+        _send_frame(good, _CONFIRM, key)
         t.join(timeout=10)
         assert not t.is_alive()
         bcast.broadcast("drop_kv", (), {})
-        good.settimeout(5)
-        payload = _recv_frame(good, b"right")
+        payload = _recv_frame(good, key)
         assert payload is not None
+        # the same frame does NOT authenticate under the base secret or
+        # under a different session's key — recorded streams are dead
         good.close()
     finally:
         bcast.close()
+
+
+def test_broadcast_frames_do_not_authenticate_under_base_secret():
+    """Cross-session replay pin (r4 advisor): step-plan frames are MAC'd
+    with the per-session key, so a stream recorded in one session fails
+    HMAC at a follower whose handshake produced a different key."""
+    import threading
+
+    from production_stack_tpu.engine.multihost import (
+        _CONFIRM,
+        _HELLO,
+        _NONCE_BYTES,
+        LeaderBroadcaster,
+        _recv_frame,
+        _send_frame,
+        _session_key,
+    )
+
+    port = _free_port()
+    bcast = LeaderBroadcaster(port, num_followers=1, secret=b"s",
+                              bind_host="127.0.0.1", accept_timeout=10.0)
+    t = threading.Thread(target=bcast.wait_for_followers, daemon=True)
+    t.start()
+    conn = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        f_nonce = b"\x02" * _NONCE_BYTES
+        _send_frame(conn, _HELLO + f_nonce, b"s")
+        conn.settimeout(5)
+        l_nonce = _recv_frame(conn, b"s")
+        key = _session_key(b"s", f_nonce, l_nonce)
+        _send_frame(conn, _CONFIRM, key)
+        t.join(timeout=10)
+        bcast.broadcast("drop_kv", (), {})
+        with pytest.raises(ConnectionError, match="HMAC"):
+            _recv_frame(conn, b"s")  # base secret must NOT verify
+        # a fresh session derives a different key for the same secret
+        other = _session_key(b"s", b"\x03" * _NONCE_BYTES, l_nonce)
+        assert other != key
+    finally:
+        conn.close()
+        bcast.close()
+
+
+def test_leader_rejects_replayed_hello_without_session_confirm():
+    """A recorded HELLO replayed at a fresh leader must NOT be counted
+    as a follower: the attacker can't produce the session-key confirm
+    frame (needs the secret to derive the key)."""
+    import threading
+
+    from production_stack_tpu.engine.multihost import (
+        _HELLO,
+        _NONCE_BYTES,
+        LeaderBroadcaster,
+        _recv_frame,
+        _send_frame,
+    )
+
+    port = _free_port()
+    bcast = LeaderBroadcaster(port, num_followers=1, secret=b"s",
+                              bind_host="127.0.0.1", accept_timeout=10.0)
+    t = threading.Thread(target=bcast.wait_for_followers, daemon=True)
+    t.start()
+    try:
+        replayer = socket.create_connection(("127.0.0.1", port), timeout=5)
+        # the recorded frame authenticates (attacker has the bytes, not
+        # the secret) ...
+        _send_frame(replayer, _HELLO + b"\x07" * _NONCE_BYTES, b"s")
+        replayer.settimeout(5)
+        assert _recv_frame(replayer, b"s") is not None  # leader's nonce
+        # ... but the attacker cannot confirm: wrong-key frame -> dropped
+        _send_frame(replayer, b"garbage-confirm", b"not-the-secret")
+        assert replayer.recv(1) == b""  # leader closed on us
+        replayer.close()
+        assert t.is_alive()  # never counted toward num_followers
+    finally:
+        bcast.close()
+        t.join(timeout=1)
+
+
+def test_follower_replay_handles_ndarray_tokens_dev():
+    """_wire_safe passes host np.ndarray tokens_dev through the wire;
+    the sentinel check must not trip numpy's elementwise == (ambiguous
+    truth ValueError — r4 advisor)."""
+    import numpy as np
+
+    from production_stack_tpu.engine.multihost import FollowerReplayer
+
+    calls = {}
+
+    class Runner:
+        def decode_multi(self, *a, **kw):
+            calls.update(kw)
+            return ("sampled", "next")
+
+    rep = FollowerReplayer(Runner())
+    arr = np.arange(4, dtype=np.int32)
+    rep.replay("decode_multi", (), {"tokens_dev": arr, "fetch": True})
+    assert calls["tokens_dev"] is arr  # passed through, no ValueError
 
 
 def test_restricted_unpickler_blocks_forbidden_types():
