@@ -2094,6 +2094,24 @@ def main(argv=None) -> None:
 
     signal.signal(signal.SIGTERM, _early_term)
 
+    if config.model.architecture == "whisper":
+        # encoder-decoder transcription engine: its own runner + server
+        # (whisper_server.py) — the paged text engine never starts.
+        # B=1 per call saturates the MXU on the fixed 30 s window; shard
+        # bigger models with --tensor-parallel-size, scale out replicas.
+        if dist.enabled:
+            raise SystemExit(
+                "whisper serving is single-controller; scale with "
+                "--tensor-parallel-size within one host or add replicas"
+            )
+        from production_stack_tpu.engine.whisper_server import (
+            run_whisper_server,
+        )
+
+        run_whisper_server(config, args.host, args.port)
+        _release_jax_backend()
+        return
+
     if dist.enabled and not dist.is_leader:
         _follower_main(config, dist, args.host, args.port)
         return

@@ -190,6 +190,98 @@ def _convert(name_rule: str, w: np.ndarray, cfg: ModelConfig) -> np.ndarray:
     raise ValueError(name_rule)
 
 
+def _whisper_block_map(prefix: str, i: int, cross: bool) -> dict:
+    """HF Whisper layer tensor names → (ours, rule) for one block.
+    ``prefix`` is ``model.encoder.layers`` / ``model.decoder.layers``."""
+    b = f"{prefix}.{i}"
+    m = {
+        f"{b}.self_attn_layer_norm.weight": ("attn_norm_w", "copy"),
+        f"{b}.self_attn_layer_norm.bias": ("attn_norm_b", "copy"),
+        f"{b}.self_attn.q_proj.weight": ("wq", "proj_q"),
+        f"{b}.self_attn.q_proj.bias": ("bq", "bias_q"),
+        f"{b}.self_attn.k_proj.weight": ("wk", "proj_q"),  # H == KH
+        f"{b}.self_attn.v_proj.weight": ("wv", "proj_q"),
+        f"{b}.self_attn.v_proj.bias": ("bv", "bias_q"),
+        f"{b}.self_attn.out_proj.weight": ("wo", "proj_o"),
+        f"{b}.self_attn.out_proj.bias": ("bo", "copy"),
+        f"{b}.final_layer_norm.weight": ("mlp_norm_w", "copy"),
+        f"{b}.final_layer_norm.bias": ("mlp_norm_b", "copy"),
+        f"{b}.fc1.weight": ("fc1", "t"),
+        f"{b}.fc1.bias": ("fc1_b", "copy"),
+        f"{b}.fc2.weight": ("fc2", "t"),
+        f"{b}.fc2.bias": ("fc2_b", "copy"),
+    }
+    if cross:
+        m.update({
+            f"{b}.encoder_attn_layer_norm.weight": ("cross_norm_w", "copy"),
+            f"{b}.encoder_attn_layer_norm.bias": ("cross_norm_b", "copy"),
+            f"{b}.encoder_attn.q_proj.weight": ("cwq", "proj_q"),
+            f"{b}.encoder_attn.q_proj.bias": ("cbq", "bias_q"),
+            f"{b}.encoder_attn.k_proj.weight": ("cwk", "proj_q"),
+            f"{b}.encoder_attn.v_proj.weight": ("cwv", "proj_q"),
+            f"{b}.encoder_attn.v_proj.bias": ("cbv", "bias_q"),
+            f"{b}.encoder_attn.out_proj.weight": ("cwo", "proj_o"),
+            f"{b}.encoder_attn.out_proj.bias": ("cbo", "copy"),
+        })
+    return m
+
+
+def _load_whisper_safetensors(cfg: ModelConfig, mesh: Mesh,
+                              rules: ShardingRules, get, specs) -> dict:
+    """WhisperForConditionalGeneration safetensors → our pytree.
+    The encoder's sinusoidal embed_positions and the tied proj_out are
+    not loaded (computed / tied in models/whisper.py)."""
+    dt = cfg.jax_dtype
+
+    def put(arr: np.ndarray, axes) -> jax.Array:
+        return jax.device_put(
+            jnp.asarray(arr, dtype=dt), logical_to_sharding(axes, mesh, rules)
+        )
+
+    def stack_layers(prefix: str, n: int, cross: bool, block_specs) -> dict:
+        per: dict[str, list] = {}
+        for i in range(n):
+            for hf_name, (ours, rule) in _whisper_block_map(
+                    prefix, i, cross).items():
+                per.setdefault(ours, []).append(
+                    _convert(rule, get(hf_name), cfg))
+        return {k: put(np.stack(v), block_specs[k]) for k, v in per.items()}
+
+    enc_s, dec_s = specs["enc"], specs["dec"]
+    return {
+        "enc": {
+            # HF conv weight is (out, in, k); ours (k, in, out)
+            "conv1_w": put(get("model.encoder.conv1.weight")
+                           .transpose(2, 1, 0), enc_s["conv1_w"]),
+            "conv1_b": put(get("model.encoder.conv1.bias"),
+                           enc_s["conv1_b"]),
+            "conv2_w": put(get("model.encoder.conv2.weight")
+                           .transpose(2, 1, 0), enc_s["conv2_w"]),
+            "conv2_b": put(get("model.encoder.conv2.bias"),
+                           enc_s["conv2_b"]),
+            "layers": stack_layers("model.encoder.layers",
+                                   cfg.encoder_layers, False,
+                                   enc_s["layers"]),
+            "final_norm_w": put(get("model.encoder.layer_norm.weight"),
+                                enc_s["final_norm_w"]),
+            "final_norm_b": put(get("model.encoder.layer_norm.bias"),
+                                enc_s["final_norm_b"]),
+        },
+        "dec": {
+            "embed": put(get("model.decoder.embed_tokens.weight"),
+                         dec_s["embed"]),
+            "pos": put(get("model.decoder.embed_positions.weight"),
+                       dec_s["pos"]),
+            "layers": stack_layers("model.decoder.layers", cfg.num_layers,
+                                   True, dec_s["layers"]),
+            "final_norm_w": put(get("model.decoder.layer_norm.weight"),
+                                dec_s["final_norm_w"]),
+            "final_norm_b": put(get("model.decoder.layer_norm.bias"),
+                                dec_s["final_norm_b"]),
+        },
+    }
+
+
 def load_safetensors(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules) -> dict:
     from safetensors import safe_open
 
@@ -207,6 +299,13 @@ def load_safetensors(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules) -> dict
 
     def get(name: str) -> np.ndarray:
         return handles[index[name]].get_tensor(name)
+
+    if cfg.architecture == "whisper":
+        try:
+            return _load_whisper_safetensors(cfg, mesh, rules, get, specs)
+        finally:
+            for h in handles:
+                del h
 
     def put(arr: np.ndarray, axes) -> jax.Array:
         return jax.device_put(

@@ -1,0 +1,242 @@
+"""HTTP server for the Whisper transcription engine.
+
+Serves the OpenAI audio surface natively — the reference gets this
+modality by deploying vLLM Whisper pods behind its router (reference:
+tutorials/23-whisper-api-transcription.md; the router proxies
+``/v1/audio/transcriptions`` and ``/v1/audio/translations``). Here the
+same engine binary serves it when started with a whisper-architecture
+model: ``python -m production_stack_tpu.engine.server --model
+whisper-small-class``.
+
+Endpoints: ``/v1/audio/transcriptions`` and ``/v1/audio/translations``
+(multipart form: file, model, language, prompt, response_format,
+temperature, stream), plus the router contract surface (``/health``,
+``/version``, ``/v1/models`` advertising the ``audio.*`` capabilities,
+``/metrics``). Text-generation endpoints are not registered — the
+router's capability filter 501s them before they reach this engine.
+
+Response formats match the reference's supported set: ``json``,
+``text``, ``verbose_json``, ``srt``, ``vtt``. Timestamps are not
+predicted (the decoder runs in notimestamps mode), so srt/vtt/
+verbose_json carry ONE segment spanning the clip — documented in
+tutorials/33-audio-transcription.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Histogram,
+    generate_latest,
+)
+
+from production_stack_tpu import __version__
+from production_stack_tpu.engine.audio import AudioError, wav_to_features
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.whisper_runner import WhisperRunner
+
+WHISPER_CAPABILITIES = ("audio.transcriptions", "audio.translations")
+
+
+def _fmt_timestamp(seconds: float, sep: str) -> str:
+    h = int(seconds // 3600)
+    m = int(seconds % 3600 // 60)
+    s = seconds % 60
+    return f"{h:02d}:{m:02d}:{int(s):02d}{sep}{int(s % 1 * 1000):03d}"
+
+
+class WhisperServer:
+    def __init__(self, config: EngineConfig,
+                 runner: WhisperRunner | None = None):
+        self.config = config
+        self.model_name = config.model.name
+        self.runner = runner or WhisperRunner(config)
+        self.start_time = time.time()
+        self.registry = CollectorRegistry()
+        self.requests = Counter(
+            "pstpu_transcription_requests", "transcription requests",
+            ["endpoint", "status"], registry=self.registry)
+        self.audio_seconds = Counter(
+            "pstpu_transcription_audio_seconds",
+            "seconds of audio transcribed", registry=self.registry)
+        self.latency = Histogram(
+            "pstpu_transcription_latency_seconds",
+            "end-to-end transcription latency", registry=self.registry)
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_post("/v1/audio/transcriptions", self.transcriptions)
+        app.router.add_post("/v1/audio/translations", self.translations)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/version", self.version)
+        app.router.add_get("/metrics", self.prometheus)
+        return app
+
+    # -- router-contract surface -------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+    async def version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": [{
+            "id": self.model_name,
+            "object": "model",
+            "created": int(self.start_time),
+            "owned_by": "production-stack-tpu",
+            "root": self.model_name,
+            "parent": None,
+            "max_model_len": self.config.model.max_model_len,
+            "capabilities": list(WHISPER_CAPABILITIES),
+        }]})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=generate_latest(self.registry),
+                            content_type="text/plain")
+
+    # -- audio endpoints ----------------------------------------------------
+
+    async def transcriptions(self, request: web.Request) -> web.Response:
+        return await self._serve_audio(request, task="transcribe")
+
+    async def translations(self, request: web.Request) -> web.Response:
+        return await self._serve_audio(request, task="translate")
+
+    async def _serve_audio(self, request: web.Request,
+                           task: str) -> web.Response:
+        endpoint = f"audio.{task}"
+        t0 = time.monotonic()
+        try:
+            form = await request.post()
+            upload = form.get("file")
+            if upload is None or not hasattr(upload, "file"):
+                raise AudioError("missing 'file' form field")
+            data = upload.file.read()
+            language = form.get("language") or None
+            prompt = form.get("prompt") or None
+            response_format = form.get("response_format") or "json"
+            if response_format not in ("json", "text", "verbose_json",
+                                       "srt", "vtt"):
+                raise AudioError(
+                    f"unsupported response_format {response_format!r}")
+            try:
+                temperature = float(form.get("temperature") or 0.0)
+            except ValueError:
+                raise AudioError("temperature must be a float") from None
+            stream = str(form.get("stream") or "").lower() in ("true", "1")
+            cfg = self.config.model
+            features, duration = wav_to_features(
+                data, cfg.num_mel_bins, self.runner.chunk_frames)
+            # bad language / oversized prompt must 400 HERE — once the
+            # SSE stream is prepared a late AudioError can only kill the
+            # connection (r5 review)
+            self.runner.validate_request(language, task, prompt)
+        except AudioError as e:
+            self.requests.labels(endpoint, "400").inc()
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}}, status=400)
+
+        loop = asyncio.get_running_loop()
+        seed = uuid.uuid4().int & 0x7FFFFFFF
+        info: dict = {}  # receives the used/detected language
+        kw = dict(language=language, task=task, prompt=prompt,
+                  temperature=temperature, seed=seed, info=info)
+
+        if stream:
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            })
+            await resp.prepare(request)
+            gen = self.runner.transcribe_stream(features, **kw)
+
+            def next_piece():
+                try:
+                    return next(gen)
+                except StopIteration:
+                    return None
+
+            # emit deltas of the CUMULATIVE decode, holding back a
+            # trailing replacement char: a multi-byte character whose
+            # tokens straddle a chunk boundary would otherwise stream as
+            # U+FFFD garbage the non-streaming path doesn't have
+            all_toks: list[int] = []
+            emitted = 0
+            while True:
+                piece = await loop.run_in_executor(None, next_piece)
+                if piece is None:
+                    break
+                all_toks.extend(piece)
+                full = self.runner.tokenizer.decode(all_toks)
+                safe = full.rstrip("�")
+                if len(safe) > emitted:
+                    await resp.write(
+                        b"data: "
+                        + json.dumps({"text": safe[emitted:]}).encode()
+                        + b"\n\n")
+                    emitted = len(safe)
+            full = self.runner.tokenizer.decode(all_toks)
+            if len(full) > emitted:  # flush any genuinely-unmappable tail
+                await resp.write(
+                    b"data: " + json.dumps({"text": full[emitted:]}).encode()
+                    + b"\n\n")
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            self.requests.labels(endpoint, "200").inc()
+            self.audio_seconds.inc(duration)
+            self.latency.observe(time.monotonic() - t0)
+            return resp
+
+        try:
+            tokens = await loop.run_in_executor(
+                None, lambda: self.runner.transcribe(features, **kw))
+        except AudioError as e:
+            self.requests.labels(endpoint, "400").inc()
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}}, status=400)
+        text = self.runner.tokenizer.decode(tokens)
+        self.requests.labels(endpoint, "200").inc()
+        self.audio_seconds.inc(duration)
+        self.latency.observe(time.monotonic() - t0)
+
+        if response_format == "text":
+            return web.Response(text=text, content_type="text/plain")
+        if response_format == "srt":
+            body = (f"1\n{_fmt_timestamp(0.0, ',')} --> "
+                    f"{_fmt_timestamp(duration, ',')}\n{text}\n")
+            return web.Response(text=body, content_type="text/plain")
+        if response_format == "vtt":
+            body = (f"WEBVTT\n\n{_fmt_timestamp(0.0, '.')} --> "
+                    f"{_fmt_timestamp(duration, '.')}\n{text}\n")
+            return web.Response(text=body, content_type="text/plain")
+        if response_format == "verbose_json":
+            return web.json_response({
+                "task": ("transcribe" if task == "transcribe"
+                         else "translate"),
+                "language": info.get("language", language),
+                "duration": duration,
+                "text": text,
+                "segments": [{
+                    "id": 0, "seek": 0, "start": 0.0, "end": duration,
+                    "text": text, "tokens": tokens,
+                    "temperature": temperature,
+                }],
+            })
+        return web.json_response({"text": text})
+
+
+def run_whisper_server(config: EngineConfig, host: str, port: int) -> None:
+    server = WhisperServer(config)
+    web.run_app(server.build_app(), host=host, port=port, access_log=None)

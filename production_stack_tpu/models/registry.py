@@ -12,7 +12,7 @@ from __future__ import annotations
 from types import ModuleType
 
 from production_stack_tpu.engine.config import ModelConfig
-from production_stack_tpu.models import llama
+from production_stack_tpu.models import llama, whisper
 
 _REGISTRY: dict[str, ModuleType] = {
     "llama": llama,
@@ -24,6 +24,10 @@ _REGISTRY: dict[str, ModuleType] = {
     # Phi-3 is the Llama stack too; only its HF checkpoint layout differs
     # (fused qkv_proj / gate_up_proj, split at load in engine/weights.py)
     "phi3": llama,
+    # encoder-decoder audio transcription: exposes its own forward
+    # surface (encode/cross_kv/decode_tokens) instead of the decoder-only
+    # protocol; shares param_specs/init_params so weights.py works
+    "whisper": whisper,
 }
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import time
 import uuid
 from typing import AsyncIterator, Optional
@@ -42,6 +43,37 @@ HOP_BY_HOP = {
 
 def sanitize_headers(headers) -> dict[str, str]:
     return {k: v for k, v in headers.items() if k.lower() not in HOP_BY_HOP}
+
+
+def multipart_fields(raw: bytes, content_type: str,
+                     names: tuple[str, ...]) -> dict[str, str]:
+    """Extract small text fields from a multipart/form-data payload
+    WITHOUT consuming an aiohttp stream: audio uploads must be relayed
+    byte-identical to the backend (reference: request.py:1119-1143 there
+    re-encodes the form; we forward the original bytes), but the router
+    still needs `model` (routing) and `stream` (relay mode) up front."""
+    marker = "boundary="
+    i = content_type.find(marker)
+    if i < 0:
+        return {}
+    boundary = content_type[i + len(marker):].split(";")[0].strip().strip('"')
+    out: dict[str, str] = {}
+    for part in raw.split(b"--" + boundary.encode()):
+        head, sep, value = part.partition(b"\r\n\r\n")
+        if not sep:
+            continue
+        for name in names:
+            # `; name="x"` anchored on a delimiter: a file part whose
+            # filename="model" must NOT match name="model" (r5 review)
+            if re.search(rb'[;\s]name="%s"' % re.escape(name.encode()),
+                         head):
+                # the part body ends with exactly one CRLF before the
+                # next boundary; trailing dashes are legitimate value
+                # characters (model names can end with "-")
+                if value.endswith(b"\r\n"):
+                    value = value[:-2]
+                out[name] = value.decode("utf-8", errors="replace")
+    return out
 
 
 # endpoint path → capability family an engine must advertise to receive it
@@ -119,19 +151,32 @@ class RequestService:
     ) -> web.StreamResponse:
         t_start = time.time()
         request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
-        try:
-            body = await request.json()
-        except Exception:
-            return web.json_response(
-                {"error": {"message": "invalid JSON body"}}, status=400
-            )
+        raw_body: Optional[bytes] = None
+        if request.content_type.startswith("multipart/"):
+            # audio uploads: relay the original bytes; pull only the
+            # routing fields out of the form. Callback/rewriter hooks are
+            # JSON-body contracts and don't apply to multipart.
+            raw_body = await request.read()
+            fields = multipart_fields(
+                raw_body, request.headers.get("Content-Type", ""),
+                ("model", "stream"))
+            body = {"model": fields.get("model", ""),
+                    "stream": fields.get("stream", "").lower()
+                    in ("true", "1")}
+        else:
+            try:
+                body = await request.json()
+            except Exception:
+                return web.json_response(
+                    {"error": {"message": "invalid JSON body"}}, status=400
+                )
 
-        if self.callbacks is not None:
-            short = self.callbacks.pre_request(request, body)
-            if short is not None:
-                return web.json_response(short)
-        if self.rewriter is not None:
-            body = self.rewriter.rewrite(endpoint_path, body)
+            if self.callbacks is not None:
+                short = self.callbacks.pre_request(request, body)
+                if short is not None:
+                    return web.json_response(short)
+            if self.rewriter is not None:
+                body = self.rewriter.rewrite(endpoint_path, body)
 
         model = body.get("model", "")
         resolved = self.resolve_model(model)
@@ -141,6 +186,19 @@ class RequestService:
         if self.external_providers is not None and self.external_providers.handles(
             resolved
         ):
+            if raw_body is not None:
+                # the provider proxy re-serialises `body` as JSON — a
+                # multipart upload would be silently dropped (r5 review)
+                return web.json_response(
+                    {"error": {
+                        "message": f"model {resolved!r} is served by an "
+                                   "external provider, which does not "
+                                   "support multipart audio uploads",
+                        "type": "NotImplementedError",
+                        "code": "unsupported_endpoint",
+                    }},
+                    status=501,
+                )
             return await self.external_providers.proxy(
                 request, endpoint_path, body, resolved
             )
@@ -176,7 +234,8 @@ class RequestService:
         endpoints = capable
 
         router = get_routing_logic()
-        if isinstance(router, DisaggregatedPrefillOrchestratedRouter):
+        if (isinstance(router, DisaggregatedPrefillOrchestratedRouter)
+                and raw_body is None):  # audio has no prefill/decode split
             return await self._orchestrated_disagg(
                 request, endpoint_path, body, endpoints, router, request_id, t_start
             )
@@ -197,7 +256,8 @@ class RequestService:
                         url, attempt + 1)
             try:
                 return await self._proxy_and_stream(
-                    request, endpoint_path, body, url, resolved, request_id, t_start
+                    request, endpoint_path, body, url, resolved, request_id,
+                    t_start, raw_body=raw_body,
                 )
             except BackendError as e:
                 last_error = str(e)
@@ -214,17 +274,19 @@ class RequestService:
         )
 
     async def _proxy_and_stream(
-        self, request, endpoint_path, body, url, model, request_id, t_start
+        self, request, endpoint_path, body, url, model, request_id, t_start,
+        raw_body: Optional[bytes] = None,
     ) -> web.StreamResponse:
         """One backend attempt. Raises BackendError before any byte has been
         relayed (so failover is safe); after first byte, errors terminate the
-        stream."""
+        stream. ``raw_body`` (multipart audio) is relayed byte-identical
+        instead of re-serialising ``body``."""
         from production_stack_tpu.router.experimental import tracing
 
         monitor = get_request_stats_monitor()
         stream = bool(body.get("stream", False))
         strip_usage = False
-        if stream:
+        if stream and raw_body is None:
             # ask the engine for the final usage chunk so streamed requests
             # feed token accounting; if the client didn't request it, the
             # chunk is stripped from the relayed stream (OpenAI parity)
@@ -251,17 +313,24 @@ class RequestService:
             return await self._attempt(
                 request, endpoint_path, body, url, model, request_id, t_start,
                 monitor, stream, headers, span_cm, strip_usage=strip_usage,
+                raw_body=raw_body,
             )
         finally:
             span_cm.__exit__(None, None, None)
 
     async def _attempt(self, request, endpoint_path, body, url, model,
                        request_id, t_start, monitor, stream, headers,
-                       span_cm, strip_usage=False) -> web.StreamResponse:
+                       span_cm, strip_usage=False,
+                       raw_body: Optional[bytes] = None) -> web.StreamResponse:
         try:
-            backend = await self.session.post(
-                f"{url}{endpoint_path}", json=body, headers=headers
-            )
+            if raw_body is not None:  # multipart: original bytes + boundary
+                backend = await self.session.post(
+                    f"{url}{endpoint_path}", data=raw_body, headers=headers
+                )
+            else:
+                backend = await self.session.post(
+                    f"{url}{endpoint_path}", json=body, headers=headers
+                )
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             monitor.on_request_complete(url, request_id, time.time())
             raise BackendError("connect", f"{type(e).__name__}: {e}") from e

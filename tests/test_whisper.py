@@ -1,0 +1,459 @@
+"""Whisper audio serving path: frontend, model, runner, HTTP surface, and
+router integration (VERDICT r4 #4 — the reference gets this modality via
+vLLM Whisper pods, tutorials/23-whisper-api-transcription.md there; this
+stack serves it natively)."""
+
+import asyncio
+import io
+import json
+import wave
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine import audio as A
+from production_stack_tpu.engine.config import EngineConfig, ModelConfig
+
+
+def make_wav(seconds=0.5, rate=8000, freq=440.0, channels=1,
+             width=2) -> bytes:
+    t = np.arange(int(rate * seconds)) / rate
+    x = np.sin(2 * np.pi * freq * t)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(width)
+        w.setframerate(rate)
+        if width == 2:
+            frames = (x * 20000).astype(np.int16)
+        elif width == 4:
+            frames = x.astype(np.float32)
+        else:
+            frames = ((x * 100) + 128).astype(np.uint8)
+        if channels > 1:
+            frames = np.repeat(frames[:, None], channels, axis=1)
+        w.writeframes(frames.tobytes())
+    return buf.getvalue()
+
+
+# --- audio frontend ---------------------------------------------------------
+
+def test_decode_wav_formats():
+    for width in (1, 2, 4):
+        x, rate = A.decode_wav(make_wav(width=width))
+        assert rate == 8000 and x.dtype == np.float32
+        assert 0.001 < np.abs(x).max() <= 1.0
+    stereo, _ = A.decode_wav(make_wav(channels=2))
+    mono, _ = A.decode_wav(make_wav(channels=1))
+    assert stereo.shape == mono.shape  # averaged to mono
+
+
+def test_decode_wav_rejects_garbage():
+    with pytest.raises(A.AudioError, match="WAV"):
+        A.decode_wav(b"ID3\x04not audio at all")
+    with pytest.raises(A.AudioError, match="WAV"):
+        A.decode_wav(b"")
+
+
+def test_resample_length_and_identity():
+    x = np.sin(np.linspace(0, 100, 8000)).astype(np.float32)
+    y = A.resample(x, 8000, 16000)
+    assert abs(y.size - 16000) <= 1
+    assert A.resample(x, 16000, 16000) is x
+    # a pure tone survives resampling with small error
+    t8 = np.arange(8000) / 8000.0
+    tone = np.sin(2 * np.pi * 100 * t8).astype(np.float32)
+    up = A.resample(tone, 8000, 16000)
+    t16 = np.arange(up.size) / 16000.0
+    ref = np.sin(2 * np.pi * 100 * t16).astype(np.float32)
+    assert np.abs(up[100:-100] - ref[100:-100]).max() < 0.01
+
+
+def test_mel_filterbank_properties():
+    fb = A.mel_filterbank(80)
+    assert fb.shape == (80, A.N_FFT // 2 + 1)
+    assert (fb >= 0).all()
+    # every filter has support; centers increase monotonically
+    assert (fb.sum(axis=1) > 0).all()
+    centers = fb.argmax(axis=1)
+    assert (np.diff(centers) >= 0).all()
+
+
+def test_log_mel_shape_and_scaling():
+    wav = make_wav(seconds=2.0)
+    feats, dur = A.wav_to_features(wav, 20, 100)  # 1 s window
+    assert feats.shape == (20, 100)
+    assert dur == pytest.approx(2.0, abs=0.01)
+    # whisper scaling bounds: (log10 range clamped to max-8 then /4 +1)
+    assert feats.max() <= 2.0 and feats.min() >= feats.max() - 2.0
+
+
+# --- config -----------------------------------------------------------------
+
+def test_whisper_from_hf_config():
+    hf = {
+        "architectures": ["WhisperForConditionalGeneration"],
+        "vocab_size": 51865, "d_model": 768,
+        "decoder_layers": 12, "encoder_layers": 12,
+        "decoder_attention_heads": 12, "encoder_attention_heads": 12,
+        "decoder_ffn_dim": 3072, "num_mel_bins": 80,
+        "max_source_positions": 1500, "max_target_positions": 448,
+        "decoder_start_token_id": 50258, "eos_token_id": 50257,
+    }
+    cfg = ModelConfig.from_hf_config(hf, name="whisper-small")
+    assert cfg.architecture == "whisper"
+    assert cfg.n_langs == 99
+    assert cfg.transcribe_id == 50359 and cfg.translate_id == 50358
+    assert cfg.sot_prev_id == 50361 and cfg.notimestamps_id == 50363
+    # matches the hand-written preset
+    preset = ModelConfig.from_pretrained("whisper-small-class")
+    for f in ("sot_id", "eot_id", "lang_base_id", "n_langs",
+              "transcribe_id", "translate_id", "sot_prev_id",
+              "notimestamps_id", "head_dim"):
+        assert getattr(cfg, f) == getattr(preset, f), f
+    # large-v3 layout (100 languages)
+    hf["vocab_size"] = 51866
+    hf["num_mel_bins"] = 128
+    v3 = ModelConfig.from_hf_config(hf)
+    p3 = ModelConfig.from_pretrained("whisper-large-v3-class")
+    for f in ("n_langs", "transcribe_id", "translate_id", "sot_prev_id",
+              "notimestamps_id"):
+        assert getattr(v3, f) == getattr(p3, f), f
+
+
+def test_whisper_refuses_english_only_vocab():
+    hf = {
+        "architectures": ["WhisperForConditionalGeneration"],
+        "vocab_size": 51864, "d_model": 768, "decoder_layers": 12,
+        "encoder_layers": 12, "decoder_attention_heads": 12,
+    }
+    with pytest.raises(ValueError, match="multilingual"):
+        ModelConfig.from_hf_config(hf)
+
+
+# --- model ------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from production_stack_tpu.models import whisper as W
+
+    cfg = ModelConfig.from_pretrained("tiny-whisper")
+    params = W.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_whisper_incremental_matches_dense(tiny):
+    import jax.numpy as jnp
+
+    from production_stack_tpu.models import whisper as W
+
+    cfg, params = tiny
+    mel = jnp.asarray(np.random.default_rng(0).normal(
+        size=(1, cfg.num_mel_bins, cfg.n_audio_ctx * 2)).astype(np.float32))
+    enc = W.encode(cfg, params, mel)
+    assert enc.shape == (1, cfg.n_audio_ctx, cfg.hidden_size)
+    ck, cv = W.cross_kv(cfg, params, enc)
+    kv = W.init_self_kv(cfg, 1, cfg.max_model_len)
+    toks = jnp.array([[cfg.sot_id, cfg.lang_base_id, cfg.transcribe_id,
+                       cfg.notimestamps_id]], jnp.int32)
+    logits, kv = W.decode_tokens(cfg, params, toks,
+                                 jnp.zeros((1,), jnp.int32), kv, ck, cv,
+                                 jnp.array([4], jnp.int32))
+    nxt = jnp.argmax(logits[0, 3]).astype(jnp.int32)
+    inc, _ = W.decode_tokens(cfg, params, nxt[None, None],
+                             jnp.array([4], jnp.int32), kv, ck, cv,
+                             jnp.array([1], jnp.int32))
+    dense, _ = W.decode_tokens(
+        cfg, params, jnp.concatenate([toks, nxt[None, None]], axis=1),
+        jnp.zeros((1,), jnp.int32),
+        W.init_self_kv(cfg, 1, cfg.max_model_len), ck, cv,
+        jnp.array([5], jnp.int32))
+    assert float(jnp.abs(inc[0, 0] - dense[0, 4]).max()) < 1e-4
+
+
+def test_whisper_padded_prefill_matches_exact(tiny):
+    import jax.numpy as jnp
+
+    from production_stack_tpu.models import whisper as W
+
+    cfg, params = tiny
+    mel = jnp.zeros((1, cfg.num_mel_bins, cfg.n_audio_ctx * 2))
+    enc = W.encode(cfg, params, mel)
+    ck, cv = W.cross_kv(cfg, params, enc)
+    forced = [cfg.sot_id, cfg.lang_base_id, cfg.transcribe_id,
+              cfg.notimestamps_id]
+    exact, _ = W.decode_tokens(
+        cfg, params, jnp.array([forced], jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        W.init_self_kv(cfg, 1, cfg.max_model_len), ck, cv,
+        jnp.array([4], jnp.int32))
+    padded, _ = W.decode_tokens(
+        cfg, params, jnp.array([forced + [0] * 4], jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        W.init_self_kv(cfg, 1, cfg.max_model_len), ck, cv,
+        jnp.array([4], jnp.int32))
+    assert float(jnp.abs(padded[0, 3] - exact[0, 3]).max()) < 1e-5
+
+
+# --- runner -----------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def runner():
+    from production_stack_tpu.engine.whisper_runner import WhisperRunner
+
+    return WhisperRunner(EngineConfig.for_model("tiny-whisper"))
+
+
+def _features(runner, seconds=0.5):
+    cfg = runner.cfg
+    return A.wav_to_features(make_wav(seconds=seconds), cfg.num_mel_bins,
+                             runner.chunk_frames)[0]
+
+
+def test_runner_greedy_deterministic_and_text_only(runner):
+    feats = _features(runner)
+    toks = runner.transcribe(feats, language="en")
+    assert toks == runner.transcribe(feats, language="en")
+    assert toks  # something was generated
+    # suppression: only text tokens (or eot, stripped) may appear
+    assert all(t < runner.cfg.eot_id for t in toks)
+
+
+def test_runner_max_tokens_and_languages(runner):
+    feats = _features(runner)
+    assert len(runner.transcribe(feats, language="en", max_tokens=3)) <= 3
+    lang = runner.detect_language(feats)
+    assert lang in runner.languages
+    with pytest.raises(A.AudioError, match="unsupported language"):
+        runner.transcribe(feats, language="xx")
+
+
+def test_runner_translate_task_differs(runner):
+    # different task token conditions a different continuation in
+    # general; at minimum it must run and obey suppression
+    feats = _features(runner)
+    toks = runner.transcribe(feats, language="en", task="translate")
+    assert all(t < runner.cfg.eot_id for t in toks)
+
+
+def test_runner_prompt_conditioning(runner):
+    feats = _features(runner)
+    a = runner.transcribe(feats, language="en")
+    b = runner.transcribe(feats, language="en", prompt="hello context")
+    # both valid; conditioning changes the forced prefix (sot_prev path)
+    assert all(t < runner.cfg.eot_id for t in b)
+    assert isinstance(a, list) and isinstance(b, list)
+
+
+# --- HTTP surface -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wserver(runner):
+    from production_stack_tpu.engine.whisper_server import WhisperServer
+
+    return WhisperServer(EngineConfig.for_model("tiny-whisper"),
+                         runner=runner)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def with_client(server, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async with TestClient(TestServer(server.build_app())) as client:
+        return await fn(client)
+
+
+def _form(**extra):
+    import aiohttp
+
+    form = aiohttp.FormData()
+    form.add_field("file", make_wav(), filename="a.wav",
+                   content_type="audio/wav")
+    form.add_field("model", "tiny-whisper")
+    for k, v in extra.items():
+        form.add_field(k, v)
+    return form
+
+
+def test_transcriptions_endpoint(wserver):
+    async def fn(client):
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="en"))
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert "text" in body and isinstance(body["text"], str)
+        # capability card
+        r = await client.get("/v1/models")
+        card = (await r.json())["data"][0]
+        assert "audio.transcriptions" in card["capabilities"]
+        assert "audio.translations" in card["capabilities"]
+        # metrics counted
+        r = await client.get("/metrics")
+        assert "pstpu_transcription_requests" in await r.text()
+
+    run(with_client(wserver, fn))
+
+
+def test_transcriptions_response_formats(wserver):
+    async def fn(client):
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="en",
+                                         response_format="text"))
+        assert r.status == 200
+        assert r.content_type == "text/plain"
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="en",
+                                         response_format="verbose_json"))
+        body = await r.json()
+        assert body["language"] == "en"
+        assert body["duration"] == pytest.approx(0.5, abs=0.01)
+        assert len(body["segments"]) == 1
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="en",
+                                         response_format="srt"))
+        assert "-->" in await r.text()
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="en",
+                                         response_format="vtt"))
+        assert (await r.text()).startswith("WEBVTT")
+
+    run(with_client(wserver, fn))
+
+
+def test_transcriptions_streaming_sse(wserver):
+    async def fn(client):
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="en", stream="true"))
+        assert r.status == 200
+        text = await r.text()
+        assert text.rstrip().endswith("data: [DONE]")
+
+    run(with_client(wserver, fn))
+
+
+def test_multipart_fields_parser_edge_cases():
+    from production_stack_tpu.router.request_service import multipart_fields
+
+    boundary = "XBOUND"
+    ctype = f'multipart/form-data; boundary="{boundary}"'
+
+    def enc(parts):
+        raw = b""
+        for head, value in parts:
+            raw += (b"--XBOUND\r\n" + head + b"\r\n\r\n" + value + b"\r\n")
+        return raw + b"--XBOUND--\r\n"
+
+    # a FILE named "model" must not clobber the model field (r5 review)
+    raw = enc([
+        (b'Content-Disposition: form-data; name="file"; filename="model"',
+         b"\x00\x01binary"),
+        (b'Content-Disposition: form-data; name="model"', b"whisper-small"),
+    ])
+    assert multipart_fields(raw, ctype, ("model",)) == {
+        "model": "whisper-small"}
+    # trailing dash in a value survives (r5 review)
+    raw = enc([(b'Content-Disposition: form-data; name="model"',
+                b"my-lora-")])
+    assert multipart_fields(raw, ctype, ("model",))["model"] == "my-lora-"
+
+
+def test_unsupported_language_is_clean_400(wserver):
+    async def fn(client):
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(language="klingon"))
+        assert r.status == 400, await r.text()
+        body = await r.json()
+        assert "unsupported language" in body["error"]["message"]
+        # streaming request with bad language must also 400, not start
+        # an SSE stream that dies
+        r = await client.post(
+            "/v1/audio/transcriptions",
+            data=_form(language="klingon", stream="true"))
+        assert r.status == 400
+
+    run(with_client(wserver, fn))
+
+
+def test_transcriptions_errors(wserver):
+    async def fn(client):
+        import aiohttp
+
+        # missing file
+        form = aiohttp.FormData()
+        form.add_field("model", "tiny-whisper")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 400
+        # not-a-wav payload
+        form = aiohttp.FormData()
+        form.add_field("file", b"not audio", filename="x.mp3")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 400
+        body = await r.json()
+        assert "WAV" in body["error"]["message"]
+        # bad response_format
+        r = await client.post("/v1/audio/transcriptions",
+                              data=_form(response_format="yaml"))
+        assert r.status == 400
+        # translations endpoint exists
+        r = await client.post("/v1/audio/translations",
+                              data=_form(language="en"))
+        assert r.status == 200
+
+    run(with_client(wserver, fn))
+
+
+# --- through the router -----------------------------------------------------
+
+def test_audio_routed_through_router(wserver):
+    """Router discovers the whisper engine's audio.* capabilities and
+    proxies multipart transcription requests to it; text endpoints on
+    the whisper model still 501 (no chat capability)."""
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import RouterApp, build_parser
+
+        ts = TestServer(wserver.build_app())
+        await ts.start_server()
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"http://127.0.0.1:{ts.port}",
+            "--static-models", "tiny-whisper",
+            "--static-query-models",
+            "--static-backend-health-checks",
+            "--health-check-interval", "0.2",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            from production_stack_tpu.router.service_discovery import (
+                get_service_discovery,
+            )
+            for _ in range(50):
+                eps = get_service_discovery().get_endpoint_info()
+                if eps and eps[0].capabilities is not None:
+                    break
+                await asyncio.sleep(0.1)
+            assert eps and "audio.transcriptions" in eps[0].capabilities
+
+            r = await client.post("/v1/audio/transcriptions",
+                                  data=_form(language="en"))
+            assert r.status == 200, await r.text()
+            assert "text" in await r.json()
+
+            # a text request against the whisper model is refused clean
+            r = await client.post("/v1/chat/completions", json={
+                "model": "tiny-whisper",
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 501
+        finally:
+            await client.close()
+            await ts.close()
+
+    asyncio.run(main())
