@@ -1,0 +1,840 @@
+// Envoy ext-proc gRPC data plane for the gateway picker (see extproc.h).
+//
+// Why hand-rolled: the image ships neither grpc++ nor nghttp2 headers, and
+// the reference's pickers get this layer for free by compiling into the
+// inference-extension EPP (Go). A real kgateway EPP speaks gRPC streaming
+// over HTTP/2 — so this file implements exactly the slice of HTTP/2
+// (RFC 7540), HPACK (RFC 7541, huffman table validated against every
+// Appendix C vector), gRPC framing, and the ext_proc v3 protobuf wire
+// format that the EPP exchange needs. ~900 lines buys a picker the
+// gateway can actually drive.
+//
+// Protocol flow served (the inference-extension EPP contract):
+//   Envoy HEADERS  -> ProcessingRequest{request_headers}  -> empty
+//                     HeadersResponse (we need the body for the pick)
+//   Envoy DATA     -> ProcessingRequest{request_body}     -> BodyResponse
+//                     with header_mutation x-gateway-destination-endpoint
+//                     + dynamic_metadata envoy.lb/x-gateway-destination-
+//                     endpoint + clear_route_cache
+//   headers with end_of_stream (bodyless request) -> the pick rides the
+//                     HeadersResponse instead.
+
+#include "extproc.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <map>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace extproc {
+namespace {
+
+// ---------------------------------------------------------------------------
+// HPACK huffman (RFC 7541 Appendix B; table validated in-repo against the
+// RFC's Appendix C vectors + Kraft equality — tests/test_gateway_extproc.py)
+// ---------------------------------------------------------------------------
+
+struct HuffSym { uint32_t code; uint8_t bits; };
+static const HuffSym kHuff[257] = {
+    {0x1ff8u,13},{0x7fffd8u,23},{0xfffffe2u,28},{0xfffffe3u,28},{0xfffffe4u,28},{0xfffffe5u,28},
+    {0xfffffe6u,28},{0xfffffe7u,28},{0xfffffe8u,28},{0xffffeau,24},{0x3ffffffcu,30},{0xfffffe9u,28},
+    {0xfffffeau,28},{0x3ffffffdu,30},{0xfffffebu,28},{0xfffffecu,28},{0xfffffedu,28},{0xfffffeeu,28},
+    {0xfffffefu,28},{0xffffff0u,28},{0xffffff1u,28},{0xffffff2u,28},{0x3ffffffeu,30},{0xffffff3u,28},
+    {0xffffff4u,28},{0xffffff5u,28},{0xffffff6u,28},{0xffffff7u,28},{0xffffff8u,28},{0xffffff9u,28},
+    {0xffffffau,28},{0xffffffbu,28},{0x14u,6},{0x3f8u,10},{0x3f9u,10},{0xffau,12},
+    {0x1ff9u,13},{0x15u,6},{0xf8u,8},{0x7fau,11},{0x3fau,10},{0x3fbu,10},
+    {0xf9u,8},{0x7fbu,11},{0xfau,8},{0x16u,6},{0x17u,6},{0x18u,6},
+    {0x0u,5},{0x1u,5},{0x2u,5},{0x19u,6},{0x1au,6},{0x1bu,6},
+    {0x1cu,6},{0x1du,6},{0x1eu,6},{0x1fu,6},{0x5cu,7},{0xfbu,8},
+    {0x7ffcu,15},{0x20u,6},{0xffbu,12},{0x3fcu,10},{0x1ffau,13},{0x21u,6},
+    {0x5du,7},{0x5eu,7},{0x5fu,7},{0x60u,7},{0x61u,7},{0x62u,7},
+    {0x63u,7},{0x64u,7},{0x65u,7},{0x66u,7},{0x67u,7},{0x68u,7},
+    {0x69u,7},{0x6au,7},{0x6bu,7},{0x6cu,7},{0x6du,7},{0x6eu,7},
+    {0x6fu,7},{0x70u,7},{0x71u,7},{0x72u,7},{0xfcu,8},{0x73u,7},
+    {0xfdu,8},{0x1ffbu,13},{0x7fff0u,19},{0x1ffcu,13},{0x3ffcu,14},{0x22u,6},
+    {0x7ffdu,15},{0x3u,5},{0x23u,6},{0x4u,5},{0x24u,6},{0x5u,5},
+    {0x25u,6},{0x26u,6},{0x27u,6},{0x6u,5},{0x74u,7},{0x75u,7},
+    {0x28u,6},{0x29u,6},{0x2au,6},{0x7u,5},{0x2bu,6},{0x76u,7},
+    {0x2cu,6},{0x8u,5},{0x9u,5},{0x2du,6},{0x77u,7},{0x78u,7},
+    {0x79u,7},{0x7au,7},{0x7bu,7},{0x7ffeu,15},{0x7fcu,11},{0x3ffdu,14},
+    {0x1ffdu,13},{0xffffffcu,28},{0xfffe6u,20},{0x3fffd2u,22},{0xfffe7u,20},{0xfffe8u,20},
+    {0x3fffd3u,22},{0x3fffd4u,22},{0x3fffd5u,22},{0x7fffd9u,23},{0x3fffd6u,22},{0x7fffdau,23},
+    {0x7fffdbu,23},{0x7fffdcu,23},{0x7fffddu,23},{0x7fffdeu,23},{0xffffebu,24},{0x7fffdfu,23},
+    {0xffffecu,24},{0xffffedu,24},{0x3fffd7u,22},{0x7fffe0u,23},{0xffffeeu,24},{0x7fffe1u,23},
+    {0x7fffe2u,23},{0x7fffe3u,23},{0x7fffe4u,23},{0x1fffdcu,21},{0x3fffd8u,22},{0x7fffe5u,23},
+    {0x3fffd9u,22},{0x7fffe6u,23},{0x7fffe7u,23},{0xffffefu,24},{0x3fffdau,22},{0x1fffddu,21},
+    {0xfffe9u,20},{0x3fffdbu,22},{0x3fffdcu,22},{0x7fffe8u,23},{0x7fffe9u,23},{0x1fffdeu,21},
+    {0x7fffeau,23},{0x3fffddu,22},{0x3fffdeu,22},{0xfffff0u,24},{0x1fffdfu,21},{0x3fffdfu,22},
+    {0x7fffebu,23},{0x7fffecu,23},{0x1fffe0u,21},{0x1fffe1u,21},{0x3fffe0u,22},{0x1fffe2u,21},
+    {0x7fffedu,23},{0x3fffe1u,22},{0x7fffeeu,23},{0x7fffefu,23},{0xfffeau,20},{0x3fffe2u,22},
+    {0x3fffe3u,22},{0x3fffe4u,22},{0x7ffff0u,23},{0x3fffe5u,22},{0x3fffe6u,22},{0x7ffff1u,23},
+    {0x3ffffe0u,26},{0x3ffffe1u,26},{0xfffebu,20},{0x7fff1u,19},{0x3fffe7u,22},{0x7ffff2u,23},
+    {0x3fffe8u,22},{0x1ffffecu,25},{0x3ffffe2u,26},{0x3ffffe3u,26},{0x3ffffe4u,26},{0x7ffffdeu,27},
+    {0x7ffffdfu,27},{0x3ffffe5u,26},{0xfffff1u,24},{0x1ffffedu,25},{0x7fff2u,19},{0x1fffe3u,21},
+    {0x3ffffe6u,26},{0x7ffffe0u,27},{0x7ffffe1u,27},{0x3ffffe7u,26},{0x7ffffe2u,27},{0xfffff2u,24},
+    {0x1fffe4u,21},{0x1fffe5u,21},{0x3ffffe8u,26},{0x3ffffe9u,26},{0xffffffdu,28},{0x7ffffe3u,27},
+    {0x7ffffe4u,27},{0x7ffffe5u,27},{0xfffecu,20},{0xfffff3u,24},{0xfffedu,20},{0x1fffe6u,21},
+    {0x3fffe9u,22},{0x1fffe7u,21},{0x1fffe8u,21},{0x7ffff3u,23},{0x3fffeau,22},{0x3fffebu,22},
+    {0x1ffffeeu,25},{0x1ffffefu,25},{0xfffff4u,24},{0xfffff5u,24},{0x3ffffeau,26},{0x7ffff4u,23},
+    {0x3ffffebu,26},{0x7ffffe6u,27},{0x3ffffecu,26},{0x3ffffedu,26},{0x7ffffe7u,27},{0x7ffffe8u,27},
+    {0x7ffffe9u,27},{0x7ffffeau,27},{0x7ffffebu,27},{0xffffffeu,28},{0x7ffffecu,27},{0x7ffffedu,27},
+    {0x7ffffeeu,27},{0x7ffffefu,27},{0x7fffff0u,27},{0x3ffffeeu,26},{0x3fffffffu,30}
+};
+
+// binary decode trie built once (513 nodes max: 257 leaves)
+struct HuffNode { int16_t next0 = -1, next1 = -1; int16_t sym = -1; };
+struct HuffTree {
+    std::vector<HuffNode> nodes;
+    HuffTree() {
+        nodes.emplace_back();
+        for (int s = 0; s < 257; ++s) {
+            int cur = 0;
+            for (int b = kHuff[s].bits - 1; b >= 0; --b) {
+                int bit = (kHuff[s].code >> b) & 1;
+                // NOTE: no reference into `nodes` may be held across the
+                // emplace_back — it reallocates
+                int nxt = bit ? nodes[cur].next1 : nodes[cur].next0;
+                if (nxt < 0) {
+                    nxt = (int)nodes.size();
+                    nodes.emplace_back();
+                    if (bit) nodes[cur].next1 = (int16_t)nxt;
+                    else nodes[cur].next0 = (int16_t)nxt;
+                }
+                cur = nxt;
+            }
+            nodes[cur].sym = (int16_t)s;
+        }
+    }
+};
+static const HuffTree kHuffTree;
+
+bool huff_decode(const uint8_t* p, size_t n, std::string* out) {
+    int cur = 0;
+    int depth = 0;  // bits consumed since last symbol (for padding check)
+    for (size_t i = 0; i < n; ++i) {
+        for (int b = 7; b >= 0; --b) {
+            int bit = (p[i] >> b) & 1;
+            cur = bit ? kHuffTree.nodes[cur].next1 : kHuffTree.nodes[cur].next0;
+            if (cur < 0) return false;
+            ++depth;
+            int sym = kHuffTree.nodes[cur].sym;
+            if (sym >= 0) {
+                if (sym == 256) return false;  // EOS in stream = error
+                out->push_back((char)sym);
+                cur = 0;
+                depth = 0;
+            }
+        }
+    }
+    // RFC 7541 §5.2: padding must be <8 bits of the EOS prefix (all 1s);
+    // walking 1-edges from the partial state must be consistent — accept
+    // any partial depth < 8 (strictness about all-ones padding is a MAY)
+    return depth < 8;
+}
+
+// ---------------------------------------------------------------------------
+// HPACK decoding (integers, static + dynamic table, literals)
+// ---------------------------------------------------------------------------
+
+struct Header { std::string name, value; };
+
+static const Header kStatic[62] = {
+    {"", ""},  // index 0 unused
+    {":authority", ""}, {":method", "GET"}, {":method", "POST"},
+    {":path", "/"}, {":path", "/index.html"}, {":scheme", "http"},
+    {":scheme", "https"}, {":status", "200"}, {":status", "204"},
+    {":status", "206"}, {":status", "304"}, {":status", "400"},
+    {":status", "404"}, {":status", "500"}, {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+    {"accept-ranges", ""}, {"accept", ""},
+    {"access-control-allow-origin", ""}, {"age", ""}, {"allow", ""},
+    {"authorization", ""}, {"cache-control", ""}, {"content-disposition", ""},
+    {"content-encoding", ""}, {"content-language", ""}, {"content-length", ""},
+    {"content-location", ""}, {"content-range", ""}, {"content-type", ""},
+    {"cookie", ""}, {"date", ""}, {"etag", ""}, {"expect", ""},
+    {"expires", ""}, {"from", ""}, {"host", ""}, {"if-match", ""},
+    {"if-modified-since", ""}, {"if-none-match", ""}, {"if-range", ""},
+    {"if-unmodified-since", ""}, {"last-modified", ""}, {"link", ""},
+    {"location", ""}, {"max-forwards", ""}, {"proxy-authenticate", ""},
+    {"proxy-authorization", ""}, {"range", ""}, {"referer", ""},
+    {"refresh", ""}, {"retry-after", ""}, {"server", ""}, {"set-cookie", ""},
+    {"strict-transport-security", ""}, {"transfer-encoding", ""},
+    {"user-agent", ""}, {"vary", ""}, {"via", ""}, {"www-authenticate", ""},
+};
+
+class HpackDecoder {
+  public:
+    // false on malformed block (connection error per RFC)
+    bool decode(const uint8_t* p, size_t n, std::vector<Header>* out) {
+        size_t i = 0;
+        while (i < n) {
+            uint8_t b = p[i];
+            if (b & 0x80) {  // indexed header field
+                uint64_t idx;
+                if (!integer(p, n, &i, 7, &idx) || idx == 0) return false;
+                Header h;
+                if (!lookup(idx, &h)) return false;
+                out->push_back(h);
+            } else if (b & 0x40) {  // literal with incremental indexing
+                Header h;
+                if (!literal(p, n, &i, 6, &h)) return false;
+                insert(h);
+                out->push_back(h);
+            } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+                uint64_t sz;
+                if (!integer(p, n, &i, 5, &sz)) return false;
+                if (sz > 65536) return false;
+                max_size_ = (size_t)sz;
+                evict();
+            } else {  // literal without indexing (0x00) / never indexed (0x10)
+                Header h;
+                if (!literal(p, n, &i, 4, &h)) return false;
+                out->push_back(h);
+            }
+        }
+        return true;
+    }
+
+  private:
+    std::deque<Header> dyn_;  // newest at front
+    size_t size_ = 0, max_size_ = 4096;
+
+    static bool integer(const uint8_t* p, size_t n, size_t* i, int prefix,
+                        uint64_t* out) {
+        if (*i >= n) return false;
+        uint64_t max_prefix = (1u << prefix) - 1;
+        uint64_t v = p[(*i)++] & max_prefix;
+        if (v < max_prefix) { *out = v; return true; }
+        int shift = 0;
+        while (*i < n) {
+            uint8_t b = p[(*i)++];
+            v += (uint64_t)(b & 0x7f) << shift;
+            if (v > (1ull << 32)) return false;  // sanity cap
+            if (!(b & 0x80)) { *out = v; return true; }
+            shift += 7;
+            if (shift > 28) return false;
+        }
+        return false;
+    }
+
+    static bool string(const uint8_t* p, size_t n, size_t* i,
+                       std::string* out) {
+        if (*i >= n) return false;
+        bool huff = p[*i] & 0x80;
+        uint64_t len;
+        if (!integer(p, n, i, 7, &len)) return false;
+        if (*i + len > n || len > (16u << 20)) return false;
+        if (huff) {
+            if (!huff_decode(p + *i, len, out)) return false;
+        } else {
+            out->assign((const char*)p + *i, len);
+        }
+        *i += len;
+        return true;
+    }
+
+    bool literal(const uint8_t* p, size_t n, size_t* i, int prefix,
+                 Header* h) {
+        uint64_t idx;
+        if (!integer(p, n, i, prefix, &idx)) return false;
+        if (idx) {
+            Header nh;
+            if (!lookup(idx, &nh)) return false;
+            h->name = nh.name;
+        } else if (!string(p, n, i, &h->name)) {
+            return false;
+        }
+        return string(p, n, i, &h->value);
+    }
+
+    bool lookup(uint64_t idx, Header* h) {
+        if (idx <= 61) { *h = kStatic[idx]; return true; }
+        size_t d = idx - 62;
+        if (d >= dyn_.size()) return false;
+        *h = dyn_[d];
+        return true;
+    }
+
+    void insert(const Header& h) {
+        size_t entry = h.name.size() + h.value.size() + 32;
+        dyn_.push_front(h);
+        size_ += entry;
+        evict();
+    }
+
+    void evict() {
+        while (size_ > max_size_ && !dyn_.empty()) {
+            size_ -= dyn_.back().name.size() + dyn_.back().value.size() + 32;
+            dyn_.pop_back();
+        }
+        if (dyn_.empty()) size_ = 0;
+    }
+};
+
+// response encoding: indexed :status 200 + literal-without-indexing plain
+// strings — always a valid HPACK stream, no encoder state to maintain
+void hpack_emit_literal(std::string* out, const std::string& name,
+                        const std::string& value) {
+    auto emit_int = [out](uint64_t v, int prefix, uint8_t flags) {
+        uint64_t max_prefix = (1u << prefix) - 1;
+        if (v < max_prefix) { out->push_back((char)(flags | v)); return; }
+        out->push_back((char)(flags | max_prefix));
+        v -= max_prefix;
+        while (v >= 128) { out->push_back((char)(0x80 | (v & 0x7f))); v >>= 7; }
+        out->push_back((char)v);
+    };
+    out->push_back('\x00');
+    emit_int(name.size(), 7, 0);
+    out->append(name);
+    emit_int(value.size(), 7, 0);
+    out->append(value);
+}
+
+// ---------------------------------------------------------------------------
+// protobuf wire helpers (hand-rolled: only varint + length-delimited used)
+// ---------------------------------------------------------------------------
+
+void pb_varint(std::string* out, uint64_t v) {
+    while (v >= 128) { out->push_back((char)(0x80 | (v & 0x7f))); v >>= 7; }
+    out->push_back((char)v);
+}
+void pb_tag(std::string* out, int field, int wire) {
+    pb_varint(out, (uint64_t)(field << 3) | wire);
+}
+void pb_bytes(std::string* out, int field, const std::string& s) {
+    pb_tag(out, field, 2);
+    pb_varint(out, s.size());
+    out->append(s);
+}
+
+struct PbReader {
+    const uint8_t* p; size_t n, i = 0;
+    bool varint(uint64_t* v) {
+        *v = 0; int shift = 0;
+        while (i < n) {
+            uint8_t b = p[i++];
+            *v |= (uint64_t)(b & 0x7f) << shift;
+            if (!(b & 0x80)) return true;
+            shift += 7;
+            if (shift >= 64) return false;
+        }
+        return false;
+    }
+    // next field: returns false at end. wire 2 puts the payload in sub.
+    bool next(int* field, uint64_t* vint, PbReader* sub) {
+        if (i >= n) return false;
+        uint64_t key;
+        if (!varint(&key)) return false;
+        *field = (int)(key >> 3);
+        int wire = (int)(key & 7);
+        switch (wire) {
+            case 0: return varint(vint);
+            case 1: if (i + 8 > n) return false; i += 8; *vint = 0; return true;
+            case 2: {
+                uint64_t len;
+                if (!varint(&len) || i + len > n) return false;
+                sub->p = p + i; sub->n = (size_t)len; sub->i = 0;
+                i += (size_t)len;
+                *vint = 0;
+                return true;
+            }
+            case 5: if (i + 4 > n) return false; i += 4; *vint = 0; return true;
+            default: return false;
+        }
+    }
+};
+
+// ext_proc ProcessingRequest subset we consume
+struct ProcRequest {
+    bool has_headers = false, has_body = false;
+    bool headers_eos = false;
+    std::vector<Header> headers;  // from request_headers.headers.headers[]
+    std::string body;             // from request_body.body
+};
+
+bool parse_processing_request(const std::string& msg, ProcRequest* out) {
+    PbReader r{(const uint8_t*)msg.data(), msg.size()};
+    int f; uint64_t v; PbReader sub{nullptr, 0};
+    bool ok = true;
+    while (r.next(&f, &v, &sub)) {
+        if (f == 2) {  // request_headers: HttpHeaders
+            out->has_headers = true;
+            PbReader hh = sub;
+            int hf; uint64_t hv; PbReader hsub{nullptr, 0};
+            while (hh.next(&hf, &hv, &hsub)) {
+                if (hf == 1) {  // HeaderMap
+                    PbReader hm = hsub;
+                    int mf; uint64_t mv; PbReader msub{nullptr, 0};
+                    while (hm.next(&mf, &mv, &msub)) {
+                        if (mf != 1) continue;  // repeated HeaderValue
+                        Header h;
+                        PbReader hv2 = msub;
+                        int vf; uint64_t vv; PbReader vsub{nullptr, 0};
+                        while (hv2.next(&vf, &vv, &vsub)) {
+                            std::string s((const char*)vsub.p, vsub.n);
+                            if (vf == 1) h.name = s;
+                            else if (vf == 2) h.value = s;
+                            else if (vf == 3) h.value = s;  // raw_value
+                        }
+                        out->headers.push_back(h);
+                    }
+                } else if (hf == 3) {  // end_of_stream
+                    out->headers_eos = hv != 0;
+                }
+            }
+        } else if (f == 4) {  // request_body: HttpBody
+            out->has_body = true;
+            PbReader hb = sub;
+            int bf; uint64_t bv; PbReader bsub{nullptr, 0};
+            while (hb.next(&bf, &bv, &bsub)) {
+                if (bf == 1) out->body.assign((const char*)bsub.p, bsub.n);
+            }
+        }
+    }
+    // a truncated varint/length leaves the reader mid-buffer: report it
+    // so the caller answers with an error instead of silence (a missing
+    // ProcessingResponse stalls Envoy until its message_timeout)
+    if (r.i != r.n) ok = false;
+    return ok;
+}
+
+// CommonResponse with the destination header mutation
+std::string encode_common_response(const std::string& endpoint) {
+    std::string hv;  // HeaderValue{key, raw_value}
+    pb_bytes(&hv, 1, "x-gateway-destination-endpoint");
+    pb_bytes(&hv, 3, endpoint);  // raw_value: envoy >=1.27 rejects `value`
+    std::string hvo;  // HeaderValueOption{header}
+    pb_bytes(&hvo, 1, hv);
+    std::string mut;  // HeaderMutation{set_headers}
+    pb_bytes(&mut, 1, hvo);
+    std::string common;  // CommonResponse{header_mutation=2, clear_route_cache=5}
+    pb_bytes(&common, 2, mut);
+    pb_tag(&common, 5, 0);
+    pb_varint(&common, 1);
+    return common;
+}
+
+// google.protobuf.Struct: {"envoy.lb": {"x-gateway-destination-endpoint": ep}}
+std::string encode_dynamic_metadata(const std::string& endpoint) {
+    std::string val;  // Value{string_value=3}
+    pb_bytes(&val, 3, endpoint);
+    std::string inner_entry;  // FieldsEntry{key, value}
+    pb_bytes(&inner_entry, 1, "x-gateway-destination-endpoint");
+    pb_bytes(&inner_entry, 2, val);
+    std::string inner_struct;  // Struct{fields}
+    pb_bytes(&inner_struct, 1, inner_entry);
+    std::string inner_value;  // Value{struct_value=5}
+    pb_bytes(&inner_value, 5, inner_struct);
+    std::string outer_entry;
+    pb_bytes(&outer_entry, 1, "envoy.lb");
+    pb_bytes(&outer_entry, 2, inner_value);
+    std::string outer;
+    pb_bytes(&outer, 1, outer_entry);
+    return outer;
+}
+
+// ProcessingResponse: oneof field (1=request_headers HeadersResponse,
+// 3=request_body BodyResponse), each wrapping CommonResponse at field 1;
+// dynamic_metadata at field 8.
+std::string encode_processing_response(int oneof_field,
+                                       const std::string& endpoint) {
+    std::string wrapper;
+    if (!endpoint.empty()) {
+        pb_bytes(&wrapper, 1, encode_common_response(endpoint));
+    }
+    std::string resp;
+    pb_bytes(&resp, oneof_field, wrapper);
+    if (!endpoint.empty()) {
+        pb_bytes(&resp, 8, encode_dynamic_metadata(endpoint));
+    }
+    return resp;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP/2 server (the slice gRPC needs)
+// ---------------------------------------------------------------------------
+
+constexpr uint8_t F_DATA = 0x0, F_HEADERS = 0x1, F_RST = 0x3,
+                  F_SETTINGS = 0x4, F_PING = 0x6, F_GOAWAY = 0x7,
+                  F_WINUP = 0x8, F_CONT = 0x9;
+constexpr uint8_t FLAG_END_STREAM = 0x1, FLAG_END_HEADERS = 0x4,
+                  FLAG_ACK = 0x1, FLAG_PADDED = 0x8, FLAG_PRIORITY = 0x20;
+constexpr size_t kMaxFrame = 1u << 20;
+
+struct Conn {
+    explicit Conn(int fd_) : fd(fd_) {}
+    int fd;
+    std::mutex write_mu;
+    bool send_all(const std::string& data) {
+        std::lock_guard<std::mutex> lock(write_mu);
+        size_t sent = 0;
+        while (sent < data.size()) {
+            ssize_t n = ::send(fd, data.data() + sent, data.size() - sent,
+                               MSG_NOSIGNAL);
+            if (n <= 0) return false;
+            sent += n;
+        }
+        return true;
+    }
+    bool frame(uint8_t type, uint8_t flags, uint32_t stream,
+               const std::string& payload) {
+        std::string f;
+        uint32_t len = (uint32_t)payload.size();
+        f.push_back((char)(len >> 16));
+        f.push_back((char)(len >> 8));
+        f.push_back((char)len);
+        f.push_back((char)type);
+        f.push_back((char)flags);
+        f.push_back((char)((stream >> 24) & 0x7f));
+        f.push_back((char)(stream >> 16));
+        f.push_back((char)(stream >> 8));
+        f.push_back((char)stream);
+        f += payload;
+        return send_all(f);
+    }
+};
+
+struct Stream {
+    std::vector<Header> req_headers;
+    std::string header_block;   // accumulating (CONTINUATION)
+    bool headers_done = false;
+    bool is_process_rpc = false;
+    bool client_closed = false;
+    std::string grpc_buf;       // unparsed gRPC message bytes
+};
+
+bool read_exact(int fd, uint8_t* p, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+        ssize_t r = recv(fd, p + got, n - got, 0);
+        if (r <= 0) return false;
+        got += r;
+    }
+    return true;
+}
+
+void send_grpc_response_headers(Conn* c, uint32_t stream) {
+    std::string block;
+    block.push_back('\x88');  // indexed: :status 200
+    hpack_emit_literal(&block, "content-type", "application/grpc");
+    c->frame(F_HEADERS, FLAG_END_HEADERS, stream, block);
+}
+
+void send_grpc_trailers(Conn* c, uint32_t stream, int status,
+                        const std::string& msg) {
+    std::string block;
+    hpack_emit_literal(&block, "grpc-status", std::to_string(status));
+    if (!msg.empty()) hpack_emit_literal(&block, "grpc-message", msg);
+    c->frame(F_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM, stream, block);
+}
+
+void send_grpc_message(Conn* c, uint32_t stream, const std::string& msg) {
+    std::string framed;
+    framed.push_back('\x00');  // no compression
+    uint32_t len = (uint32_t)msg.size();
+    framed.push_back((char)(len >> 24));
+    framed.push_back((char)(len >> 16));
+    framed.push_back((char)(len >> 8));
+    framed.push_back((char)len);
+    framed += msg;
+    c->frame(F_DATA, 0, stream, framed);
+}
+
+std::string header_get(const std::vector<Header>& hs, const std::string& k) {
+    for (const auto& h : hs) if (h.name == k) return h.value;
+    return "";
+}
+
+// drive one ProcessingRequest through the picker; returns the response
+// message, or "" when nothing should be sent yet
+// returns false on a malformed message (stream must answer with an error
+// rather than leave Envoy waiting for a ProcessingResponse)
+bool process_message(const std::string& msg, Stream* st,
+                     const PickFn& pick, std::string* out) {
+    ProcRequest req;
+    if (!parse_processing_request(msg, &req)) return false;
+    if (req.has_headers) {
+        st->req_headers = req.headers;
+        if (req.headers_eos) {  // bodyless request: pick on headers alone
+            std::string session = header_get(req.headers, "x-session-id");
+            if (session.empty())
+                session = header_get(req.headers, "x-user-id");
+            std::string ep = pick("", session);
+            *out = encode_processing_response(1, ep);
+            return true;
+        }
+        *out = encode_processing_response(1, "");  // wait for the body
+        return true;
+    }
+    if (req.has_body) {
+        // model/prompt come from the buffered OpenAI JSON body; session
+        // affinity from the headers captured at the headers message
+        std::string session = header_get(st->req_headers, "x-session-id");
+        if (session.empty())
+            session = header_get(st->req_headers, "x-user-id");
+        std::string ep = pick(req.body, session);
+        *out = encode_processing_response(3, ep);
+        return true;
+    }
+    out->clear();  // trailers / unknown oneof: nothing to say
+    return true;
+}
+
+void serve_conn(int fd, PickFn pick) {
+    Conn conn{fd};
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    struct timeval tv = {300, 0};  // idle guard
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+
+    uint8_t preface[24];
+    if (!read_exact(fd, preface, 24) ||
+        memcmp(preface, "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n", 24) != 0) {
+        close(fd);
+        return;
+    }
+    conn.frame(F_SETTINGS, 0, 0, "");  // our (default) settings
+
+    HpackDecoder hpack;
+    std::map<uint32_t, Stream> streams;
+    bool cont_pending = false;  // a CONTINUATION sequence is open
+    uint32_t cont_stream = 0;   // ... on this stream
+    uint32_t max_sid = 0;       // for the GOAWAY last-stream-id
+
+    auto finish_headers = [&](uint32_t sid, Stream& st,
+                              bool end_stream) -> bool {
+        std::vector<Header> hs;
+        if (!hpack.decode((const uint8_t*)st.header_block.data(),
+                          st.header_block.size(), &hs))
+            return false;  // HPACK desync = connection error
+        st.header_block.clear();
+        if (st.headers_done) {
+            // a second HEADERS block on an open stream is the client's
+            // trailers: the block was decoded (shared HPACK state must
+            // advance) but it is not a new request — just let the
+            // stream finish
+            if (end_stream) {
+                send_grpc_trailers(&conn, sid, 0, "");
+                streams.erase(sid);
+            }
+            return true;
+        }
+        st.headers_done = true;
+        std::string path, ct;
+        for (const auto& h : hs) {
+            if (h.name == ":path") path = h.value;
+            else if (h.name == "content-type") ct = h.value;
+        }
+        if (path == "/envoy.service.ext_proc.v3.ExternalProcessor/Process"
+            && ct.rfind("application/grpc", 0) == 0) {
+            st.is_process_rpc = true;
+            send_grpc_response_headers(&conn, sid);
+        } else {
+            send_grpc_response_headers(&conn, sid);
+            send_grpc_trailers(&conn, sid, 12,  // UNIMPLEMENTED
+                               "unknown method " + path);
+            streams.erase(sid);
+            return true;
+        }
+        if (end_stream) {
+            send_grpc_trailers(&conn, sid, 0, "");
+            streams.erase(sid);
+        }
+        return true;
+    };
+
+    while (true) {
+        uint8_t hdr[9];
+        if (!read_exact(fd, hdr, 9)) break;
+        uint32_t len = (hdr[0] << 16) | (hdr[1] << 8) | hdr[2];
+        uint8_t type = hdr[3], flags = hdr[4];
+        uint32_t sid = ((hdr[5] & 0x7f) << 24) | (hdr[6] << 16) |
+                       (hdr[7] << 8) | hdr[8];
+        if (len > kMaxFrame) break;
+        std::string payload(len, '\0');
+        if (len && !read_exact(fd, (uint8_t*)payload.data(), len)) break;
+
+        if (cont_pending && type != F_CONT) break;  // protocol error
+        if (!cont_pending && type == F_CONT) break;  // stray CONTINUATION
+
+        switch (type) {
+            case F_SETTINGS:
+                if (!(flags & FLAG_ACK)) conn.frame(F_SETTINGS, FLAG_ACK, 0, "");
+                break;
+            case F_PING:
+                if (!(flags & FLAG_ACK)) conn.frame(F_PING, FLAG_ACK, 0, payload);
+                break;
+            case F_WINUP:
+                break;  // responses are tiny; windows never bind
+            case F_GOAWAY:
+                close(fd);
+                return;
+            case F_RST:
+                streams.erase(sid);
+                break;
+            case F_HEADERS: {
+                if (!sid) goto conn_error;
+                if (sid > max_sid) max_sid = sid;
+                Stream& st = streams[sid];
+                size_t off = 0;
+                size_t end = payload.size();
+                if (flags & FLAG_PADDED) {
+                    if (payload.empty()) goto conn_error;
+                    uint8_t pad = (uint8_t)payload[0];
+                    off = 1;
+                    if (pad > end - off) goto conn_error;
+                    end -= pad;
+                }
+                if (flags & FLAG_PRIORITY) {
+                    if (end - off < 5) goto conn_error;
+                    off += 5;
+                }
+                st.header_block.append(payload, off, end - off);
+                st.client_closed = flags & FLAG_END_STREAM;
+                if (flags & FLAG_END_HEADERS) {
+                    if (!finish_headers(sid, st, st.client_closed))
+                        goto conn_error;
+                } else {
+                    cont_pending = true;
+                    cont_stream = sid;
+                }
+                break;
+            }
+            case F_CONT: {
+                if (sid != cont_stream || !sid) goto conn_error;
+                Stream& st = streams[sid];
+                st.header_block += payload;
+                if (flags & FLAG_END_HEADERS) {
+                    cont_pending = false;
+                    cont_stream = 0;
+                    if (!finish_headers(sid, st, st.client_closed))
+                        goto conn_error;
+                }
+                break;
+            }
+            case F_DATA: {
+                // flow control FIRST, stream lookup after: DATA on an
+                // erased/unknown stream still consumed connection window
+                // (RFC 7540 §6.9 counts the whole payload, padding
+                // included) — dropping it silently would leak the window
+                // until the peer stalls at 0
+                if (len) {
+                    std::string w;
+                    uint32_t inc = len;
+                    w.push_back((char)(inc >> 24)); w.push_back((char)(inc >> 16));
+                    w.push_back((char)(inc >> 8)); w.push_back((char)inc);
+                    conn.frame(F_WINUP, 0, 0, w);
+                }
+                auto it = streams.find(sid);
+                if (it == streams.end()) break;  // reset/finished stream
+                Stream& st = it->second;
+                size_t off = 0, end = payload.size();
+                if (flags & FLAG_PADDED) {
+                    if (payload.empty()) goto conn_error;
+                    uint8_t pad = (uint8_t)payload[0];
+                    off = 1;
+                    if (pad > end - off) goto conn_error;
+                    end -= pad;
+                }
+                st.grpc_buf.append(payload, off, end - off);
+                if (len) {
+                    std::string w;
+                    uint32_t inc = len;
+                    w.push_back((char)(inc >> 24)); w.push_back((char)(inc >> 16));
+                    w.push_back((char)(inc >> 8)); w.push_back((char)inc);
+                    conn.frame(F_WINUP, 0, sid, w);
+                }
+                while (st.grpc_buf.size() >= 5) {
+                    uint32_t mlen =
+                        ((uint8_t)st.grpc_buf[1] << 24) |
+                        ((uint8_t)st.grpc_buf[2] << 16) |
+                        ((uint8_t)st.grpc_buf[3] << 8) |
+                        (uint8_t)st.grpc_buf[4];
+                    if ((uint8_t)st.grpc_buf[0] != 0) goto conn_error;
+                    if (mlen > kMaxFrame) goto conn_error;
+                    if (st.grpc_buf.size() < 5u + mlen) break;
+                    std::string msg = st.grpc_buf.substr(5, mlen);
+                    st.grpc_buf.erase(0, 5 + mlen);
+                    if (st.is_process_rpc) {
+                        std::string resp;
+                        if (!process_message(msg, &st, pick, &resp)) {
+                            // malformed message: answer with a gRPC
+                            // error instead of silence (silence stalls
+                            // Envoy until its message_timeout)
+                            send_grpc_trailers(&conn, sid, 3,
+                                               "malformed ProcessingRequest");
+                            streams.erase(sid);
+                            goto next_frame;
+                        }
+                        if (!resp.empty())
+                            send_grpc_message(&conn, sid, resp);
+                    }
+                }
+                if (flags & FLAG_END_STREAM) {
+                    send_grpc_trailers(&conn, sid, 0, "");
+                    streams.erase(sid);
+                }
+                break;
+            }
+            default:
+                break;  // PRIORITY, PUSH_PROMISE (never from client), unknown
+        }
+    next_frame:;
+    }
+conn_error:
+    {
+        // best-effort GOAWAY: a pooled gRPC client (Envoy keeps ONE
+        // ext-proc connection) must learn the connection is going away
+        // (idle timeout / protocol error) rather than race its next
+        // request onto a dead socket
+        std::string ga;
+        ga.push_back((char)((max_sid >> 24) & 0x7f));
+        ga.push_back((char)(max_sid >> 16));
+        ga.push_back((char)(max_sid >> 8));
+        ga.push_back((char)max_sid);
+        ga.append(4, '\0');  // NO_ERROR
+        conn.frame(F_GOAWAY, 0, 0, ga);
+    }
+    close(fd);
+}
+
+}  // namespace
+
+int run_server(int port, PickFn pick) {
+    signal(SIGPIPE, SIG_IGN);
+    int srv = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in addr = {};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(srv, (struct sockaddr*)&addr, sizeof addr) != 0) {
+        perror("extproc bind");
+        return 1;
+    }
+    if (listen(srv, 128) != 0) {
+        perror("extproc listen");
+        return 1;
+    }
+    fprintf(stderr, "picker_server: ext-proc gRPC on :%d\n", port);
+    while (true) {
+        int fd = accept(srv, nullptr, nullptr);
+        if (fd < 0) continue;
+        std::thread(serve_conn, fd, pick).detach();
+    }
+}
+
+}  // namespace extproc

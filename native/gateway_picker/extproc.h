@@ -1,0 +1,31 @@
+// Envoy ext-proc gRPC surface for the gateway endpoint picker.
+//
+// The reference's pickers compile INTO the gateway-api-inference-extension
+// EPP, which Envoy drives over the ext_proc streaming gRPC protocol
+// (reference: src/gateway_inference_extension/kv_aware_picker.go:27-86 +
+// scheduler.patch — the framework around those Pick() plugins IS an
+// ext-proc server). This module is that data plane for the native picker:
+// a dependency-free HTTP/2 + HPACK + gRPC framing implementation serving
+// /envoy.service.ext_proc.v3.ExternalProcessor/Process (no grpc++ or
+// nghttp2 in the image — see extproc.cpp).
+#ifndef GATEWAY_PICKER_EXTPROC_H_
+#define GATEWAY_PICKER_EXTPROC_H_
+
+#include <functional>
+#include <string>
+
+namespace extproc {
+
+// (request body JSON — empty for bodyless requests, session_key)
+// -> chosen endpoint ("" = no endpoints known). The adapter in
+// picker_server.cpp parses model/prompt out of the OpenAI body.
+using PickFn = std::function<std::string(
+    const std::string&, const std::string&)>;
+
+// Blocks forever serving ext-proc gRPC on `port`. Returns non-zero on
+// bind/listen failure.
+int run_server(int port, PickFn pick);
+
+}  // namespace extproc
+
+#endif  // GATEWAY_PICKER_EXTPROC_H_

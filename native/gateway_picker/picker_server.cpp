@@ -42,6 +42,8 @@
 
 #include <atomic>
 #include <algorithm>
+
+#include "extproc.h"
 #include <csignal>
 #include <cstdint>
 #include <cstring>
@@ -653,6 +655,7 @@ void handle(int fd, Picker* picker,
 
 int main(int argc, char** argv) {
     int port = 9002;
+    int extproc_port = 0;  // 0 = ext-proc gRPC listener disabled
     std::string mode = "roundrobin";
     long threshold = 16;
     size_t chunk_size = 128;
@@ -665,6 +668,7 @@ int main(int argc, char** argv) {
             return i + 1 < argc ? argv[++i] : "";
         };
         if (a == "--port") port = atoi(next().c_str());
+        else if (a == "--extproc-port") extproc_port = atoi(next().c_str());
         else if (a == "--picker") mode = next();
         else if (a == "--threshold") threshold = atol(next().c_str());
         else if (a == "--chunk-size") chunk_size = atol(next().c_str());
@@ -679,7 +683,7 @@ int main(int argc, char** argv) {
                 if (!item.empty()) static_endpoints.push_back(item);
         } else {
             fprintf(stderr,
-                    "usage: picker_server [--port N] "
+                    "usage: picker_server [--port N] [--extproc-port N] "
                     "[--picker roundrobin|prefix|kvaware|session] "
                     "[--threshold N] "
                     "[--chunk-size N] [--lookup-timeout-ms N] [--trie-max-prompts N] "
@@ -691,6 +695,40 @@ int main(int argc, char** argv) {
 
     Picker picker(mode, threshold, chunk_size, lookup_timeout_ms,
                   trie_max_prompts);
+
+    if (extproc_port > 0) {
+        // the EPP data plane: Envoy streams ProcessingRequests here; the
+        // pod set comes from --endpoints (an EPP learns it from the
+        // InferencePool — the chart passes the engine Service's pods)
+        extproc::PickFn fn = [&picker, static_endpoints](
+                                 const std::string& body,
+                                 const std::string& session) -> std::string {
+            if (static_endpoints.empty()) return "";
+            std::string model, prompt, sess = session;
+            if (!body.empty()) {
+                json_string_field(body, "model", &model);
+                if (!json_string_field(body, "prompt", &prompt))
+                    // chat-shaped body: hash/match over the serialized
+                    // messages — stable per conversation prefix, which is
+                    // exactly what the prefix/kvaware pickers need
+                    prompt = body;
+                if (sess.empty())  // body session_key, as the HTTP /pick
+                    json_string_field(body, "session_key", &sess);
+            }
+            return picker.pick(model, prompt, static_endpoints, sess)
+                .endpoint;
+        };
+        std::thread([extproc_port, fn]() {
+            // a pod whose data plane cannot bind must crash visibly —
+            // staying up with only the HTTP port would pass readiness
+            // while Envoy's extensionRef gets connection-refused
+            if (extproc::run_server(extproc_port, fn) != 0) {
+                fprintf(stderr, "picker_server: ext-proc listener failed; "
+                                "exiting\n");
+                _exit(1);
+            }
+        }).detach();
+    }
 
     int srv = socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;

@@ -1,0 +1,534 @@
+"""Golden-frame tests for the picker's Envoy ext-proc gRPC data plane
+(native/gateway_picker/extproc.cpp — VERDICT r4 #3).
+
+A real kgateway EPP is driven by Envoy over ext-proc streaming gRPC;
+these tests ARE that client: they speak raw HTTP/2 (preface, SETTINGS,
+HEADERS with real HPACK — huffman, incremental indexing, dynamic-table
+reuse — DATA, CONTINUATION, padding) and exchange protobuf-encoded
+ProcessingRequest/ProcessingResponse messages, asserting the
+x-gateway-destination-endpoint header mutation and envoy.lb dynamic
+metadata come back exactly as the inference-extension protocol expects.
+
+The embedded HPACK huffman table is RFC 7541 Appendix B; its validity is
+asserted here via Kraft equality + the RFC's own Appendix C vectors, so
+the C++ table (generated from the same data) is pinned transitively.
+"""
+
+import socket
+import struct
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+PICKER_DIR = ROOT / "native" / "gateway_picker"
+
+# --- RFC 7541 Appendix B huffman table (code, bits) per symbol 0..256 ----
+HUFF = [
+    (0x1ff8, 13), (0x7fffd8, 23), (0xfffffe2, 28), (0xfffffe3, 28),
+    (0xfffffe4, 28), (0xfffffe5, 28), (0xfffffe6, 28), (0xfffffe7, 28),
+    (0xfffffe8, 28), (0xffffea, 24), (0x3ffffffc, 30), (0xfffffe9, 28),
+    (0xfffffea, 28), (0x3ffffffd, 30), (0xfffffeb, 28), (0xfffffec, 28),
+    (0xfffffed, 28), (0xfffffee, 28), (0xfffffef, 28), (0xffffff0, 28),
+    (0xffffff1, 28), (0xffffff2, 28), (0x3ffffffe, 30), (0xffffff3, 28),
+    (0xffffff4, 28), (0xffffff5, 28), (0xffffff6, 28), (0xffffff7, 28),
+    (0xffffff8, 28), (0xffffff9, 28), (0xffffffa, 28), (0xffffffb, 28),
+    (0x14, 6), (0x3f8, 10), (0x3f9, 10), (0xffa, 12), (0x1ff9, 13),
+    (0x15, 6), (0xf8, 8), (0x7fa, 11), (0x3fa, 10), (0x3fb, 10),
+    (0xf9, 8), (0x7fb, 11), (0xfa, 8), (0x16, 6), (0x17, 6), (0x18, 6),
+    (0x0, 5), (0x1, 5), (0x2, 5), (0x19, 6), (0x1a, 6), (0x1b, 6),
+    (0x1c, 6), (0x1d, 6), (0x1e, 6), (0x1f, 6), (0x5c, 7), (0xfb, 8),
+    (0x7ffc, 15), (0x20, 6), (0xffb, 12), (0x3fc, 10), (0x1ffa, 13),
+    (0x21, 6), (0x5d, 7), (0x5e, 7), (0x5f, 7), (0x60, 7), (0x61, 7),
+    (0x62, 7), (0x63, 7), (0x64, 7), (0x65, 7), (0x66, 7), (0x67, 7),
+    (0x68, 7), (0x69, 7), (0x6a, 7), (0x6b, 7), (0x6c, 7), (0x6d, 7),
+    (0x6e, 7), (0x6f, 7), (0x70, 7), (0x71, 7), (0x72, 7), (0xfc, 8),
+    (0x73, 7), (0xfd, 8), (0x1ffb, 13), (0x7fff0, 19), (0x1ffc, 13),
+    (0x3ffc, 14), (0x22, 6), (0x7ffd, 15), (0x3, 5), (0x23, 6), (0x4, 5),
+    (0x24, 6), (0x5, 5), (0x25, 6), (0x26, 6), (0x27, 6), (0x6, 5),
+    (0x74, 7), (0x75, 7), (0x28, 6), (0x29, 6), (0x2a, 6), (0x7, 5),
+    (0x2b, 6), (0x76, 7), (0x2c, 6), (0x8, 5), (0x9, 5), (0x2d, 6),
+    (0x77, 7), (0x78, 7), (0x79, 7), (0x7a, 7), (0x7b, 7), (0x7ffe, 15),
+    (0x7fc, 11), (0x3ffd, 14), (0x1ffd, 13), (0xffffffc, 28),
+    (0xfffe6, 20), (0x3fffd2, 22), (0xfffe7, 20), (0xfffe8, 20),
+    (0x3fffd3, 22), (0x3fffd4, 22), (0x3fffd5, 22), (0x7fffd9, 23),
+    (0x3fffd6, 22), (0x7fffda, 23), (0x7fffdb, 23), (0x7fffdc, 23),
+    (0x7fffdd, 23), (0x7fffde, 23), (0xffffeb, 24), (0x7fffdf, 23),
+    (0xffffec, 24), (0xffffed, 24), (0x3fffd7, 22), (0x7fffe0, 23),
+    (0xffffee, 24), (0x7fffe1, 23), (0x7fffe2, 23), (0x7fffe3, 23),
+    (0x7fffe4, 23), (0x1fffdc, 21), (0x3fffd8, 22), (0x7fffe5, 23),
+    (0x3fffd9, 22), (0x7fffe6, 23), (0x7fffe7, 23), (0xffffef, 24),
+    (0x3fffda, 22), (0x1fffdd, 21), (0xfffe9, 20), (0x3fffdb, 22),
+    (0x3fffdc, 22), (0x7fffe8, 23), (0x7fffe9, 23), (0x1fffde, 21),
+    (0x7fffea, 23), (0x3fffdd, 22), (0x3fffde, 22), (0xfffff0, 24),
+    (0x1fffdf, 21), (0x3fffdf, 22), (0x7fffeb, 23), (0x7fffec, 23),
+    (0x1fffe0, 21), (0x1fffe1, 21), (0x3fffe0, 22), (0x1fffe2, 21),
+    (0x7fffed, 23), (0x3fffe1, 22), (0x7fffee, 23), (0x7fffef, 23),
+    (0xfffea, 20), (0x3fffe2, 22), (0x3fffe3, 22), (0x3fffe4, 22),
+    (0x7ffff0, 23), (0x3fffe5, 22), (0x3fffe6, 22), (0x7ffff1, 23),
+    (0x3ffffe0, 26), (0x3ffffe1, 26), (0xfffeb, 20), (0x7fff1, 19),
+    (0x3fffe7, 22), (0x7ffff2, 23), (0x3fffe8, 22), (0x1ffffec, 25),
+    (0x3ffffe2, 26), (0x3ffffe3, 26), (0x3ffffe4, 26), (0x7ffffde, 27),
+    (0x7ffffdf, 27), (0x3ffffe5, 26), (0xfffff1, 24), (0x1ffffed, 25),
+    (0x7fff2, 19), (0x1fffe3, 21), (0x3ffffe6, 26), (0x7ffffe0, 27),
+    (0x7ffffe1, 27), (0x3ffffe7, 26), (0x7ffffe2, 27), (0xfffff2, 24),
+    (0x1fffe4, 21), (0x1fffe5, 21), (0x3ffffe8, 26), (0x3ffffe9, 26),
+    (0xffffffd, 28), (0x7ffffe3, 27), (0x7ffffe4, 27), (0x7ffffe5, 27),
+    (0xfffec, 20), (0xfffff3, 24), (0xfffed, 20), (0x1fffe6, 21),
+    (0x3fffe9, 22), (0x1fffe7, 21), (0x1fffe8, 21), (0x7ffff3, 23),
+    (0x3fffea, 22), (0x3fffeb, 22), (0x1ffffee, 25), (0x1ffffef, 25),
+    (0xfffff4, 24), (0xfffff5, 24), (0x3ffffea, 26), (0x7ffff4, 23),
+    (0x3ffffeb, 26), (0x7ffffe6, 27), (0x3ffffec, 26), (0x3ffffed, 26),
+    (0x7ffffe7, 27), (0x7ffffe8, 27), (0x7ffffe9, 27), (0x7ffffea, 27),
+    (0x7ffffeb, 27), (0xffffffe, 28), (0x7ffffec, 27), (0x7ffffed, 27),
+    (0x7ffffee, 27), (0x7ffffef, 27), (0x7fffff0, 27), (0x3ffffee, 26),
+    (0x3fffffff, 30),
+]
+
+
+def test_huffman_table_is_rfc7541():
+    """Kraft equality (complete prefix code) + the RFC's own encodings."""
+    assert len(HUFF) == 257
+    assert sum(2 ** -b for _, b in HUFF) == 1.0
+    vectors = {
+        "www.example.com": "f1e3c2e5f23a6ba0ab90f4ff",
+        "no-cache": "a8eb10649cbf",
+        "custom-key": "25a849e95ba97d7f",
+        "custom-value": "25a849e95bb8e8b4bf",
+        "private": "aec3771a4b",
+        "Mon, 21 Oct 2013 20:13:21 GMT":
+            "d07abe941054d444a8200595040b8166e082a62d1bff",
+        "https://www.example.com": "9d29ad171863c78f0b97c8e9ae82ae43d3",
+    }
+    for text, hexpect in vectors.items():
+        assert huff_encode(text.encode()).hex() == hexpect, text
+
+
+def huff_encode(data: bytes) -> bytes:
+    bits = ""
+    for ch in data:
+        code, n = HUFF[ch]
+        bits += format(code, f"0{n}b")
+    while len(bits) % 8:
+        bits += "1"
+    return bytes(int(bits[i:i + 8], 2) for i in range(0, len(bits), 8))
+
+
+# --- HPACK encoding ---------------------------------------------------------
+
+def hp_int(value: int, prefix: int, flags: int) -> bytes:
+    cap = (1 << prefix) - 1
+    if value < cap:
+        return bytes([flags | value])
+    out = [flags | cap]
+    value -= cap
+    while value >= 128:
+        out.append(0x80 | (value & 0x7F))
+        value >>= 7
+    out.append(value)
+    return bytes(out)
+
+
+def hp_str(s: bytes, huffman=False) -> bytes:
+    if huffman:
+        enc = huff_encode(s)
+        return hp_int(len(enc), 7, 0x80) + enc
+    return hp_int(len(s), 7, 0x00) + s
+
+
+def hp_literal(name: bytes, value: bytes, indexing=False, huffman=False):
+    """Literal header; indexing=True exercises the dynamic table."""
+    prefix = bytes([0x40]) if indexing else bytes([0x00])
+    return prefix + hp_str(name, huffman) + hp_str(value, huffman)
+
+
+def hp_indexed(index: int) -> bytes:
+    return hp_int(index, 7, 0x80)
+
+
+GRPC_PATH = b"/envoy.service.ext_proc.v3.ExternalProcessor/Process"
+
+
+def request_headers_block(path=GRPC_PATH, huffman=True, session=None):
+    block = b""
+    block += hp_indexed(3)  # :method POST (static)
+    block += hp_literal(b":scheme", b"http")
+    # exercises huffman + incremental indexing: the path enters the
+    # dynamic table on the first stream and is index-referenced later
+    block += hp_literal(b":path", path, indexing=True, huffman=huffman)
+    block += hp_literal(b":authority", b"picker")
+    block += hp_literal(b"content-type", b"application/grpc",
+                        indexing=True)
+    block += hp_literal(b"te", b"trailers", huffman=huffman)
+    if session:
+        block += hp_literal(b"x-session-id", session, huffman=huffman)
+    return block
+
+
+def reuse_headers_block(session=None):
+    """Second stream: reference the dynamic-table entries from stream 1.
+    Entry 62 is the most recent insertion (content-type), 63 the path."""
+    block = b""
+    block += hp_indexed(3)
+    block += hp_literal(b":scheme", b"http")
+    block += hp_indexed(63)  # :path (inserted first, now older)
+    block += hp_literal(b":authority", b"picker")
+    block += hp_indexed(62)  # content-type: application/grpc
+    block += hp_literal(b"te", b"trailers")
+    if session:
+        block += hp_literal(b"x-session-id", session)
+    return block
+
+
+# --- HTTP/2 framing ---------------------------------------------------------
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+(DATA, HEADERS, RST, SETTINGS, PING, GOAWAY, WINUP, CONT) = (
+    0, 1, 3, 4, 6, 7, 8, 9)
+END_STREAM, END_HEADERS, ACK, PADDED = 0x1, 0x4, 0x1, 0x8
+
+
+def frame(ftype, flags, sid, payload=b""):
+    return (struct.pack("!I", len(payload))[1:] + bytes([ftype, flags])
+            + struct.pack("!I", sid) + payload)
+
+
+# --- protobuf wire ----------------------------------------------------------
+
+def pb_varint(v):
+    out = b""
+    while v >= 128:
+        out += bytes([0x80 | (v & 0x7F)])
+        v >>= 7
+    return out + bytes([v])
+
+
+def pb_field(num, payload: bytes) -> bytes:
+    return pb_varint((num << 3) | 2) + pb_varint(len(payload)) + payload
+
+
+def pb_bool(num, v) -> bytes:
+    return pb_varint(num << 3) + pb_varint(1 if v else 0)
+
+
+def header_value(key: bytes, raw: bytes) -> bytes:
+    return pb_field(1, key) + pb_field(3, raw)
+
+
+def processing_request_headers(headers, end_of_stream=False) -> bytes:
+    hmap = b"".join(pb_field(1, header_value(k, v)) for k, v in headers)
+    http_headers = pb_field(1, hmap)
+    if end_of_stream:
+        http_headers += pb_bool(3, True)
+    return pb_field(2, http_headers)  # ProcessingRequest.request_headers
+
+
+def processing_request_body(body: bytes, end_of_stream=True) -> bytes:
+    hb = pb_field(1, body)
+    if end_of_stream:
+        hb += pb_bool(2, True)
+    return pb_field(4, hb)  # ProcessingRequest.request_body
+
+
+def grpc_msg(pb: bytes) -> bytes:
+    return b"\x00" + struct.pack("!I", len(pb)) + pb
+
+
+def pb_walk(data: bytes):
+    """Yield (field, wire, value) — value is bytes for wire 2, int else."""
+    i = 0
+    while i < len(data):
+        key = 0
+        shift = 0
+        while True:
+            b = data[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield field, wire, v
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield field, wire, data[i:i + ln]
+            i += ln
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+
+
+def extract_mutation_endpoint(resp_pb: bytes):
+    """ProcessingResponse -> (oneof field, endpoint or None, has_dyn_md)."""
+    oneof = None
+    endpoint = None
+    dyn = False
+    for f, w, v in pb_walk(resp_pb):
+        if f in (1, 3) and w == 2:
+            oneof = f
+            for f2, _, v2 in pb_walk(v):  # HeadersResponse/BodyResponse
+                if f2 != 1:
+                    continue
+                for f3, _, v3 in pb_walk(v2):  # CommonResponse
+                    if f3 != 2:
+                        continue
+                    for f4, _, v4 in pb_walk(v3):  # HeaderMutation
+                        if f4 != 1:
+                            continue
+                        for f5, _, v5 in pb_walk(v4):  # HeaderValueOption
+                            if f5 != 1:
+                                continue
+                            kv = dict(
+                                (f6, v6) for f6, _, v6 in pb_walk(v5))
+                            if kv.get(1) == b"x-gateway-destination-endpoint":
+                                endpoint = kv.get(3) or kv.get(2)
+        elif f == 8 and w == 2:
+            dyn = b"envoy.lb" in v and b"x-gateway-destination-endpoint" in v
+    return oneof, endpoint, dyn
+
+
+# --- the client -------------------------------------------------------------
+
+class H2Client:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.sock.sendall(PREFACE + frame(SETTINGS, 0, 0))
+        self.buf = b""
+
+    def send(self, raw: bytes):
+        self.sock.sendall(raw)
+
+    def read_frame(self):
+        while len(self.buf) < 9:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return None
+            self.buf += chunk
+        ln = int.from_bytes(self.buf[:3], "big")
+        ftype, flags = self.buf[3], self.buf[4]
+        sid = int.from_bytes(self.buf[5:9], "big") & 0x7FFFFFFF
+        while len(self.buf) < 9 + ln:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return None
+            self.buf += chunk
+        payload = self.buf[9:9 + ln]
+        self.buf = self.buf[9 + ln:]
+        return ftype, flags, sid, payload
+
+    def grpc_messages_until_trailers(self, sid):
+        """Collect DATA gRPC messages on sid until END_STREAM trailers;
+        returns (messages, trailer_headers_block)."""
+        msgs = []
+        databuf = b""
+        while True:
+            fr = self.read_frame()
+            assert fr is not None, "connection closed early"
+            ftype, flags, fsid, payload = fr
+            if ftype == SETTINGS and not flags & ACK:
+                self.send(frame(SETTINGS, ACK, 0))
+                continue
+            if ftype in (SETTINGS, WINUP, PING):
+                continue
+            if fsid != sid:
+                continue
+            if ftype == DATA:
+                databuf += payload
+                while len(databuf) >= 5:
+                    mlen = int.from_bytes(databuf[1:5], "big")
+                    if len(databuf) < 5 + mlen:
+                        break
+                    msgs.append(databuf[5:5 + mlen])
+                    databuf = databuf[5 + mlen:]
+            elif ftype == HEADERS and flags & END_STREAM:
+                return msgs, payload
+            # initial response HEADERS (no END_STREAM): keep reading
+
+    def close(self):
+        self.sock.close()
+
+
+def trailer_status(block: bytes) -> int:
+    """Our server encodes trailers as literal-without-indexing plain
+    strings; parse just that."""
+    i = 0
+    headers = {}
+    while i < len(block):
+        assert block[i] == 0
+        i += 1
+        nlen = block[i] & 0x7F
+        i += 1
+        name = block[i:i + nlen]
+        i += nlen
+        vlen = block[i] & 0x7F
+        i += 1
+        headers[name] = block[i:i + vlen]
+        i += vlen
+    return int(headers[b"grpc-status"])
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def picker():
+    subprocess.run(["make", "-C", str(PICKER_DIR)], check=True,
+                   capture_output=True)
+    http_port, ep_port = free_port(), free_port()
+    proc = subprocess.Popen(
+        [str(PICKER_DIR / "picker_server"),
+         "--port", str(http_port), "--extproc-port", str(ep_port),
+         "--picker", "session",
+         "--endpoints", "http://pod-a:8000,http://pod-b:8000"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # wait for the extproc listener
+    for _ in range(100):
+        try:
+            socket.create_connection(("127.0.0.1", ep_port),
+                                     timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        proc.kill()
+        raise RuntimeError("extproc listener did not come up")
+    yield ep_port
+    proc.kill()
+    proc.wait()
+
+
+def run_stream(client, sid, headers_block, body: bytes, padded=False):
+    client.send(frame(HEADERS, END_HEADERS, sid, headers_block))
+    client.send(frame(
+        DATA, 0, sid,
+        grpc_msg(processing_request_headers(
+            [(b":method", b"POST"), (b"x-session-id", b"sess-1")]))))
+    body_frame = grpc_msg(processing_request_body(body))
+    if padded:
+        pad = 7
+        client.send(frame(DATA, END_STREAM | PADDED, sid,
+                          bytes([pad]) + body_frame + b"\x00" * pad))
+    else:
+        client.send(frame(DATA, END_STREAM, sid, body_frame))
+    msgs, trailers = client.grpc_messages_until_trailers(sid)
+    assert trailer_status(trailers) == 0
+    return msgs
+
+
+def test_extproc_full_exchange_and_session_affinity(picker):
+    client = H2Client(picker)
+    try:
+        body = b'{"model": "llama", "prompt": "hello world"}'
+        # stream 1: huffman + incremental-indexing HPACK
+        msgs = run_stream(
+            client, 1, request_headers_block(session=b"sess-1"), body)
+        assert len(msgs) == 2  # HeadersResponse (empty), BodyResponse
+        oneof, ep, dyn = extract_mutation_endpoint(msgs[0])
+        assert oneof == 1 and ep is None  # headers: wait for body
+        oneof, ep, dyn = extract_mutation_endpoint(msgs[1])
+        assert oneof == 3
+        assert ep in (b"http://pod-a:8000", b"http://pod-b:8000")
+        assert dyn  # envoy.lb dynamic metadata present
+        # stream 3: indexed HPACK from stream 1's dynamic table, padded
+        # DATA frame; same session key -> same endpoint
+        msgs3 = run_stream(client, 3, reuse_headers_block(session=b"sess-1"),
+                           body, padded=True)
+        _, ep3, _ = extract_mutation_endpoint(msgs3[1])
+        assert ep3 == ep, "session affinity across streams"
+    finally:
+        client.close()
+
+
+def test_extproc_bodyless_request_picks_on_headers(picker):
+    client = H2Client(picker)
+    try:
+        client.send(frame(HEADERS, END_HEADERS, 1,
+                          request_headers_block(session=b"s2")))
+        client.send(frame(
+            DATA, END_STREAM, 1,
+            grpc_msg(processing_request_headers(
+                [(b"x-session-id", b"s2")], end_of_stream=True))))
+        msgs, trailers = client.grpc_messages_until_trailers(1)
+        assert trailer_status(trailers) == 0
+        oneof, ep, dyn = extract_mutation_endpoint(msgs[0])
+        assert oneof == 1 and ep is not None and dyn
+    finally:
+        client.close()
+
+
+def test_extproc_unknown_method_unimplemented(picker):
+    client = H2Client(picker)
+    try:
+        client.send(frame(HEADERS, END_HEADERS | END_STREAM, 1,
+                          request_headers_block(path=b"/foo/Bar")))
+        msgs, trailers = client.grpc_messages_until_trailers(1)
+        assert msgs == []
+        assert trailer_status(trailers) == 12  # UNIMPLEMENTED
+    finally:
+        client.close()
+
+
+def test_extproc_malformed_message_clean_grpc_error(picker):
+    client = H2Client(picker)
+    try:
+        client.send(frame(HEADERS, END_HEADERS, 1, request_headers_block()))
+        # truncated varint: promises field 2 with length 100, sends 1 byte
+        bad = b"\x12\x64\x01"
+        client.send(frame(DATA, END_STREAM, 1, grpc_msg(bad)))
+        msgs, trailers = client.grpc_messages_until_trailers(1)
+        assert trailer_status(trailers) == 3  # INVALID_ARGUMENT, not a stall
+    finally:
+        client.close()
+
+
+def test_extproc_ping_and_continuation(picker):
+    client = H2Client(picker)
+    try:
+        # ping gets acked
+        client.send(frame(PING, 0, 0, b"12345678"))
+        while True:
+            ftype, flags, sid, payload = client.read_frame()
+            if ftype == SETTINGS and not flags & ACK:
+                client.send(frame(SETTINGS, ACK, 0))
+            if ftype == PING:
+                assert flags & ACK and payload == b"12345678"
+                break
+        # header block split across HEADERS + CONTINUATION
+        block = request_headers_block(session=b"s3")
+        cut = len(block) // 2
+        client.send(frame(HEADERS, 0, 1, block[:cut]))
+        client.send(frame(CONT, END_HEADERS, 1, block[cut:]))
+        client.send(frame(
+            DATA, END_STREAM, 1,
+            grpc_msg(processing_request_headers(
+                [(b"x-session-id", b"s3")], end_of_stream=True))))
+        msgs, trailers = client.grpc_messages_until_trailers(1)
+        assert trailer_status(trailers) == 0
+        _, ep, _ = extract_mutation_endpoint(msgs[0])
+        assert ep is not None
+    finally:
+        client.close()

@@ -170,3 +170,76 @@ def test_picker_tsan_concurrent_picks():
         proc.kill()
         stderr = proc.stderr.read() or ""
     assert "WARNING: ThreadSanitizer" not in stderr, stderr[-1200:]
+
+
+def test_picker_extproc_tsan_concurrent_streams():
+    """The ext-proc gRPC listener under TSan: multiple HTTP/2 connections
+    concurrently streaming ProcessingRequests (per-connection threads
+    share the Picker's trie/ring/counters with the HTTP path)."""
+    import socket
+    import threading
+    import time
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_gateway_extproc import (
+        H2Client,
+        extract_mutation_endpoint,
+        request_headers_block,
+        run_stream,
+    )
+
+    d = build("gateway_picker", "tsan")
+    binary = os.path.join(d, "picker_server_tsan")
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    env = dict(os.environ, TSAN_OPTIONS="exitcode=66,halt_on_error=1")
+    proc = subprocess.Popen(
+        [binary, "--port", str(ports[0]), "--extproc-port", str(ports[1]),
+         "--picker", "prefix", "--chunk-size", "8",
+         "--endpoints", "http://a:1,http://b:1"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        up = False
+        for _ in range(200):
+            try:
+                socket.create_connection(("127.0.0.1", ports[1]),
+                                         timeout=0.5).close()
+                up = True
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert up, "extproc listener never came up under TSan"
+
+        errors = []
+
+        def client(cid):
+            try:
+                c = H2Client(ports[1])
+                for i in range(10):
+                    body = (f'{{"model": "m", "prompt": '
+                            f'"shared {cid % 2} tail {i}"}}').encode()
+                    msgs = run_stream(c, 1 + 2 * i,
+                                      request_headers_block(), body)
+                    _, ep, _ = extract_mutation_endpoint(msgs[-1])
+                    assert ep in (b"http://a:1", b"http://b:1")
+                c.close()
+            except Exception as e:
+                errors.append(f"client {cid}: {e}")
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errors, errors
+        assert proc.poll() is None, (
+            "picker died under TSan: " + (proc.stderr.read() or "")[-800:]
+        )
+    finally:
+        proc.kill()
+        stderr = proc.stderr.read() or ""
+    assert "WARNING: ThreadSanitizer" not in stderr, stderr[-1200:]
