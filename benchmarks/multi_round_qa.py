@@ -3,22 +3,39 @@
 
 The reference stack's headline benchmark methodology
 (benchmarks/multi-round-qa/ there; metric definitions in its README §
-"Benchmark Metrics"): simulated users hold multi-round conversations — a
-shared system prompt plus per-user chat history that regrows every round —
-against an OpenAI-compatible endpoint at a controlled arrival QPS. Because
-each round replays the conversation so far, the workload is dominated by
-prefix reuse: it is exactly the shape KV caching, prefix-aware routing and
-KV offload exist to accelerate.
+"Benchmark Metrics"; workload shape in its run.sh: warmup 400 users,
+system prompt 1000 tok, per-user history 20000 tok, answer 100 tok,
+320 users x 10 rounds, QPS sweep 0.1→4.1): simulated users hold
+multi-round conversations — a shared system prompt plus per-user chat
+history — against an OpenAI-compatible endpoint at a controlled arrival
+QPS. Because each round replays the conversation so far, the workload is
+dominated by prefix reuse: exactly the shape KV caching, prefix-aware
+routing and KV offload exist to accelerate.
 
-Reports: actual QPS, average prompt throughput (tok/s), average generation
-throughput (tok/s), average TTFT — plus p50/p99 TTFT.
+Execution model mirrors the reference harness (multi-round-qa.py there):
 
-Dependency-free (aiohttp only), so it runs inside the engine/router images.
+- OPEN loop when ``--time`` is given: each user fires a round every
+  ``num_users / qps`` seconds regardless of completion latency; new
+  users join every ``session_alive_time / num_users`` seconds; the
+  initial cohort is RAMPED — users start with staggered virtual offsets
+  so round arrivals spread uniformly instead of stampeding at t=0.
+- CLOSED cohort without ``--time`` (CI mode): a fixed set of users runs
+  ``num_rounds`` each and the run ends — deterministic request counts.
+- ``--warmup-users N`` reproduces run.sh's warmup phase (there: a
+  separate single-user invocation for N/2 seconds): N sequential
+  2-round single-user sessions that populate the KV/offload tiers,
+  excluded from the measured summary.
 
-Usage:
-  python benchmarks/multi_round_qa.py --base-url http://localhost:8001 \
-      --model tiny-llama --num-users 32 --num-rounds 5 --qps 2 \
-      --system-prompt-len 1000 --user-history-len 2000 --answer-len 100
+Flag-compatible with the reference CLI (its spellings are accepted as
+aliases: --shared-system-prompt / --user-history-prompt / --time /
+--init-user-id / --request-with-user-id / --log-interval).
+
+Reports the reference metric list — actual QPS, average prompt
+throughput (tok/s), average generation throughput (tok/s), average
+TTFT — plus p50/p99 TTFT, latency, and a per-round breakdown.
+
+Dependency-free (aiohttp only), so it runs inside the engine/router
+images.
 """
 
 from __future__ import annotations
@@ -51,6 +68,8 @@ class UserSession:
                                                    seed=uid + 1)}
         ]
         self.round = 0
+        self.last_fire = None  # perf_counter of last round launch
+        self.in_flight = False
 
     def next_messages(self) -> list[dict]:
         self.round += 1
@@ -63,9 +82,17 @@ class UserSession:
     def record_answer(self, text: str) -> None:
         self.history.append({"role": "assistant", "content": text})
 
+    @property
+    def finished(self) -> bool:
+        return self.round >= self.args.num_rounds and not self.in_flight
+
 
 async def one_request(session, args, user: UserSession, results: list):
     messages = user.next_messages()
+    user.in_flight = True
+    headers = {}
+    if args.request_with_user_id:
+        headers["x-user-id"] = f"user-{user.uid}"
     t0 = time.perf_counter()
     ttft = None
     n_out = 0
@@ -77,7 +104,7 @@ async def one_request(session, args, user: UserSession, results: list):
             json={"model": args.model, "messages": messages,
                   "max_tokens": args.answer_len, "temperature": 0.0,
                   "stream": True, "ignore_eos": True},
-            headers={"x-user-id": f"user-{user.uid}"},
+            headers=headers,
             timeout=aiohttp.ClientTimeout(total=args.request_timeout),
         ) as resp:
             if resp.status != 200:
@@ -104,63 +131,166 @@ async def one_request(session, args, user: UserSession, results: list):
     except Exception as e:
         results.append({"ok": False, "error": str(e)})
         return
+    finally:
+        user.in_flight = False
     elapsed = time.perf_counter() - t0
     user.record_answer("".join(text_parts))
     results.append({
         "ok": True, "ttft": ttft if ttft is not None else elapsed,
         "elapsed": elapsed,
+        "launch": t0,
+        "round": user.round,
+        "user": user.uid,
         "prompt_tokens": n_prompt or sum(len(m["content"].split()) for m in messages),
         "output_tokens": n_out or args.answer_len,
     })
 
 
-async def run(args) -> dict:
-    users = [UserSession(i, args) for i in range(args.num_users)]
-    results: list[dict] = []
-    tasks = []
-    interval = 1.0 / args.qps if args.qps > 0 else 0
-    t_start = time.perf_counter()
-    deadline = t_start + args.duration if args.duration else None
-
-    async with aiohttp.ClientSession() as session:
-        sent = 0
-        per_user_rounds = {u.uid: 0 for u in users}
-        while True:
-            candidates = [u for u in users if per_user_rounds[u.uid] < args.num_rounds]
-            if not candidates:
-                break
-            if deadline and time.perf_counter() > deadline:
-                break
-            user = random.choice(candidates)
-            per_user_rounds[user.uid] += 1
-            tasks.append(asyncio.create_task(
-                one_request(session, args, user, results)
-            ))
-            sent += 1
-            if interval:
-                await asyncio.sleep(interval)
-        await asyncio.gather(*tasks)
-    wall = time.perf_counter() - t_start
-
+def summarize(results: list[dict], wall: float) -> dict:
     ok = [r for r in results if r.get("ok")]
     failed = len(results) - len(ok)
     ttfts = sorted(r["ttft"] for r in ok) or [0.0]
-    summary = {
+    rounds: dict[int, list] = {}
+    for r in ok:
+        rounds.setdefault(r.get("round", 0), []).append(r)
+    per_round = [
+        {
+            "round": rd,
+            "requests": len(rs),
+            "avg_ttft_s": round(statistics.mean(x["ttft"] for x in rs), 4),
+            "avg_latency_s": round(
+                statistics.mean(x["elapsed"] for x in rs), 4),
+            "avg_prompt_tokens": round(
+                statistics.mean(x["prompt_tokens"] for x in rs), 1),
+        }
+        for rd, rs in sorted(rounds.items())
+    ]
+    return {
         "requests": len(results),
         "failed": failed,
-        "actual_qps": round(len(ok) / wall, 3),
+        "actual_qps": round(len(ok) / wall, 3) if wall else 0.0,
         "avg_prompt_throughput_tok_s": round(
-            sum(r["prompt_tokens"] for r in ok) / wall, 1),
+            sum(r["prompt_tokens"] for r in ok) / wall, 1) if wall else 0.0,
         "avg_generation_throughput_tok_s": round(
-            sum(r["output_tokens"] for r in ok) / wall, 1),
+            sum(r["output_tokens"] for r in ok) / wall, 1) if wall else 0.0,
         "avg_ttft_s": round(statistics.mean(ttfts), 4),
         "p50_ttft_s": round(ttfts[len(ttfts) // 2], 4),
         "p99_ttft_s": round(ttfts[min(int(len(ttfts) * 0.99), len(ttfts) - 1)], 4),
         "avg_latency_s": round(statistics.mean(r["elapsed"] for r in ok), 4)
         if ok else 0.0,
         "wall_s": round(wall, 2),
+        "rounds": per_round,
     }
-    return summary
+
+
+async def run_warmup(session, args) -> int:
+    """run.sh's warmup phase: sequential single-user 2-round sessions that
+    push per-user KV into the cache/offload tiers before measurement."""
+    n = args.warmup_users
+    done = 0
+    sink: list[dict] = []
+    warm_args = argparse.Namespace(**vars(args))
+    warm_args.num_rounds = 2
+    t0 = time.perf_counter()
+    for i in range(n):
+        user = UserSession(args.init_user_id + 1_000_000 + i, warm_args)
+        for _ in range(2):
+            await one_request(session, warm_args, user, sink)
+        done += 1
+        if args.warmup_time and time.perf_counter() - t0 > args.warmup_time:
+            break
+    return done
+
+
+async def run(args) -> dict:
+    results: list[dict] = []
+    tasks: list[asyncio.Task] = []
+    open_loop = args.duration is not None
+    # reference pacing: each user fires every num_users/qps seconds; the
+    # whole population therefore arrives at `qps`
+    user_gap = args.num_users / args.qps if args.qps > 0 else 0.0
+    session_alive = user_gap * max(args.num_rounds - 1, 1)
+    join_gap = session_alive / max(args.num_users, 1)
+
+    async with aiohttp.ClientSession() as session:
+        if args.warmup_users:
+            warmed = await run_warmup(session, args)
+            print(f"warmup: {warmed} users x 2 rounds done", flush=True)
+
+        t_start = time.perf_counter()
+        deadline = t_start + args.duration if open_loop else None
+        next_uid = args.init_user_id
+        users: list[UserSession] = []
+
+        def new_user(offset: float = 0.0) -> UserSession:
+            nonlocal next_uid
+            u = UserSession(next_uid, args)
+            next_uid += 1
+            # ramp-up (reference _ramp_up): the offset is the user's
+            # VIRTUAL elapsed session time — rounds that "already
+            # happened" are materialised as synthetic history (so prompt
+            # lengths match the round number) and the user retires that
+            # much sooner. This staggers the initial cohort's retirement
+            # across a full session lifetime; joins then replace
+            # retirees 1:1, keeping the population at num_users and the
+            # arrival rate at qps (a cohort staggered only within one
+            # round gap would retire together while joins kept adding —
+            # ~2x the target arrival rate; r5 review).
+            done = int(offset // user_gap) if user_gap else 0
+            for _ in range(min(done, args.num_rounds - 1)):
+                u.next_messages()
+                u.record_answer(lorem(args.answer_len,
+                                      seed=u.uid * 31 + u.round))
+            u.last_fire = time.perf_counter() - (
+                offset % user_gap if user_gap else 0.0)
+            users.append(u)
+            return u
+
+        # initial ramped cohort
+        for i in range(args.num_users):
+            if open_loop:
+                offset = session_alive - i * join_gap
+                if offset < 0:
+                    break
+            else:
+                # closed cohort: stagger arrivals within one round gap,
+                # no virtual rounds (request counts stay deterministic)
+                offset = user_gap * i / max(args.num_users, 1)
+            new_user(offset=offset)
+        last_join = t_start
+        last_log = t_start
+
+        while True:
+            now = time.perf_counter()
+            if deadline and now > deadline:
+                break
+            if open_loop and now - last_join > join_gap:
+                new_user()
+                last_join = now
+            fired_any = False
+            for u in list(users):
+                if u.finished:
+                    users.remove(u)
+                    continue
+                if u.round >= args.num_rounds or u.in_flight:
+                    continue
+                if u.last_fire is None or now - u.last_fire >= user_gap:
+                    u.last_fire = now
+                    tasks.append(asyncio.create_task(
+                        one_request(session, args, u, results)))
+                    fired_any = True
+            if not open_loop and not users:
+                break
+            if args.log_interval and now - last_log > args.log_interval:
+                last_log = now
+                print(json.dumps({"t": round(now - t_start, 1),
+                                  **summarize(results, now - t_start)}),
+                      flush=True)
+            await asyncio.sleep(0.0 if fired_any else 0.01)
+        if tasks:
+            await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t_start
+    return summarize(results, wall)
 
 
 def main(argv=None):
@@ -170,11 +300,31 @@ def main(argv=None):
     p.add_argument("--num-users", type=int, default=32)
     p.add_argument("--num-rounds", type=int, default=5)
     p.add_argument("--qps", type=float, default=2.0)
-    p.add_argument("--system-prompt-len", type=int, default=1000)
-    p.add_argument("--user-history-len", type=int, default=2000)
+    p.add_argument("--system-prompt-len", "--shared-system-prompt",
+                   dest="system_prompt_len", type=int, default=1000)
+    p.add_argument("--user-history-len", "--user-history-prompt",
+                   dest="user_history_len", type=int, default=2000)
     p.add_argument("--answer-len", type=int, default=100)
-    p.add_argument("--duration", type=float, default=None,
-                   help="optional wall-clock cap in seconds")
+    p.add_argument("--duration", "--time", dest="duration", type=float,
+                   default=None,
+                   help="wall-clock cap in seconds; given -> open-loop "
+                        "reference pacing (users keep joining), absent -> "
+                        "closed cohort (deterministic request count)")
+    p.add_argument("--init-user-id", type=int, default=0)
+    p.add_argument("--request-with-user-id", action="store_true",
+                   default=True,
+                   help="send x-user-id headers (session routing); the "
+                        "reference flag spelling, on by default here")
+    p.add_argument("--no-request-with-user-id", dest="request_with_user_id",
+                   action="store_false")
+    p.add_argument("--log-interval", type=float, default=0.0,
+                   help="seconds between rolling summary lines (0 = off)")
+    p.add_argument("--warmup-users", type=int, default=0,
+                   help="run.sh warmup phase: N sequential 2-round "
+                        "single-user sessions before measuring "
+                        "(reference NUM_USERS_WARMUP=400)")
+    p.add_argument("--warmup-time", type=float, default=None,
+                   help="cap the warmup phase wall clock")
     p.add_argument("--request-timeout", type=float, default=300.0)
     p.add_argument("--output", default=None, help="write summary JSON here")
     p.add_argument("--qps-sweep", default=None,
@@ -190,12 +340,15 @@ def main(argv=None):
         if not sweep_values:
             p.error("--qps-sweep has no values")
         points = []
+        warmup_once = args.warmup_users
         for qps in sweep_values:
             args.qps = qps
             point = asyncio.run(run(args))
+            args.warmup_users = 0  # warm tiers persist across the sweep
             point["qps_target"] = qps
             points.append(point)
             print(json.dumps(point))
+        args.warmup_users = warmup_once
         summary = {"sweep": points}
     else:
         summary = asyncio.run(run(args))

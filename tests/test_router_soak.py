@@ -1,0 +1,116 @@
+"""Sustained router load gate (VERDICT r4 #6) — the reference's CI soak
+(.github/workflows/router-e2e-test.yml:51-71 there: 10 QPS x 32 workers x
+300 s against 4 fake engines) as an opt-in pytest tier.
+
+Opt-in because 300 s has no place in the unit-test loop:
+    PSTPU_SOAK=1 python -m pytest tests/test_router_soak.py -q
+CI runs it as its own job (router-soak in .github/workflows/test.yml).
+PSTPU_SOAK_DURATION overrides the wall clock for local shakedowns.
+
+Asserts zero errors AND flat memory: RSS sampled after a warm-in window
+must not grow more than PSTPU_SOAK_RSS_MB (default 64 MB) by the end —
+the leak/drift class the short perftest tier cannot see.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PSTPU_SOAK") != "1",
+    reason="sustained soak is opt-in: set PSTPU_SOAK=1",
+)
+
+DURATION = float(os.environ.get("PSTPU_SOAK_DURATION", "300"))
+QPS = float(os.environ.get("PSTPU_SOAK_QPS", "10"))
+WORKERS = int(os.environ.get("PSTPU_SOAK_WORKERS", "32"))
+RSS_BUDGET_MB = float(os.environ.get("PSTPU_SOAK_RSS_MB", "64"))
+
+
+def _rss_mb() -> float:
+    import psutil
+
+    return psutil.Process().memory_info().rss / 1e6
+
+
+def test_router_soak_zero_errors_flat_memory():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import RouterApp, build_parser
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def main():
+        servers, urls = [], []
+        for _ in range(4):
+            fe = FakeEngine(model="fake-model", tokens_per_second=500,
+                            ttft=0.002)
+            ts = TestServer(fe.build_app())
+            await ts.start_server()
+            servers.append(ts)
+            urls.append(f"http://127.0.0.1:{ts.port}")
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake-model"] * 4),
+            "--routing-logic", "roundrobin",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+
+        stats = {"ok": 0, "errors": []}
+        sem = asyncio.Semaphore(WORKERS)
+        inflight: set = set()
+
+        async def one(i):
+            async with sem:
+                try:
+                    r = await client.post(
+                        "/v1/completions",
+                        json={"model": "fake-model", "prompt": f"soak {i}",
+                              "max_tokens": 50, "stream": True},
+                    )
+                    body = await r.text()
+                    assert r.status == 200 and "data: [DONE]" in body
+                    stats["ok"] += 1
+                except Exception as e:  # any failure is a gate failure
+                    stats["errors"].append(f"req {i}: {e!r}")
+
+        t0 = time.monotonic()
+        warm_rss = None
+        warm_at = min(60.0, DURATION / 5)
+        i = 0
+        interval = 1.0 / QPS
+        try:
+            while time.monotonic() - t0 < DURATION:
+                task = asyncio.create_task(one(i))
+                inflight.add(task)
+                task.add_done_callback(inflight.discard)
+                i += 1
+                if warm_rss is None and time.monotonic() - t0 >= warm_at:
+                    warm_rss = _rss_mb()
+                await asyncio.sleep(interval)
+            if inflight:
+                await asyncio.wait(inflight, timeout=60)
+        finally:
+            await client.close()
+            for ts in servers:
+                await ts.close()
+        end_rss = _rss_mb()
+        assert not stats["errors"], stats["errors"][:10]
+        expected = DURATION * QPS
+        assert stats["ok"] >= expected * 0.95, (
+            f"only {stats['ok']}/{expected:.0f} requests completed"
+        )
+        assert warm_rss is not None
+        growth = end_rss - warm_rss
+        assert growth < RSS_BUDGET_MB, (
+            f"RSS grew {growth:.1f} MB over the soak "
+            f"(warm {warm_rss:.1f} -> end {end_rss:.1f})"
+        )
+        print(f"soak: {stats['ok']} requests, rss {warm_rss:.1f}"
+              f"->{end_rss:.1f} MB over {DURATION:.0f}s")
+
+    asyncio.run(main())
