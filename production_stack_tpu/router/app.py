@@ -128,6 +128,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--semantic-cache-embedding-model", default=None,
                    help="model name for the engine encoder's /v1/embeddings"
                         " calls (default: the backend's first model)")
+    p.add_argument("--pii-analyzer", default="regex",
+                   choices=["regex", "ner", "presidio"],
+                   help="'regex' = dependency-free pattern tier; 'ner' ="
+                        " entity tier (presidio if installed, else the"
+                        " built-in heuristic PERSON/ADDRESS detector);"
+                        " 'presidio' requires the package")
+    p.add_argument("--pii-action", default="block",
+                   choices=["block", "redact"])
     p.add_argument("--otel-endpoint", default=None,
                    help="OTLP gRPC endpoint; W3C propagation is always on")
     p.add_argument("--otel-service-name", default="tpu-router")
@@ -313,9 +321,16 @@ class RouterApp:
             )
             self.request_service.post_response = self.semantic_cache.store
         if gates.enabled("PIIDetection"):
-            from production_stack_tpu.router.experimental.pii import PIIMiddleware
+            from production_stack_tpu.router.experimental.pii import (
+                PIIMiddleware,
+                make_analyzer,
+            )
 
-            self.pii_middleware = PIIMiddleware()
+            self.pii_middleware = PIIMiddleware(
+                action=getattr(args, "pii_action", "block"),
+                analyzer=make_analyzer(
+                    getattr(args, "pii_analyzer", "regex")),
+            )
 
     # -- app --------------------------------------------------------------
     # endpoints that must stay reachable without a key (probes + scraping)

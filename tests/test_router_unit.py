@@ -177,6 +177,63 @@ def test_pii_regex_analyzer():
     assert "bob@example.com" not in red
 
 
+def test_pii_ner_tier_catches_entity_pii():
+    """VERDICT r4 #8: the NER tier must catch entity PII (names,
+    addresses) the regex tier passes through — across paraphrases."""
+    from production_stack_tpu.router.experimental.pii import make_analyzer
+
+    a = make_analyzer("ner")  # presidio absent -> heuristic entity tier
+    name_paraphrases = [
+        "Hi, my name is Maria Gonzalez and I need help.",
+        "I'm Jonathan Smithers, here about my account.",
+        "Please forward this to Dr. Elena Vasquez today.",
+        "Regards, Tom Atkinson",
+        "From: Priya Natarajan",
+        "the patient Robert Oldfield was admitted yesterday",
+    ]
+    for text in name_paraphrases:
+        kinds = {m.kind for m in a.analyze(text)}
+        assert "PERSON" in kinds, text
+        red = a.redact(text)
+        assert "[PERSON]" in red, red
+    address_paraphrases = [
+        "ship it to 742 Evergreen Terrace, Springfield, IL 62704",
+        "I live at 1600 Pennsylvania Avenue",
+        "mail goes to P.O. Box 1234 as usual",
+        "our office: 88 Market Street, Suite 400",
+    ]
+    for text in address_paraphrases:
+        kinds = {m.kind for m in a.analyze(text)}
+        assert "ADDRESS" in kinds, text
+        assert "[ADDRESS]" in a.redact(text), text
+    # the regex tier (default) does NOT flag these — NER is additive
+    regex = make_analyzer("regex")
+    assert not regex.analyze("Hi, my name is Maria Gonzalez.")
+    # precision: ordinary TitleCase must not trip the entity tier
+    clean = [
+        "The New York office uses Machine Learning on Monday mornings.",
+        "Please review the January report before Tuesday.",
+        "This is a test of the system.",  # "this is" + lowercase
+    ]
+    for text in clean:
+        assert not [m for m in a.analyze(text) if m.kind == "PERSON"], text
+    # the NER tier is a superset of the regex tier
+    assert {m.kind for m in a.analyze("reach bob@example.com")} >= {"EMAIL"}
+    # case-insensitivity is scoped to the cue words, not the name group
+    # (r5 review: "thanks, everyone for joining" must not be a PERSON)
+    for text in ("Thanks, everyone for joining today.",
+                 "cc: all hands meeting notes"):
+        assert not [m for m in a.analyze(text) if m.kind == "PERSON"], text
+    # ... while lowercase cues with TitleCase names still match
+    assert any(m.kind == "PERSON"
+               for m in a.analyze("thanks, Maria Gonzalez"))
+    # the kinds filter applies to the composed regex tier too
+    person_only = make_analyzer("ner", kinds={"PERSON"})
+    assert not [m for m in person_only.analyze("reach bob@example.com")
+                if m.kind == "EMAIL"]
+    assert person_only.redact("bob@example.com") == "bob@example.com"
+
+
 def test_semantic_cache_hit_and_threshold():
     cache = SemanticCache(threshold=0.95)
     body = {"model": "m", "messages": [{"role": "user", "content":
