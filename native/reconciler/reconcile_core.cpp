@@ -19,6 +19,7 @@
 //   int rc_subset_drifted(const char* desired_json, const char* live_json)
 //     returns 1 = drift, 0 = no drift, -1 = parse error.
 
+#include <algorithm>
 #include <cctype>
 #include <cmath>
 #include <cstdlib>
@@ -822,6 +823,119 @@ ValuePtr build_cache_server_deployment(const Value& cr,
     return dep;
 }
 
+// ---------------------------------------------------------------------------
+// reconcile decisions (VERDICT r4 #10: the desired-state diff -> action
+// list moves next to drift + manifests; Python stays transport-only)
+// ---------------------------------------------------------------------------
+
+// Ready/Updating/NotReady/Unknown mapping (parity with controller.py
+// _model_status; reference vllmruntime_controller.go:1110-1121)
+std::string model_status(const Value* live_deploy, double want) {
+    double avail = 0, unavail = 0, updated = 0;
+    if (live_deploy) {
+        const Value* st = get(*live_deploy, "status");
+        if (st) {
+            const Value* v;
+            if ((v = get(*st, "availableReplicas"))) avail = v->num;
+            if ((v = get(*st, "unavailableReplicas"))) unavail = v->num;
+            if ((v = get(*st, "updatedReplicas"))) updated = v->num;
+        }
+    }
+    if (avail == want && unavail == 0) return "Ready";
+    if (updated > 0 && (avail != want || unavail > 0)) return "Updating";
+    if (unavail > 0) return "NotReady";
+    return "Unknown";
+}
+
+// Action list for one TPURuntime CR given the observed live state:
+// which children to ensure (ordered), whether to delete a leftover
+// ScaledObject, and the status to write (parity with controller.py
+// reconcile_runtime's decisions).
+ValuePtr runtime_actions(const Value& cr, const Value* live_deploy,
+                         bool scaledobject_exists) {
+    const Value* spec = get(cr, "spec");
+    auto ensure = mk(Value::Arr);
+    ensure->arr.push_back(S("deployment"));
+    ensure->arr.push_back(S("service"));
+    if (spec && present_truthy(*spec, "pvcStorage"))
+        ensure->arr.push_back(S("pvc"));
+    const Value* au = spec ? get(*spec, "autoscaling") : nullptr;
+    bool au_truthy = au && au->kind == Value::Obj && !au->obj.empty();
+    bool au_enabled = au_truthy;
+    if (au_truthy) {
+        // Python parity: `autoscaling.get("enabled", True)` under
+        // truthiness — a PRESENT key (any type, including explicit
+        // null, 0, "") overrides the default with its truthiness, so
+        // use raw map lookup (get() hides null) + present_truthy
+        auto it = au->obj.find("enabled");
+        if (it != au->obj.end()) au_enabled = present_truthy(*au, "enabled");
+    }
+    bool del_scaled = false;
+    if (au_enabled) {
+        ensure->arr.push_back(S("scaledobject"));
+    } else if (scaledobject_exists) {
+        del_scaled = true;
+    }
+    double want = 1;
+    if (spec) {
+        const Value* r = get(*spec, "replicas");
+        if (r && r->kind == Value::Num) want = r->num;
+    }
+    auto status = mk(Value::Obj);
+    status->obj["replicas"] = N(want);
+    const char* fields[3] = {"availableReplicas", "updatedReplicas",
+                             "unavailableReplicas"};
+    const Value* st =
+        live_deploy ? get(*live_deploy, "status") : nullptr;
+    for (const char* f : fields) {
+        const Value* v = st ? get(*st, f) : nullptr;
+        status->obj[f] = N(v && v->kind == Value::Num ? v->num : 0);
+    }
+    std::string name = get_str(*get(cr, "metadata"), "name");
+    status->obj["selector"] = S(std::string(GROUP) + "/model=" + name);
+    status->obj["modelStatus"] = S(model_status(live_deploy, want));
+    status->obj["state"] = S("Reconciled");
+    auto out = mk(Value::Obj);
+    out->obj["ensure"] = std::move(ensure);
+    out->obj["delete_scaledobject"] = B(del_scaled);
+    out->obj["status"] = std::move(status);
+    return out;
+}
+
+// LoRA placement (parity with controller.py _place; reference
+// getOptimalPlacement, loraadapter_controller.go:360): default = every
+// ready pod (or first N when replicas set); ordered = first N by name;
+// equalized = N pods with the fewest loaded adapters (name tiebreak).
+ValuePtr place_lora(const Value& pods, const std::string& algorithm,
+                    long replicas, const Value* counts) {
+    std::vector<std::string> names;
+    for (const auto& e : pods.arr)
+        if (e->kind == Value::Str) names.push_back(e->str);
+    std::sort(names.begin(), names.end());
+    size_t n = replicas > 0 ? std::min((size_t)replicas, names.size())
+                            : names.size();
+    if (algorithm == "equalized") {
+        std::stable_sort(names.begin(), names.end(),
+                         [&](const std::string& a, const std::string& b) {
+                             auto cnt = [&](const std::string& x) -> double {
+                                 const Value* v =
+                                     counts ? get(*counts, x) : nullptr;
+                                 return v && v->kind == Value::Num ? v->num
+                                                                   : 0;
+                             };
+                             double ca = cnt(a), cb = cnt(b);
+                             if (ca != cb) return ca < cb;
+                             return a < b;
+                         });
+    }
+    // "ordered" and "default" both take the (sorted) prefix; they differ
+    // only in that default-without-replicas keeps everything — already
+    // encoded in n
+    auto out = mk(Value::Arr);
+    for (size_t i = 0; i < n; ++i) out->arr.push_back(S(names[i]));
+    return out;
+}
+
 }  // namespace
 
 extern "C" {
@@ -860,6 +974,60 @@ char* rc_build_manifests(const char* kind, const char* cr_json,
     } else {
         return nullptr;
     }
+    std::string s;
+    serialize(*out, s);
+    char* buf = (char*)malloc(s.size() + 1);
+    if (!buf) return nullptr;
+    memcpy(buf, s.c_str(), s.size() + 1);
+    return buf;
+}
+
+// Reconcile decision for a TPURuntime: cr + live Deployment (JSON or
+// "null") + whether a ScaledObject currently exists -> malloc'd JSON
+// {"ensure": [...], "delete_scaledobject": bool, "status": {...}}.
+// Caller frees with rc_free(); NULL on parse/shape error.
+char* rc_runtime_actions(const char* cr_json, const char* live_deploy_json,
+                         int scaledobject_exists) {
+    Parser pc(cr_json);
+    ValuePtr cr = pc.parse();
+    if (!pc.ok || cr->kind != Value::Obj || !get(*cr, "metadata"))
+        return nullptr;
+    ValuePtr live;
+    const Value* livep = nullptr;
+    if (live_deploy_json && *live_deploy_json) {
+        Parser pl(live_deploy_json);
+        live = pl.parse();
+        if (!pl.ok) return nullptr;
+        if (live->kind == Value::Obj) livep = live.get();
+    }
+    ValuePtr out = runtime_actions(*cr, livep, scaledobject_exists != 0);
+    std::string s;
+    serialize(*out, s);
+    char* buf = (char*)malloc(s.size() + 1);
+    if (!buf) return nullptr;
+    memcpy(buf, s.c_str(), s.size() + 1);
+    return buf;
+}
+
+// LoRA placement: pods_json = array of READY pod names, counts_json =
+// {"pod": loaded-adapter-count}. replicas <= 0 means unset. Returns a
+// malloc'd JSON array of chosen pod names (sorted placement order);
+// caller frees with rc_free(); NULL on parse error.
+char* rc_place_lora(const char* pods_json, const char* algorithm,
+                    long replicas, const char* counts_json) {
+    Parser pp(pods_json);
+    ValuePtr pods = pp.parse();
+    if (!pp.ok || pods->kind != Value::Arr) return nullptr;
+    ValuePtr counts;
+    const Value* countsp = nullptr;
+    if (counts_json && *counts_json) {
+        Parser pn(counts_json);
+        counts = pn.parse();
+        if (!pn.ok) return nullptr;
+        if (counts->kind == Value::Obj) countsp = counts.get();
+    }
+    ValuePtr out = place_lora(*pods, algorithm ? algorithm : "default",
+                              replicas, countsp);
     std::string s;
     serialize(*out, s);
     char* buf = (char*)malloc(s.size() + 1);

@@ -44,6 +44,14 @@ def load_reconcile_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.rc_build_manifests.restype = ctypes.c_void_p  # freed via rc_free
+        lib.rc_runtime_actions.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.rc_runtime_actions.restype = ctypes.c_void_p
+        lib.rc_place_lora.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+        ]
+        lib.rc_place_lora.restype = ctypes.c_void_p
         lib.rc_free.argtypes = [ctypes.c_void_p]
         lib.rc_free.restype = None
         _LIB = lib

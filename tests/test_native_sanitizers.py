@@ -100,6 +100,27 @@ out = json.loads(ctypes.string_at(ptr).decode())
 lib.rc_free(ptr)
 assert out["deployment"]["kind"] == "Deployment" and "pvc" in out
 assert lib.rc_build_manifests(b"bogus", json.dumps(cr).encode(), b"i") in (None, 0)
+# compiled reconcile decisions (r4 #10): actions + placement + errors
+lib.rc_runtime_actions.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+lib.rc_runtime_actions.restype = ctypes.c_void_p
+ptr = lib.rc_runtime_actions(json.dumps(cr).encode(), b"", 1)
+assert ptr
+acts = json.loads(ctypes.string_at(ptr).decode())
+lib.rc_free(ptr)
+assert acts["ensure"][:2] == ["deployment", "service"]
+assert acts["status"]["state"] == "Reconciled"
+assert lib.rc_runtime_actions(b"not json", b"", 0) in (None, 0)
+lib.rc_place_lora.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_long, ctypes.c_char_p]
+lib.rc_place_lora.restype = ctypes.c_void_p
+ptr = lib.rc_place_lora(b'["b", "a", "c"]', b"equalized", 2,
+                        b'{{"a": 5, "b": 0}}')
+assert ptr
+placed = json.loads(ctypes.string_at(ptr).decode())
+lib.rc_free(ptr)
+assert placed == ["b", "c"]
+assert lib.rc_place_lora(b"{{", b"default", 0, b"") in (None, 0)
 print("SMOKE-OK")
 """
     env = dict(os.environ, LD_PRELOAD=_sanitizer_runtime("asan"),

@@ -550,3 +550,129 @@ def test_native_manifest_parity():
     # malformed input: clean None, not a crash
     assert build_manifests_native("engine", {"no": "metadata"}, "x") is None
     assert build_manifests_native("bogus-kind", runtimes[0], "x") is None
+
+
+def test_native_decision_parity():
+    """The compiled reconcile decisions (rc_runtime_actions /
+    rc_place_lora — VERDICT r4 #10) must match the Python fallbacks
+    exactly across a matrix of CR shapes, live states, and placement
+    configurations."""
+    from production_stack_tpu.operator.drift import load_reconcile_lib
+    from production_stack_tpu.operator.native_decisions import (
+        place_lora,
+        place_lora_py,
+        runtime_actions,
+        runtime_actions_py,
+    )
+
+    assert load_reconcile_lib() is not None, "libreconcile.so must be built"
+
+    def cr(**spec):
+        return {"kind": "TPURuntime",
+                "metadata": {"name": "m", "namespace": "ns", "uid": "u"},
+                "spec": spec}
+
+    def live(avail=0, unavail=0, updated=0):
+        return {"status": {"availableReplicas": avail,
+                           "unavailableReplicas": unavail,
+                           "updatedReplicas": updated}}
+
+    cases = [
+        (cr(model="x"), None, False),
+        (cr(model="x", replicas=3), live(3), False),
+        (cr(model="x", replicas=3), live(2, 1, 2), False),  # Updating
+        (cr(model="x", replicas=2), live(0, 2, 0), True),   # NotReady
+        (cr(model="x", pvcStorage="10Gi"), live(1), False),
+        (cr(model="x", autoscaling={"minReplicas": 1}), live(1), False),
+        (cr(model="x", autoscaling={"enabled": False}), live(1), True),
+        (cr(model="x", autoscaling={}), None, True),  # empty = disabled
+        (cr(model="x", autoscaling={"enabled": True}), None, False),
+        # non-bool `enabled` values follow Python truthiness (r5 review)
+        (cr(model="x", autoscaling={"enabled": 0}), None, True),
+        (cr(model="x", autoscaling={"enabled": 1}), None, False),
+        (cr(model="x", autoscaling={"enabled": ""}), None, True),
+        (cr(model="x", autoscaling={"enabled": "false"}), None, False),
+        (cr(model="x", autoscaling={"enabled": None}), None, True),
+    ]
+    for c, lv, exists in cases:
+        native = runtime_actions(c, lv, exists)
+        py = runtime_actions_py(c, lv, exists)
+        assert native == py, (c["spec"], lv, exists, native, py)
+
+    pods = ["pod-c", "pod-a", "pod-b", "pod-d"]
+    counts_cases = [{}, {"pod-a": 3, "pod-b": 1},
+                    {"pod-a": 1, "pod-b": 1, "pod-c": 0, "pod-d": 2}]
+    for algo in ("default", "ordered", "equalized"):
+        for replicas in (None, 1, 2, 10):
+            for counts in counts_cases:
+                native = place_lora(pods, algo, replicas, counts)
+                py = place_lora_py(pods, algo, replicas, counts)
+                assert native == py, (algo, replicas, counts, native, py)
+
+
+def test_reconcile_runtime_executes_compiled_decisions():
+    """The transport loop must follow the decision list: scaledobject
+    ensured when autoscaling on, deleted when off-and-leftover."""
+    import asyncio
+
+    from production_stack_tpu.operator.controller import Operator
+
+    class FakeClient:
+        def __init__(self, scaled_exists):
+            self.calls = []
+            self.scaled_exists = scaled_exists
+
+        async def get(self, path):
+            if "scaledobjects" in path and self.scaled_exists:
+                return {"metadata": {"name": "m-scaledobject"}}
+            return None
+
+        async def put(self, path, body):
+            self.calls.append(("put", path))
+            return {}
+
+        async def post(self, path, body):
+            self.calls.append(("post", path))
+            return {}
+
+        async def delete(self, path):
+            self.calls.append(("delete", path))
+            return {}
+
+        async def patch_status(self, path, body):
+            self.calls.append(("status", path))
+            return {}
+
+    def run_case(spec, scaled_exists):
+        client = FakeClient(scaled_exists)
+        op = Operator.__new__(Operator)
+        op.client = client
+        op.ns = "default"
+        op.engine_image = "img"
+
+        async def _set_status(plural, name, status):
+            client.calls.append(("set_status", plural, status))
+
+        async def _ensure(path, desired):
+            client.calls.append(("ensure", path.rsplit("/", 1)[-1],
+                                 desired["kind"]))
+
+        op._set_status = _set_status
+        op._ensure = _ensure
+        cr = {"kind": "TPURuntime",
+              "metadata": {"name": "m", "namespace": "default", "uid": "u"},
+              "spec": spec}
+        asyncio.run(op.reconcile_runtime("ADDED", cr))
+        return client.calls
+
+    calls = run_case({"model": "x", "autoscaling": {"minReplicas": 1}},
+                     scaled_exists=False)
+    kinds = [c[2] for c in calls if c[0] == "ensure"]
+    assert "ScaledObject" in kinds and "Deployment" in kinds
+
+    calls = run_case({"model": "x"}, scaled_exists=True)
+    assert any(c[0] == "delete" for c in calls), calls
+    assert not any(c[0] == "ensure" and c[2] == "ScaledObject"
+                   for c in calls)
+    status = [c for c in calls if c[0] == "set_status"][-1]
+    assert status[2]["state"] == "Reconciled"
