@@ -44,6 +44,37 @@ QuantizedWeight = dict
 
 _EPS = 1e-8
 
+# Intensity-adaptive kernel selection (docs/roofline.md int8 section):
+# W8A8's per-token activation quantize + int32 rescale is noise next to
+# a bandwidth-bound matmul but measured −14% on compute-bound 4k
+# prefill. A matmul's arithmetic intensity is its token count (weight
+# bytes amortise over tokens), and that count is STATIC at trace time,
+# so the mode picks itself per compiled program: at or above this many
+# tokens the contraction is compute-bound and runs W8A16 — activations
+# stay in the model dtype and the int8 weights dequantize INTO the dot
+# (XLA fuses the convert+scale into the operand read; worst case it
+# materialises one tile, still amortised over >=512 tokens) — below it,
+# the bandwidth-bound regime keeps native W8A8. This is deliberately
+# NOT a prefill/decode switch: a 512-sequence decode batch has the same
+# intensity as a 512-token prefill and takes the same branch (the
+# measured prefill regression is evidence for W8A16 in exactly that
+# regime). MoE expert matmuls pass their REAL token count via
+# ``tokens_hint`` — capacity padding is not intensity.
+# Override: PSTPU_QUANT_A16_THRESHOLD (values <= 0 disable W8A16).
+def _a16_threshold() -> int:
+    import os
+
+    raw = os.environ.get("PSTPU_QUANT_A16_THRESHOLD", "512")
+    try:
+        val = int(float(raw))
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "unparseable PSTPU_QUANT_A16_THRESHOLD=%r; using 512", raw)
+        return 512
+    return max(val, 0)  # <= 0 means "never use W8A16"
+
 
 def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
@@ -78,13 +109,20 @@ def dequantize_array(w: dict) -> jnp.ndarray:
     return w["q"].astype(jnp.float32) * w["s"]
 
 
-def quant_einsum(eq: str, x: jnp.ndarray, w: Any, out_dtype=None) -> jnp.ndarray:
+def quant_einsum(eq: str, x: jnp.ndarray, w: Any, out_dtype=None,
+                 tokens_hint: int | None = None) -> jnp.ndarray:
     """``jnp.einsum(eq, x, w)`` accepting a quantized ``w``.
 
     With a plain array this is exactly ``jnp.einsum``. With a quantized
-    weight the activation is dynamically quantized per token (absmax over
-    its contracted axes), the contraction runs int8×int8→int32 on the MXU,
-    and the result is rescaled by (activation scale × weight scale).
+    weight the kernel is intensity-adaptive (see ``_a16_threshold``):
+    below the token threshold the activation is dynamically quantized
+    per token (absmax over its contracted axes), the contraction runs
+    int8×int8→int32 on the MXU, and the result is rescaled by
+    (activation scale × weight scale); at/above it the weights
+    fused-dequantize into a model-dtype contraction (W8A16).
+    ``tokens_hint`` overrides the token count inferred from ``x``'s
+    shape — MoE expert matmuls pass the real token count (their
+    capacity-slot shape over-counts by ~2x).
 
     Supported equations: activation first, any leading ``...`` batch dims,
     every non-contracted explicit activation letter appearing as a prefix of
@@ -101,6 +139,24 @@ def quant_einsum(eq: str, x: jnp.ndarray, w: Any, out_dtype=None) -> jnp.ndarray
     contracted = [c for c in x_letters if c not in out_letters]
     n = len(x_letters)
     cax = tuple(i - n for i, c in enumerate(x_letters) if c in contracted)
+
+    # intensity-adaptive: compute-bound (many-token) contractions skip
+    # the activation quantize and run W8A16 — see _a16_threshold
+    if tokens_hint is not None:
+        tokens = tokens_hint
+    else:
+        contracted_sizes = 1
+        for i in cax:
+            contracted_sizes *= x.shape[i]
+        tokens = x.size // max(contracted_sizes, 1)
+    thresh = _a16_threshold()
+    if thresh and tokens >= thresh:
+        # multiply q*s in f32, round ONCE into the model dtype — the
+        # same fidelity a bf16 checkpoint would hold (casting the scale
+        # to bf16 first would round twice)
+        wd = dequantize_array(w).astype(x.dtype)
+        out = jnp.einsum(eq, x, wd)
+        return out.astype(out_dtype if out_dtype is not None else x.dtype)
 
     xf = x.astype(jnp.float32)
     sx = jnp.max(jnp.abs(xf), axis=cax) / 127.0  # (..., surviving)

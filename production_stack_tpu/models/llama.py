@@ -252,10 +252,16 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
     ).astype(xt.dtype)
 
     expert_in = jnp.einsum("txc,te->xce", disp, xt)  # (X, C, E)
-    gate = quant_einsum("xce,xef->xcf", expert_in, lp["w_gate"])
-    up = quant_einsum("xce,xef->xcf", expert_in, lp["w_up"])
+    # expert matmuls see (X, C, E) capacity slots, ~2x the real token
+    # count — pass the true T so the intensity-adaptive int8 kernel
+    # (quant.py _a16_threshold) doesn't misread padding as intensity
+    gate = quant_einsum("xce,xef->xcf", expert_in, lp["w_gate"],
+                        tokens_hint=T)
+    up = quant_einsum("xce,xef->xcf", expert_in, lp["w_up"],
+                      tokens_hint=T)
     expert_out = quant_einsum(
-        "xcf,xfe->xce", jax.nn.silu(gate) * up, lp["w_down"]
+        "xcf,xfe->xce", jax.nn.silu(gate) * up, lp["w_down"],
+        tokens_hint=T,
     )
     out = jnp.einsum("txc,xce->te", comb, expert_out)
     return out.reshape(orig_shape)

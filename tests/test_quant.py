@@ -167,3 +167,62 @@ def test_engine_int8_sleep_wake_restores_quantized():
             out.extend(o.new_token_ids)
         steps += 1
     assert out == before["r0"]
+
+
+def test_quant_einsum_w8a16_above_token_threshold(monkeypatch):
+    """Phase-adaptive selection (docs/roofline.md: int8's -14% prefill
+    regression): prefill-sized token counts skip activation quantization
+    and run the fused weight-dequant (W8A16) path — strictly MORE
+    accurate than W8A8, and bit-matching the explicit dequant einsum."""
+    import numpy as np
+
+    eq = "...te,ef->...tf"
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 96), jnp.float32) * 0.1
+    qw = quant.quantize_array(w, (0,))
+
+    x_big = jax.random.normal(jax.random.PRNGKey(1), (8, 128, 64),
+                              jnp.float32)  # 1024 tokens >= 512
+    got = quant.quant_einsum(eq, x_big, qw)
+    dequant_ref = jnp.einsum(eq, x_big, qw["q"].astype(jnp.float32)
+                             * qw["s"].astype(jnp.float32))
+    assert np.allclose(np.asarray(got), np.asarray(dequant_ref), atol=1e-5)
+    # W8A16 must be at least as close to the dense reference as W8A8
+    ref = jnp.einsum(eq, x_big, w)
+    monkeypatch.setenv("PSTPU_QUANT_A16_THRESHOLD", "1000000")
+    a8 = quant.quant_einsum(eq, x_big, qw)  # forced W8A8 at this size
+    monkeypatch.delenv("PSTPU_QUANT_A16_THRESHOLD")
+    err16 = float(jnp.linalg.norm(ref - got))
+    err8 = float(jnp.linalg.norm(ref - a8))
+    assert err16 <= err8 * 1.01, (err16, err8)
+    # 0 disables the W8A16 path entirely
+    monkeypatch.setenv("PSTPU_QUANT_A16_THRESHOLD", "0")
+    forced_a8 = quant.quant_einsum(eq, x_big, qw)
+    assert np.allclose(np.asarray(forced_a8), np.asarray(a8), atol=1e-6)
+
+
+def test_a16_threshold_env_robustness(monkeypatch):
+    """Unparseable values warn and keep the default; negative and zero
+    both disable; scientific notation parses (r5 review)."""
+    monkeypatch.setenv("PSTPU_QUANT_A16_THRESHOLD", "junk")
+    assert quant._a16_threshold() == 512
+    monkeypatch.setenv("PSTPU_QUANT_A16_THRESHOLD", "-1")
+    assert quant._a16_threshold() == 0
+    monkeypatch.setenv("PSTPU_QUANT_A16_THRESHOLD", "1e6")
+    assert quant._a16_threshold() == 1_000_000
+    monkeypatch.delenv("PSTPU_QUANT_A16_THRESHOLD")
+    assert quant._a16_threshold() == 512
+
+
+def test_quant_einsum_tokens_hint_overrides_shape(monkeypatch):
+    """MoE capacity slots over-count tokens ~2x; tokens_hint keeps the
+    bandwidth-bound W8A8 path selected for real decode batches."""
+    eq = "xce,xef->xcf"
+    w = jax.random.normal(jax.random.PRNGKey(2), (4, 32, 20),
+                          jnp.float32) * 0.1
+    qw = quant.quantize_array(w, (1,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 256, 32), jnp.float32)
+    # shape says 1024 slots (would pick W8A16); hint says 300 real tokens
+    hinted = quant.quant_einsum(eq, x, qw, tokens_hint=300)
+    monkeypatch.setenv("PSTPU_QUANT_A16_THRESHOLD", "1000000")
+    a8 = quant.quant_einsum(eq, x, qw)  # forced W8A8
+    assert np.allclose(np.asarray(hinted), np.asarray(a8), atol=1e-6)
