@@ -47,9 +47,15 @@ PROMPT_BUCKETS = (8, 32, 128)
 
 
 class WhisperRunner:
-    """Single-model transcription runner (B=1 per call; the server
-    serialises calls with a lock — transcription requests are seconds
-    long and the 30 s window batch=1 already saturates the MXU)."""
+    """Single-model transcription runner.
+
+    Concurrency model: B=1 per device call (the 30 s window batch=1
+    already saturates the MXU); an ADMISSION semaphore sized by
+    ``scheduler.max_num_seqs`` bounds how many requests may hold live
+    decode state (each admitted request owns cross-KV + self-KV device
+    buffers), and within the admitted set the device lock is taken per
+    32-token decode chunk so concurrent requests interleave instead of
+    head-of-line blocking for whole clips."""
 
     def __init__(self, config: EngineConfig, mesh=None):
         cfg = config.model
@@ -60,6 +66,11 @@ class WhisperRunner:
         self.params = init_or_load(cfg, self.mesh)
         self.tokenizer = get_tokenizer(cfg.tokenizer)
         self.lock = threading.Lock()
+        # bound on LIVE decode states (per-request KV buffers on device):
+        # without it a burst of uploads would each allocate cross-KV +
+        # self-KV before queueing on the chunk lock and OOM HBM
+        self.admit = threading.BoundedSemaphore(
+            max(config.scheduler.max_num_seqs, 1))
         self.chunk_frames = cfg.n_audio_ctx * 2
         # langs actually present in this vocab
         self.languages = LANGUAGES[: cfg.n_langs]
@@ -235,38 +246,53 @@ class WhisperRunner:
         ``info`` (if given) receives ``{"language": <used-or-detected>}``
         before the first yield."""
         cfg = self.cfg
-        with self.lock:
-            # ONE encoder pass shared by detection and transcription
-            ck, cv = self._encode(self.params, jnp.asarray(features)[None])
-            if language is None and cfg.n_langs:
-                language = self._detect_language_from(ck, cv)
-        if info is not None:
-            info["language"] = language
-        forced = self._forced_tokens(language, task, prompt)
-        P = self._bucket(len(forced))
-        tokens = np.zeros((1, P), np.int32)
-        tokens[0, : len(forced)] = forced
-        n_forced = len(forced)
-        limit = cfg.max_model_len
-        if max_tokens is not None:
-            limit = min(limit, n_forced + max(int(max_tokens), 1))
-        with self.lock:
-            kv, last = self._dec_prefill(
-                P, self.params, ck, cv, jnp.asarray(tokens),
-                jnp.full((1,), n_forced, jnp.int32))
+        # admission: bound the number of requests holding live device
+        # buffers (released in the finally when the generator finishes
+        # or is closed)
+        self.admit.acquire()
+        try:
+            with self.lock:
+                # ONE encoder pass shared by detection and transcription
+                ck, cv = self._encode(self.params,
+                                      jnp.asarray(features)[None])
+                if language is None and cfg.n_langs:
+                    language = self._detect_language_from(ck, cv)
+            if info is not None:
+                info["language"] = language
+            forced = self._forced_tokens(language, task, prompt)
+            P = self._bucket(len(forced))
+            tokens = np.zeros((1, P), np.int32)
+            tokens[0, : len(forced)] = forced
+            n_forced = len(forced)
+            limit = cfg.max_model_len
+            if max_tokens is not None:
+                limit = min(limit, n_forced + max(int(max_tokens), 1))
+            with self.lock:
+                kv, last = self._dec_prefill(
+                    P, self.params, ck, cv, jnp.asarray(tokens),
+                    jnp.full((1,), n_forced, jnp.int32))
             cur = jnp.full((), n_forced, jnp.int32)
             n_gen = jnp.zeros((), jnp.int32)
             key = jax.random.PRNGKey(seed)
             done = False
             while not done:
                 key, sub = jax.random.split(key)
-                buf, n_emit, kv, cur, n_gen, last, done_dev = self._chunk(
-                    self.params, kv, ck, cv, cur, n_gen, last,
-                    jnp.int32(limit), jnp.float32(temperature), sub)
+                # lock per CHUNK, not per request: every request's decode
+                # state (kv/ck/cv/cur) is its own arrays, so admitted
+                # transcriptions interleave at chunk granularity instead
+                # of head-of-line-blocking for whole clips
+                with self.lock:
+                    buf, n_emit, kv, cur, n_gen, last, done_dev = \
+                        self._chunk(
+                            self.params, kv, ck, cv, cur, n_gen, last,
+                            jnp.int32(limit), jnp.float32(temperature),
+                            sub)
                 n_emit = int(n_emit)
                 out = np.asarray(buf[:n_emit]).tolist()
                 done = bool(done_dev) or n_emit < DECODE_CHUNK
                 yield [t for t in out if t != cfg.eot_id]
+        finally:
+            self.admit.release()
 
     def transcribe(self, features: np.ndarray, **kw) -> list[int]:
         out: list[int] = []

@@ -633,3 +633,19 @@ def test_keda_fallback_and_router_depth():
            if s["metadata"]["name"].endswith("-router")][0]
     assert svc["metadata"]["annotations"] == {"a": "b"}
     assert svc["spec"]["ports"][0]["nodePort"] == 30123
+
+
+def test_scenario_11_whisper_renders():
+    """The audio modality deploys as an ordinary engine modelSpec
+    (tutorial 33): whisper model + capability-reading router."""
+    objs = render_asset("values-11-whisper.yaml")
+    eng = engine_deployments(objs)
+    assert len(eng) == 1
+    args = container_args(eng[0])
+    assert "whisper-small-class" in args
+    i = args.index("--max-model-len")
+    assert args[i + 1] == "448"
+    assert "--static-query-models" in router_args(objs)
+    # TPU resources, zero CUDA — same contract as every scenario
+    c = eng[0]["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"]
