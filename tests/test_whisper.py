@@ -561,3 +561,116 @@ def test_runner_admission_bound(monkeypatch):
     a.close()  # releases the slot...
     assert started.wait(timeout=60), "slot never released on close"
     b.close()
+
+
+def test_whisper_hf_safetensors_loading_roundtrip(tmp_path):
+    """engine/weights._load_whisper_safetensors: synthesize an HF
+    WhisperForConditionalGeneration checkpoint (HF tensor names/layouts)
+    from random-init params by INVERTING the mapping, load it, and
+    require the loaded tree to match the source — any transpose or
+    reshape mistake in the loader breaks the equality."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from production_stack_tpu.engine.weights import init_or_load
+    from production_stack_tpu.models import whisper as W
+    from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = ModelConfig.from_pretrained("tiny-whisper")
+    params = W.init_params(cfg, jax.random.PRNGKey(7))
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def hf_proj(w):  # ours (L, E, H, D) -> HF (H*D, E) per layer
+        return np.asarray(w).transpose(1, 2, 0).reshape(H * D, E)
+
+    def hf_out(w):  # ours (L, H, D, E) -> HF (E, H*D) per layer
+        return np.asarray(w).reshape(H * D, E).T
+
+    tensors = {}
+    enc, dec = params["enc"], params["dec"]
+    tensors["model.encoder.conv1.weight"] = np.asarray(
+        enc["conv1_w"]).transpose(2, 1, 0)  # ours (k, in, out) -> HF (out, in, k)
+    tensors["model.encoder.conv1.bias"] = np.asarray(enc["conv1_b"])
+    tensors["model.encoder.conv2.weight"] = np.asarray(
+        enc["conv2_w"]).transpose(2, 1, 0)
+    tensors["model.encoder.conv2.bias"] = np.asarray(enc["conv2_b"])
+    tensors["model.encoder.layer_norm.weight"] = np.asarray(
+        enc["final_norm_w"])
+    tensors["model.encoder.layer_norm.bias"] = np.asarray(
+        enc["final_norm_b"])
+    tensors["model.decoder.embed_tokens.weight"] = np.asarray(dec["embed"])
+    tensors["model.decoder.embed_positions.weight"] = np.asarray(dec["pos"])
+    tensors["model.decoder.layer_norm.weight"] = np.asarray(
+        dec["final_norm_w"])
+    tensors["model.decoder.layer_norm.bias"] = np.asarray(
+        dec["final_norm_b"])
+
+    def dump_block(prefix, layers, i, cross):
+        L = layers
+        b = f"{prefix}.{i}"
+        tensors[f"{b}.self_attn_layer_norm.weight"] = np.asarray(
+            L["attn_norm_w"][i])
+        tensors[f"{b}.self_attn_layer_norm.bias"] = np.asarray(
+            L["attn_norm_b"][i])
+        tensors[f"{b}.self_attn.q_proj.weight"] = hf_proj(L["wq"][i])
+        tensors[f"{b}.self_attn.q_proj.bias"] = np.asarray(
+            L["bq"][i]).reshape(-1)
+        tensors[f"{b}.self_attn.k_proj.weight"] = hf_proj(L["wk"][i])
+        tensors[f"{b}.self_attn.v_proj.weight"] = hf_proj(L["wv"][i])
+        tensors[f"{b}.self_attn.v_proj.bias"] = np.asarray(
+            L["bv"][i]).reshape(-1)
+        tensors[f"{b}.self_attn.out_proj.weight"] = hf_out(L["wo"][i])
+        tensors[f"{b}.self_attn.out_proj.bias"] = np.asarray(L["bo"][i])
+        tensors[f"{b}.final_layer_norm.weight"] = np.asarray(
+            L["mlp_norm_w"][i])
+        tensors[f"{b}.final_layer_norm.bias"] = np.asarray(
+            L["mlp_norm_b"][i])
+        tensors[f"{b}.fc1.weight"] = np.asarray(L["fc1"][i]).T
+        tensors[f"{b}.fc1.bias"] = np.asarray(L["fc1_b"][i])
+        tensors[f"{b}.fc2.weight"] = np.asarray(L["fc2"][i]).T
+        tensors[f"{b}.fc2.bias"] = np.asarray(L["fc2_b"][i])
+        if cross:
+            tensors[f"{b}.encoder_attn_layer_norm.weight"] = np.asarray(
+                L["cross_norm_w"][i])
+            tensors[f"{b}.encoder_attn_layer_norm.bias"] = np.asarray(
+                L["cross_norm_b"][i])
+            tensors[f"{b}.encoder_attn.q_proj.weight"] = hf_proj(
+                L["cwq"][i])
+            tensors[f"{b}.encoder_attn.q_proj.bias"] = np.asarray(
+                L["cbq"][i]).reshape(-1)
+            tensors[f"{b}.encoder_attn.k_proj.weight"] = hf_proj(
+                L["cwk"][i])
+            tensors[f"{b}.encoder_attn.v_proj.weight"] = hf_proj(
+                L["cwv"][i])
+            tensors[f"{b}.encoder_attn.v_proj.bias"] = np.asarray(
+                L["cbv"][i]).reshape(-1)
+            tensors[f"{b}.encoder_attn.out_proj.weight"] = hf_out(
+                L["cwo"][i])
+            tensors[f"{b}.encoder_attn.out_proj.bias"] = np.asarray(
+                L["cbo"][i])
+
+    for i in range(cfg.encoder_layers):
+        dump_block("model.encoder.layers", enc["layers"], i, cross=False)
+    for i in range(cfg.num_layers):
+        dump_block("model.decoder.layers", dec["layers"], i, cross=True)
+
+    # safetensors serialises the underlying buffer: transposed VIEWS
+    # must be made contiguous or the file holds pre-transpose bytes
+    tensors = {k: np.ascontiguousarray(v) for k, v in tensors.items()}
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    loaded_cfg = dataclasses.replace(cfg, weights_path=str(tmp_path),
+                                     dtype="float32")
+    mesh = build_mesh(MeshConfig(data=1, tensor=1))
+    loaded = init_or_load(loaded_cfg, mesh)
+
+    flat_src = jax.tree_util.tree_leaves_with_path(params)
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(loaded))
+    assert len(flat_src) == len(flat_got)
+    for path, leaf in flat_src:
+        got = flat_got[path]
+        assert got.shape == leaf.shape, path
+        np.testing.assert_allclose(np.asarray(got), np.asarray(leaf),
+                                   atol=1e-6, err_msg=str(path))
