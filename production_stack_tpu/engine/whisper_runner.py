@@ -114,16 +114,21 @@ class WhisperRunner:
         cfg = self.cfg
         V = cfg.vocab_size
         ids = jnp.arange(V, dtype=jnp.int32)
-        special = ids > cfg.eot_id  # vocab layout: all specials above eot
+        # vocab layout: eot < sot < langs < tasks < ... < notimestamps <
+        # timestamps. Default mode suppresses everything above eot;
+        # timestamp mode re-admits the timestamp tokens (the segment
+        # boundaries srt/vtt/verbose_json are built from).
+        special = ids > cfg.eot_id
+        non_ts_special = (ids > cfg.eot_id) & (ids <= cfg.notimestamps_id)
 
-        def suppress(logits, n_gen):
-            # (V,) f32 logits: mask specials; mask eot until 1 text token
-            logits = jnp.where(special, -jnp.inf, logits)
+        def suppress(logits, n_gen, timestamps):
+            mask = jnp.where(timestamps, non_ts_special, special)
+            logits = jnp.where(mask, -jnp.inf, logits)
             return jnp.where((ids == cfg.eot_id) & (n_gen < 1),
                              -jnp.inf, logits)
 
-        def sample(logits, n_gen, temp, key):
-            logits = suppress(logits, n_gen)
+        def sample(logits, n_gen, temp, key, timestamps):
+            logits = suppress(logits, n_gen, timestamps)
             greedy = jnp.argmax(logits).astype(jnp.int32)
             drawn = jax.random.categorical(
                 key, logits / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
@@ -131,7 +136,7 @@ class WhisperRunner:
 
         @jax.jit
         def chunk(params, kv, ck, cv, cur_len, n_gen, last_logits,
-                  limit, temp, key):
+                  limit, temp, key, timestamps):
             """Generate up to DECODE_CHUNK tokens from ``last_logits``.
 
             Returns (buf (CHUNK,), n_emitted, kv, cur_len, n_gen,
@@ -145,13 +150,16 @@ class WhisperRunner:
             def body(c):
                 i, buf, kv, cur, n, logits, done, key = c
                 key, sub = jax.random.split(key)
-                tok = sample(logits[0], n, temp, sub)
+                tok = sample(logits[0], n, temp, sub, timestamps)
                 buf = buf.at[i].set(tok)
                 is_eot = tok == cfg.eot_id
                 new_logits, kv = W.decode_tokens(
                     cfg, params, tok[None, None], cur[None], kv, ck, cv,
                     jnp.ones((1,), jnp.int32))
-                return (i + 1, buf, kv, cur + 1, n + 1,
+                # n counts TEXT tokens (eot-release guard): a leading
+                # <|0.00|> must not satisfy "at least one text token"
+                n_next = n + jnp.where(tok < cfg.eot_id, 1, 0)
+                return (i + 1, buf, kv, cur + 1, n_next,
                         new_logits[:, 0], is_eot, key)
 
             i, buf, kv, cur, n, logits, done, _ = lax.while_loop(
@@ -178,7 +186,8 @@ class WhisperRunner:
         )
 
     def _forced_tokens(self, language: Optional[str], task: str,
-                       prompt: Optional[str]) -> list[int]:
+                       prompt: Optional[str],
+                       timestamps: bool = False) -> list[int]:
         cfg = self.cfg
         forced: list[int] = []
         if prompt:
@@ -199,8 +208,56 @@ class WhisperRunner:
             forced.append(cfg.lang_base_id + lang_idx)
         forced.append(cfg.translate_id if task == "translate"
                       else cfg.transcribe_id)
-        forced.append(cfg.notimestamps_id)
+        if not timestamps:  # timestamp mode lets the model emit <|t.tt|>
+            forced.append(cfg.notimestamps_id)
         return forced
+
+    def strip_timestamps(self, tokens: list[int]) -> list[int]:
+        """Drop <|t.tt|> tokens before plain-text decoding (v2 HF
+        tokenizers don't even carry them in vocab)."""
+        return [t for t in tokens if t <= self.cfg.notimestamps_id]
+
+    def segments_from_tokens(self, tokens: list[int],
+                             duration: float) -> list[dict]:
+        """Split a timestamp-mode token stream into segments.
+
+        Timestamp tokens encode ``(id - notimestamps_id - 1) * 0.02``
+        seconds; text between a start and end timestamp is one segment.
+        Lenient parse (the decoder is not grammar-constrained): an
+        unclosed final segment ends at the clip duration."""
+        cfg = self.cfg
+        base = cfg.notimestamps_id + 1
+
+        def ts(tok):
+            return (tok - base) * 0.02
+
+        segments: list[dict] = []
+        start = 0.0
+        text_toks: list[int] = []
+        for t in tokens:
+            if t > cfg.notimestamps_id:  # timestamp token
+                if text_toks:
+                    # ungrammatical decodes can emit a smaller timestamp
+                    # after a larger one: clamp so no cue ever has
+                    # start > end (subtitle players reject those)
+                    end = max(ts(t), start)
+                    segments.append({
+                        "start": round(start, 2), "end": round(end, 2),
+                        "tokens": text_toks,
+                        "text": self.tokenizer.decode(text_toks),
+                    })
+                    text_toks = []
+                start = ts(t)
+            elif t != cfg.eot_id:
+                text_toks.append(t)
+        if text_toks:
+            segments.append({
+                "start": round(start, 2),
+                "end": round(max(duration, start), 2),
+                "tokens": text_toks,
+                "text": self.tokenizer.decode(text_toks),
+            })
+        return segments
 
     def _detect_language_from(self, ck, cv) -> str:
         """argmax over the language tokens after <|startoftranscript|>.
@@ -241,10 +298,12 @@ class WhisperRunner:
         max_tokens: Optional[int] = None,
         seed: int = 0,
         info: Optional[dict] = None,
+        timestamps: bool = False,
     ) -> Iterator[list[int]]:
-        """Yields lists of newly generated text token ids (eot stripped).
-        ``info`` (if given) receives ``{"language": <used-or-detected>}``
-        before the first yield."""
+        """Yields lists of newly generated token ids (eot stripped; with
+        ``timestamps`` the stream includes <|t.tt|> tokens — see
+        ``segments_from_tokens``). ``info`` (if given) receives
+        ``{"language": <used-or-detected>}`` before the first yield."""
         cfg = self.cfg
         # admission: bound the number of requests holding live device
         # buffers (released in the finally when the generator finishes
@@ -259,7 +318,8 @@ class WhisperRunner:
                     language = self._detect_language_from(ck, cv)
             if info is not None:
                 info["language"] = language
-            forced = self._forced_tokens(language, task, prompt)
+            forced = self._forced_tokens(language, task, prompt,
+                                         timestamps=timestamps)
             P = self._bucket(len(forced))
             tokens = np.zeros((1, P), np.int32)
             tokens[0, : len(forced)] = forced
@@ -286,7 +346,7 @@ class WhisperRunner:
                         self._chunk(
                             self.params, kv, ck, cv, cur, n_gen, last,
                             jnp.int32(limit), jnp.float32(temperature),
-                            sub)
+                            sub, jnp.bool_(timestamps))
                 n_emit = int(n_emit)
                 out = np.asarray(buf[:n_emit]).tolist()
                 done = bool(done_dev) or n_emit < DECODE_CHUNK

@@ -46,10 +46,13 @@ WHISPER_CAPABILITIES = ("audio.transcriptions", "audio.translations")
 
 
 def _fmt_timestamp(seconds: float, sep: str) -> str:
-    h = int(seconds // 3600)
-    m = int(seconds % 3600 // 60)
-    s = seconds % 60
-    return f"{h:02d}:{m:02d}:{int(s):02d}{sep}{int(s % 1 * 1000):03d}"
+    # integer-millisecond arithmetic: float truncation would render
+    # 1.14 as ",139" instead of ",140"
+    ms_total = round(seconds * 1000)
+    h, rem = divmod(ms_total, 3_600_000)
+    m, rem = divmod(rem, 60_000)
+    s, ms = divmod(rem, 1000)
+    return f"{h:02d}:{m:02d}:{s:02d}{sep}{ms:03d}"
 
 
 class WhisperServer:
@@ -134,6 +137,19 @@ class WhisperServer:
             except ValueError:
                 raise AudioError("temperature must be a float") from None
             stream = str(form.get("stream") or "").lower() in ("true", "1")
+            granularities = [v for k, v in form.items()
+                             if k.startswith("timestamp_granularities")]
+            if granularities and set(granularities) - {"segment"}:
+                raise AudioError(
+                    "unsupported timestamp_granularities "
+                    f"{sorted(set(granularities) - {'segment'})}; "
+                    "supported: segment")
+            # srt/vtt NEED segment boundaries, and verbose_json defaults
+            # to them too (OpenAI defaults timestamp_granularities to
+            # ['segment']). Streaming emits plain text only, so
+            # timestamp tokens would just burn decode budget there.
+            ts_mode = (response_format in ("srt", "vtt", "verbose_json")
+                       and not stream)
             cfg = self.config.model
             features, duration = wav_to_features(
                 data, cfg.num_mel_bins, self.runner.chunk_frames)
@@ -151,7 +167,8 @@ class WhisperServer:
         seed = uuid.uuid4().int & 0x7FFFFFFF
         info: dict = {}  # receives the used/detected language
         kw = dict(language=language, task=task, prompt=prompt,
-                  temperature=temperature, seed=seed, info=info)
+                  temperature=temperature, seed=seed, info=info,
+                  timestamps=ts_mode)
 
         if stream:
             resp = web.StreamResponse(headers={
@@ -178,7 +195,8 @@ class WhisperServer:
                 if piece is None:
                     break
                 all_toks.extend(piece)
-                full = self.runner.tokenizer.decode(all_toks)
+                full = self.runner.tokenizer.decode(
+                    self.runner.strip_timestamps(all_toks))
                 safe = full.rstrip("�")
                 if len(safe) > emitted:
                     await resp.write(
@@ -186,7 +204,8 @@ class WhisperServer:
                         + json.dumps({"text": safe[emitted:]}).encode()
                         + b"\n\n")
                     emitted = len(safe)
-            full = self.runner.tokenizer.decode(all_toks)
+            full = self.runner.tokenizer.decode(
+                self.runner.strip_timestamps(all_toks))
             if len(full) > emitted:  # flush any genuinely-unmappable tail
                 await resp.write(
                     b"data: " + json.dumps({"text": full[emitted:]}).encode()
@@ -206,7 +225,13 @@ class WhisperServer:
             return web.json_response(
                 {"error": {"message": str(e),
                            "type": "invalid_request_error"}}, status=400)
-        text = self.runner.tokenizer.decode(tokens)
+        text = self.runner.tokenizer.decode(
+            self.runner.strip_timestamps(tokens))
+        if ts_mode:
+            segments = self.runner.segments_from_tokens(tokens, duration)
+        else:  # one segment spanning the clip
+            segments = [{"start": 0.0, "end": duration, "tokens": tokens,
+                         "text": text}]
         self.requests.labels(endpoint, "200").inc()
         self.audio_seconds.inc(duration)
         self.latency.observe(time.monotonic() - t0)
@@ -214,12 +239,16 @@ class WhisperServer:
         if response_format == "text":
             return web.Response(text=text, content_type="text/plain")
         if response_format == "srt":
-            body = (f"1\n{_fmt_timestamp(0.0, ',')} --> "
-                    f"{_fmt_timestamp(duration, ',')}\n{text}\n")
+            body = "".join(
+                f"{i + 1}\n{_fmt_timestamp(s['start'], ',')} --> "
+                f"{_fmt_timestamp(s['end'], ',')}\n{s['text']}\n\n"
+                for i, s in enumerate(segments))
             return web.Response(text=body, content_type="text/plain")
         if response_format == "vtt":
-            body = (f"WEBVTT\n\n{_fmt_timestamp(0.0, '.')} --> "
-                    f"{_fmt_timestamp(duration, '.')}\n{text}\n")
+            body = "WEBVTT\n\n" + "".join(
+                f"{_fmt_timestamp(s['start'], '.')} --> "
+                f"{_fmt_timestamp(s['end'], '.')}\n{s['text']}\n\n"
+                for s in segments)
             return web.Response(text=body, content_type="text/plain")
         if response_format == "verbose_json":
             return web.json_response({
@@ -229,10 +258,10 @@ class WhisperServer:
                 "duration": duration,
                 "text": text,
                 "segments": [{
-                    "id": 0, "seek": 0, "start": 0.0, "end": duration,
-                    "text": text, "tokens": tokens,
-                    "temperature": temperature,
-                }],
+                    "id": i, "seek": 0, "start": s["start"],
+                    "end": s["end"], "text": s["text"],
+                    "tokens": s["tokens"], "temperature": temperature,
+                } for i, s in enumerate(segments)],
             })
         return web.json_response({"text": text})
 

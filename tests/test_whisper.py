@@ -312,7 +312,7 @@ def test_transcriptions_response_formats(wserver):
         body = await r.json()
         assert body["language"] == "en"
         assert body["duration"] == pytest.approx(0.5, abs=0.01)
-        assert len(body["segments"]) == 1
+        assert body["segments"]  # timestamp mode: one OR MORE segments
         r = await client.post("/v1/audio/transcriptions",
                               data=_form(language="en",
                                          response_format="srt"))
@@ -732,3 +732,62 @@ def test_whisper_matches_hf_transformers(tmp_path):
         jnp.array([dec_ids.shape[1]], jnp.int32))
     np.testing.assert_allclose(np.asarray(logits), logits_ref,
                                atol=3e-5, rtol=1e-4)
+
+
+def test_segments_from_tokens_parsing(runner):
+    """Timestamp-token pairs split the stream into segments; an
+    unclosed final segment ends at the clip duration."""
+    cfg = runner.cfg
+    base = cfg.notimestamps_id + 1  # <|0.00|>
+    toks = [base + 0, 10, 11, base + 2,      # seg 0.00-0.04 "..."
+            base + 2, 12, 13]                # seg 0.04-<duration>
+    segs = runner.segments_from_tokens(toks, duration=1.0)
+    assert len(segs) == 2
+    assert segs[0]["start"] == 0.0 and segs[0]["end"] == 0.04
+    assert segs[0]["tokens"] == [10, 11]
+    assert segs[1]["start"] == 0.04 and segs[1]["end"] == 1.0
+    # no timestamp tokens at all -> one segment over the clip
+    segs = runner.segments_from_tokens([10, 11, cfg.eot_id], duration=0.5)
+    assert len(segs) == 1 and segs[0]["end"] == 0.5
+    # strip_timestamps removes exactly the <|t.tt|> ids
+    assert runner.strip_timestamps(toks) == [10, 11, 12, 13]
+
+
+def test_runner_timestamp_mode_emits_only_valid_ids(runner):
+    """Timestamp mode re-admits ONLY the timestamp tokens: every
+    generated id is text, eot, or a timestamp — never another special."""
+    cfg = runner.cfg
+    feats = _features(runner)
+    toks = runner.transcribe(feats, language="en", timestamps=True)
+    assert toks, "nothing generated"
+    for t in toks:
+        assert t < cfg.eot_id or t > cfg.notimestamps_id, t
+    # default mode still suppresses timestamps
+    toks_plain = runner.transcribe(feats, language="en")
+    assert all(t < cfg.eot_id for t in toks_plain)
+
+
+def test_transcriptions_segment_formats(wserver):
+    """srt/vtt decode in timestamp mode and render one block per
+    segment; verbose_json honors timestamp_granularities[]."""
+    async def fn(client):
+        r = await client.post(
+            "/v1/audio/transcriptions",
+            data=_form(language="en", response_format="verbose_json",
+                       **{"timestamp_granularities[]": "segment"}))
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert body["segments"], body
+        for s in body["segments"]:
+            assert 0.0 <= s["start"] <= s["end"] <= body["duration"] + 30
+        r = await client.post(
+            "/v1/audio/transcriptions",
+            data=_form(language="en", response_format="srt"))
+        text = await r.text()
+        assert "-->" in text
+        r = await client.post(
+            "/v1/audio/transcriptions",
+            data=_form(language="en", response_format="vtt"))
+        assert (await r.text()).startswith("WEBVTT")
+
+    run(with_client(wserver, fn))
