@@ -15,10 +15,12 @@ Execution shape (TPU-first):
   ~66 ms RTT would otherwise dominate: 448 steps × 66 ms ≈ 30 s).
   The host loop around it streams each chunk's text incrementally and
   stops early on <|endoftext|>.
-- Token suppression rides inside the chunk: every id above
-  ``eot_id`` (all special/timestamp tokens in Whisper's vocab layout)
-  is masked at every step; ``eot`` itself is additionally masked until
-  at least one text token has been emitted.
+- Token suppression rides inside the chunk: special tokens above
+  ``eot_id`` are masked at every step — in timestamp mode the
+  ``<|t.tt|>`` tokens (above ``notimestamps_id``) are re-admitted as
+  the segment boundaries srt/vtt/verbose_json are built from — and
+  ``eot`` itself is additionally masked until at least one TEXT token
+  has been emitted.
 """
 
 from __future__ import annotations

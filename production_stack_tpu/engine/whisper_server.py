@@ -16,10 +16,11 @@ temperature, stream), plus the router contract surface (``/health``,
 router's capability filter 501s them before they reach this engine.
 
 Response formats match the reference's supported set: ``json``,
-``text``, ``verbose_json``, ``srt``, ``vtt``. Timestamps are not
-predicted (the decoder runs in notimestamps mode), so srt/vtt/
-verbose_json carry ONE segment spanning the clip — documented in
-tutorials/33-audio-transcription.md.
+``text``, ``verbose_json``, ``srt``, ``vtt``. The segment formats
+(srt/vtt/verbose_json) decode in timestamp mode — the model emits
+``<|t.tt|>`` boundary tokens, parsed into one cue/segment each
+(OpenAI's default ``timestamp_granularities=['segment']``; ``word``
+is rejected clearly) — see tutorials/33-audio-transcription.md.
 """
 
 from __future__ import annotations

@@ -80,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated model name per backend")
     p.add_argument("--static-model-labels", default="",
                    help="comma-separated label per backend (prefill/decode/...)")
+    p.add_argument("--static-model-types", default="",
+                   help="comma-separated model type per backend (the "
+                        "reference flag: chat|completion|embeddings|rerank|"
+                        "score|transcription|vision|messages) — declares "
+                        "what an EXTERNAL backend serves so capability "
+                        "filtering works without a /v1/models capability "
+                        "card; a live card always wins")
     p.add_argument("--static-backend-health-checks", action="store_true")
     p.add_argument("--static-query-models", action="store_true",
                    help="probe each static backend's /v1/models for served "
@@ -217,12 +224,18 @@ class RouterApp:
             labels = [x for x in args.static_model_labels.split(",") if x] or None
             if len(models) == 1 and len(urls) > 1:
                 models = models * len(urls)
+            types = [t.strip() or None for t in
+                     (args.static_model_types or "").split(",")] \
+                if args.static_model_types else []
+            if types and len(types) == 1 and len(urls) > 1:
+                types = types * len(urls)
             initialize_service_discovery(
                 StaticServiceDiscovery(
                     urls, models, labels,
                     health_check=args.static_backend_health_checks,
                     health_check_interval=args.health_check_interval,
                     query_models=args.static_query_models,
+                    model_types=types or None,
                 )
             )
         elif args.service_discovery in ("k8s_pod_ip", "k8s_service_name"):

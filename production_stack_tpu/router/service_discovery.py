@@ -57,6 +57,25 @@ class ExternalOnlyServiceDiscovery(ServiceDiscovery):
         return []
 
 
+# reference --static-model-types values (utils.ModelType there) → the
+# capability families our PATH_CAPABILITY filter understands. Lets an
+# operator declare what an EXTERNAL backend (vLLM/whisper pod that
+# doesn't advertise our capability card) can serve, so capability
+# filtering still works (reference: run-router.sh in its tutorial 23
+# passes --static-model-types transcription).
+MODEL_TYPE_CAPABILITIES = {
+    "chat": frozenset({"chat"}),
+    "completion": frozenset({"completions"}),
+    "embeddings": frozenset({"embeddings"}),
+    "rerank": frozenset({"rerank"}),
+    "score": frozenset({"score"}),
+    "transcription": frozenset({"audio.transcriptions",
+                                "audio.translations"}),
+    "vision": frozenset({"chat"}),
+    "messages": frozenset({"messages"}),
+}
+
+
 class StaticServiceDiscovery(ServiceDiscovery):
     def __init__(
         self,
@@ -67,6 +86,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         health_check_interval: float = 10.0,
         query_models: bool = False,
         aliases: Optional[dict[str, str]] = None,
+        model_types: Optional[list[Optional[str]]] = None,
     ):
         super().__init__()
         self.urls = urls
@@ -75,6 +95,21 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.health_check = health_check
         self.health_check_interval = health_check_interval
         self.query_models = query_models
+        self.model_types = model_types or [None] * len(urls)
+        if len(self.model_types) != len(urls):
+            # fail at STARTUP like the bad-value case — a short list
+            # would IndexError on every request at runtime instead
+            raise ValueError(
+                f"--static-model-types has {len(self.model_types)} "
+                f"entries for {len(urls)} backends (give one per "
+                "backend, or a single type for all)"
+            )
+        for t in self.model_types:
+            if t is not None and t not in MODEL_TYPE_CAPABILITIES:
+                raise ValueError(
+                    f"unsupported static model type {t!r}; supported: "
+                    f"{', '.join(sorted(MODEL_TYPE_CAPABILITIES))}"
+                )
         self.unhealthy: set[str] = set()
         self.sleeping: set[str] = set()
         self._task: Optional[asyncio.Task] = None
@@ -88,6 +123,11 @@ class StaticServiceDiscovery(ServiceDiscovery):
             if url in self.unhealthy:
                 continue
             models = self._queried_models.get(url) or [self.models[i]]
+            # a live capability card wins; the declared model type is
+            # the fallback for backends that don't advertise one
+            caps = self._queried_caps.get(url)
+            if caps is None and self.model_types[i] is not None:
+                caps = MODEL_TYPE_CAPABILITIES[self.model_types[i]]
             out.append(
                 EndpointInfo(
                     url=url,
@@ -95,7 +135,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     model_info={m: ModelInfo(m) for m in models},
                     model_label=self.model_labels[i],
                     sleep=url in self.sleeping,
-                    capabilities=self._queried_caps.get(url),
+                    capabilities=caps,
                 )
             )
         return out

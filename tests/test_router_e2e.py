@@ -350,3 +350,60 @@ def test_no_capability_advertisement_means_no_filtering():
             await ts.close()
 
     asyncio.run(main())
+
+
+def test_static_model_types_enable_capability_filtering():
+    """--static-model-types (the reference's flag, its whisper tutorial
+    passes `transcription`) declares an EXTERNAL backend's modality so
+    filtering works without a capability card: chat against a declared
+    transcription backend 501s; a declared chat backend proxies."""
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        from production_stack_tpu.testing.fake_engine import FakeEngine
+
+        fe = FakeEngine(model="whisper-ext")  # capabilities=None
+        ts = TestServer(fe.build_app())
+        await ts.start_server()
+        router, client = await router_client(
+            [f"http://127.0.0.1:{ts.port}"],
+            extra_args=("--static-model-types", "transcription"),
+        )
+        # router_client hardcodes tiny-llama as the model name; the
+        # declared TYPE is per-backend so the filter still applies
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 501, await r.text()
+            body = await r.json()
+            assert body["error"]["code"] == "unsupported_endpoint"
+        finally:
+            await client.close()
+            await ts.close()
+
+        # bad type is rejected at startup
+        import pytest
+
+        from production_stack_tpu.router.service_discovery import (
+            StaticServiceDiscovery,
+        )
+
+        with pytest.raises(ValueError, match="unsupported static model"):
+            StaticServiceDiscovery(["http://x"], ["m"],
+                                   model_types=["banana"])
+
+    asyncio.run(main())
+
+
+def test_static_model_types_length_mismatch_fails_at_startup():
+    import pytest
+
+    from production_stack_tpu.router.service_discovery import (
+        StaticServiceDiscovery,
+    )
+
+    with pytest.raises(ValueError, match="entries for"):
+        StaticServiceDiscovery(["http://a", "http://b", "http://c"],
+                               ["m"] * 3,
+                               model_types=["chat", "transcription"])
