@@ -532,3 +532,33 @@ def test_extproc_ping_and_continuation(picker):
         assert ep is not None
     finally:
         client.close()
+
+
+def test_extproc_window_update_covers_padding(picker):
+    """RFC 7540 §6.9: flow control counts the WHOLE DATA payload
+    including the pad-length byte and padding — the server must
+    replenish exactly that, or padded traffic slowly starves the
+    connection window (r5 review class)."""
+    client = H2Client(picker)
+    try:
+        client.send(frame(HEADERS, END_HEADERS, 1, request_headers_block()))
+        body_frame = grpc_msg(processing_request_body(
+            b'{"model": "m", "prompt": "pad me"}'))
+        pad = 9
+        padded_payload = bytes([pad]) + body_frame + b"\x00" * pad
+        client.send(frame(DATA, END_STREAM | PADDED, 1, padded_payload))
+        conn_increments = []
+        while True:
+            fr = client.read_frame()
+            assert fr is not None
+            ftype, flags, sid, payload = fr
+            if ftype == SETTINGS and not flags & ACK:
+                client.send(frame(SETTINGS, ACK, 0))
+            elif ftype == WINUP and sid == 0:
+                conn_increments.append(int.from_bytes(payload, "big"))
+            elif ftype == HEADERS and flags & END_STREAM and sid == 1:
+                break
+        assert sum(conn_increments) == len(padded_payload), (
+            conn_increments, len(padded_payload))
+    finally:
+        client.close()
