@@ -333,6 +333,13 @@ class WhisperRunner:
                 kv, last = self._dec_prefill(
                     P, self.params, ck, cv, jnp.asarray(tokens),
                     jnp.full((1,), n_forced, jnp.int32))
+            if info is not None:
+                # Whisper's VAD signal: the <|nospeech|> probability at
+                # the first prediction position (vocab layout: nospeech
+                # sits right below notimestamps)
+                probs = jax.nn.softmax(last[0])
+                info["no_speech_prob"] = float(
+                    probs[cfg.notimestamps_id - 1])
             cur = jnp.full((), n_forced, jnp.int32)
             n_gen = jnp.zeros((), jnp.int32)
             key = jax.random.PRNGKey(seed)

@@ -262,6 +262,7 @@ class WhisperServer:
                     "id": i, "seek": 0, "start": s["start"],
                     "end": s["end"], "text": s["text"],
                     "tokens": s["tokens"], "temperature": temperature,
+                    "no_speech_prob": info.get("no_speech_prob", 0.0),
                 } for i, s in enumerate(segments)],
             })
         return web.json_response({"text": text})

@@ -780,6 +780,7 @@ def test_transcriptions_segment_formats(wserver):
         assert body["segments"], body
         for s in body["segments"]:
             assert 0.0 <= s["start"] <= s["end"] <= body["duration"] + 30
+            assert 0.0 <= s["no_speech_prob"] <= 1.0
         r = await client.post(
             "/v1/audio/transcriptions",
             data=_form(language="en", response_format="srt"))
