@@ -130,30 +130,36 @@ class WhisperRunner:
                              -jnp.inf, logits)
 
         def sample(logits, n_gen, temp, key, timestamps):
+            """-> (token, its log-probability under the suppressed
+            distribution — verbose_json's avg_logprob input)."""
             logits = suppress(logits, n_gen, timestamps)
             greedy = jnp.argmax(logits).astype(jnp.int32)
             drawn = jax.random.categorical(
                 key, logits / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
-            return jnp.where(temp > 0.0, drawn, greedy)
+            tok = jnp.where(temp > 0.0, drawn, greedy)
+            logp = jax.nn.log_softmax(logits)[tok]
+            return tok, logp
 
         @jax.jit
         def chunk(params, kv, ck, cv, cur_len, n_gen, last_logits,
                   limit, temp, key, timestamps):
             """Generate up to DECODE_CHUNK tokens from ``last_logits``.
 
-            Returns (buf (CHUNK,), n_emitted, kv, cur_len, n_gen,
-            last_logits, done)."""
+            Returns (buf (CHUNK,), logp_buf (CHUNK,), n_emitted, kv,
+            cur_len, n_gen, last_logits, done)."""
             buf0 = jnp.zeros((DECODE_CHUNK,), jnp.int32)
+            logp0 = jnp.zeros((DECODE_CHUNK,), jnp.float32)
 
             def cond(c):
-                i, _, _, cur, n, _, done, _ = c
+                i, _, _, _, cur, n, _, done, _ = c
                 return (~done) & (i < DECODE_CHUNK) & (cur < limit)
 
             def body(c):
-                i, buf, kv, cur, n, logits, done, key = c
+                i, buf, logp_buf, kv, cur, n, logits, done, key = c
                 key, sub = jax.random.split(key)
-                tok = sample(logits[0], n, temp, sub, timestamps)
+                tok, logp = sample(logits[0], n, temp, sub, timestamps)
                 buf = buf.at[i].set(tok)
+                logp_buf = logp_buf.at[i].set(logp)
                 is_eot = tok == cfg.eot_id
                 new_logits, kv = W.decode_tokens(
                     cfg, params, tok[None, None], cur[None], kv, ck, cv,
@@ -161,14 +167,14 @@ class WhisperRunner:
                 # n counts TEXT tokens (eot-release guard): a leading
                 # <|0.00|> must not satisfy "at least one text token"
                 n_next = n + jnp.where(tok < cfg.eot_id, 1, 0)
-                return (i + 1, buf, kv, cur + 1, n_next,
+                return (i + 1, buf, logp_buf, kv, cur + 1, n_next,
                         new_logits[:, 0], is_eot, key)
 
-            i, buf, kv, cur, n, logits, done, _ = lax.while_loop(
+            i, buf, logp_buf, kv, cur, n, logits, done, _ = lax.while_loop(
                 cond, body,
-                (jnp.int32(0), buf0, kv, cur_len, n_gen, last_logits,
-                 jnp.bool_(False), key))
-            return buf, i, kv, cur, n, logits, done
+                (jnp.int32(0), buf0, logp0, kv, cur_len, n_gen,
+                 last_logits, jnp.bool_(False), key))
+            return buf, logp_buf, i, kv, cur, n, logits, done
 
         return chunk
 
@@ -219,46 +225,61 @@ class WhisperRunner:
         tokenizers don't even carry them in vocab)."""
         return [t for t in tokens if t <= self.cfg.notimestamps_id]
 
-    def segments_from_tokens(self, tokens: list[int],
-                             duration: float) -> list[dict]:
+    def segments_from_tokens(self, tokens: list[int], duration: float,
+                             logprobs: Optional[list[float]] = None,
+                             ) -> list[dict]:
         """Split a timestamp-mode token stream into segments.
 
         Timestamp tokens encode ``(id - notimestamps_id - 1) * 0.02``
         seconds; text between a start and end timestamp is one segment.
         Lenient parse (the decoder is not grammar-constrained): an
-        unclosed final segment ends at the clip duration."""
+        unclosed final segment ends at the clip duration. ``logprobs``
+        (aligned with ``tokens``) adds per-segment ``avg_logprob``;
+        ``compression_ratio`` (OpenAI schema: gzip-incompressibility of
+        the text, the repetition-loop detector) is always computed."""
+        import zlib
+
         cfg = self.cfg
         base = cfg.notimestamps_id + 1
+        lps = logprobs if logprobs and len(logprobs) == len(tokens) \
+            else [0.0] * len(tokens)
 
         def ts(tok):
             return (tok - base) * 0.02
 
+        def emit(start, end, text_toks, text_lps):
+            text = self.tokenizer.decode(text_toks)
+            raw = text.encode() or b" "
+            return {
+                "start": round(start, 2), "end": round(end, 2),
+                "tokens": text_toks, "text": text,
+                "avg_logprob": round(
+                    sum(text_lps) / max(len(text_lps), 1), 4),
+                "compression_ratio": round(
+                    len(raw) / max(len(zlib.compress(raw)), 1), 3),
+            }
+
         segments: list[dict] = []
         start = 0.0
         text_toks: list[int] = []
-        for t in tokens:
+        text_lps: list[float] = []
+        for t, lp in zip(tokens, lps):
             if t > cfg.notimestamps_id:  # timestamp token
                 if text_toks:
                     # ungrammatical decodes can emit a smaller timestamp
                     # after a larger one: clamp so no cue ever has
                     # start > end (subtitle players reject those)
-                    end = max(ts(t), start)
-                    segments.append({
-                        "start": round(start, 2), "end": round(end, 2),
-                        "tokens": text_toks,
-                        "text": self.tokenizer.decode(text_toks),
-                    })
-                    text_toks = []
+                    segments.append(
+                        emit(start, max(ts(t), start), text_toks,
+                             text_lps))
+                    text_toks, text_lps = [], []
                 start = ts(t)
             elif t != cfg.eot_id:
                 text_toks.append(t)
+                text_lps.append(lp)
         if text_toks:
-            segments.append({
-                "start": round(start, 2),
-                "end": round(max(duration, start), 2),
-                "tokens": text_toks,
-                "text": self.tokenizer.decode(text_toks),
-            })
+            segments.append(
+                emit(start, max(duration, start), text_toks, text_lps))
         return segments
 
     def _detect_language_from(self, ck, cv) -> str:
@@ -351,15 +372,21 @@ class WhisperRunner:
                 # transcriptions interleave at chunk granularity instead
                 # of head-of-line-blocking for whole clips
                 with self.lock:
-                    buf, n_emit, kv, cur, n_gen, last, done_dev = \
+                    buf, logps, n_emit, kv, cur, n_gen, last, done_dev = \
                         self._chunk(
                             self.params, kv, ck, cv, cur, n_gen, last,
                             jnp.int32(limit), jnp.float32(temperature),
                             sub, jnp.bool_(timestamps))
                 n_emit = int(n_emit)
                 out = np.asarray(buf[:n_emit]).tolist()
+                out_lp = np.asarray(logps[:n_emit]).tolist()
                 done = bool(done_dev) or n_emit < DECODE_CHUNK
-                yield [t for t in out if t != cfg.eot_id]
+                kept = [(t, lp) for t, lp in zip(out, out_lp)
+                        if t != cfg.eot_id]
+                if info is not None:  # aligned with every yielded token
+                    info.setdefault("logprobs", []).extend(
+                        lp for _, lp in kept)
+                yield [t for t, _ in kept]
         finally:
             self.admit.release()
 

@@ -229,10 +229,14 @@ class WhisperServer:
         text = self.runner.tokenizer.decode(
             self.runner.strip_timestamps(tokens))
         if ts_mode:
-            segments = self.runner.segments_from_tokens(tokens, duration)
+            segments = self.runner.segments_from_tokens(
+                tokens, duration, logprobs=info.get("logprobs"))
         else:  # one segment spanning the clip
+            lps = info.get("logprobs") or []
             segments = [{"start": 0.0, "end": duration, "tokens": tokens,
-                         "text": text}]
+                         "text": text,
+                         "avg_logprob": round(
+                             sum(lps) / max(len(lps), 1), 4)}]
         self.requests.labels(endpoint, "200").inc()
         self.audio_seconds.inc(duration)
         self.latency.observe(time.monotonic() - t0)
@@ -263,6 +267,8 @@ class WhisperServer:
                     "end": s["end"], "text": s["text"],
                     "tokens": s["tokens"], "temperature": temperature,
                     "no_speech_prob": info.get("no_speech_prob", 0.0),
+                    "avg_logprob": s.get("avg_logprob", 0.0),
+                    "compression_ratio": s.get("compression_ratio", 1.0),
                 } for i, s in enumerate(segments)],
             })
         return web.json_response({"text": text})

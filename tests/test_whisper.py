@@ -781,6 +781,8 @@ def test_transcriptions_segment_formats(wserver):
         for s in body["segments"]:
             assert 0.0 <= s["start"] <= s["end"] <= body["duration"] + 30
             assert 0.0 <= s["no_speech_prob"] <= 1.0
+            assert s["avg_logprob"] <= 0.0  # greedy: log-prob of argmax
+            assert s["compression_ratio"] > 0.0
         r = await client.post(
             "/v1/audio/transcriptions",
             data=_form(language="en", response_format="srt"))
