@@ -48,6 +48,25 @@ DECODE_CHUNK = 32
 PROMPT_BUCKETS = (8, 32, 128)
 
 
+def timestamp_suppress_mask(cfg, ids, timestamps, last_ts, ts_run):
+    """The timestamp-rule part of the suppression mask (pure; unit-
+    tested directly). Upstream ApplyTimestampRules distilled:
+
+    - timestamps are non-decreasing (ids below ``last_ts`` masked);
+    - an EQUAL timestamp is allowed only as the immediate second half
+      of a boundary pair (``ts_run == 1``); after text, the next
+      timestamp must be strictly greater (no zero-length segments);
+    - after two consecutive timestamps (``ts_run >= 2``) the whole
+      timestamp range is masked — text or eot must follow, so a
+      degenerate decode can never loop on one timestamp forever.
+    """
+    import jax.numpy as jnp
+
+    is_ts = ids > cfg.notimestamps_id
+    below = jnp.where(ts_run == 1, ids < last_ts, ids <= last_ts)
+    return timestamps & is_ts & (below | (ts_run >= 2))
+
+
 class WhisperRunner:
     """Single-model transcription runner.
 
@@ -123,16 +142,18 @@ class WhisperRunner:
         special = ids > cfg.eot_id
         non_ts_special = (ids > cfg.eot_id) & (ids <= cfg.notimestamps_id)
 
-        def suppress(logits, n_gen, timestamps):
+        def suppress(logits, n_gen, timestamps, last_ts, ts_run):
             mask = jnp.where(timestamps, non_ts_special, special)
+            mask = mask | timestamp_suppress_mask(
+                cfg, ids, timestamps, last_ts, ts_run)
             logits = jnp.where(mask, -jnp.inf, logits)
             return jnp.where((ids == cfg.eot_id) & (n_gen < 1),
                              -jnp.inf, logits)
 
-        def sample(logits, n_gen, temp, key, timestamps):
+        def sample(logits, n_gen, temp, key, timestamps, last_ts, ts_run):
             """-> (token, its log-probability under the suppressed
             distribution — verbose_json's avg_logprob input)."""
-            logits = suppress(logits, n_gen, timestamps)
+            logits = suppress(logits, n_gen, timestamps, last_ts, ts_run)
             greedy = jnp.argmax(logits).astype(jnp.int32)
             drawn = jax.random.categorical(
                 key, logits / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
@@ -142,22 +163,28 @@ class WhisperRunner:
 
         @jax.jit
         def chunk(params, kv, ck, cv, cur_len, n_gen, last_logits,
-                  limit, temp, key, timestamps):
+                  limit, temp, key, timestamps, last_ts, ts_run):
             """Generate up to DECODE_CHUNK tokens from ``last_logits``.
 
-            Returns (buf (CHUNK,), logp_buf (CHUNK,), n_emitted, kv,
-            cur_len, n_gen, last_logits, done)."""
+            ``last_ts`` carries the highest timestamp id emitted so far
+            (0 = none) and ``ts_run`` the current consecutive-timestamp
+            run length across chunks, so the timestamp rules hold
+            globally. Returns (buf (CHUNK,), logp_buf (CHUNK,),
+            n_emitted, kv, cur_len, n_gen, last_logits, done, last_ts,
+            ts_run)."""
             buf0 = jnp.zeros((DECODE_CHUNK,), jnp.int32)
             logp0 = jnp.zeros((DECODE_CHUNK,), jnp.float32)
 
             def cond(c):
-                i, _, _, _, cur, n, _, done, _ = c
+                i, _, _, _, cur, n, _, done, _, _, _ = c
                 return (~done) & (i < DECODE_CHUNK) & (cur < limit)
 
             def body(c):
-                i, buf, logp_buf, kv, cur, n, logits, done, key = c
+                (i, buf, logp_buf, kv, cur, n, logits, done, key, lts,
+                 run) = c
                 key, sub = jax.random.split(key)
-                tok, logp = sample(logits[0], n, temp, sub, timestamps)
+                tok, logp = sample(logits[0], n, temp, sub, timestamps,
+                                   lts, run)
                 buf = buf.at[i].set(tok)
                 logp_buf = logp_buf.at[i].set(logp)
                 is_eot = tok == cfg.eot_id
@@ -167,14 +194,19 @@ class WhisperRunner:
                 # n counts TEXT tokens (eot-release guard): a leading
                 # <|0.00|> must not satisfy "at least one text token"
                 n_next = n + jnp.where(tok < cfg.eot_id, 1, 0)
+                is_ts = tok > cfg.notimestamps_id
+                lts = jnp.where(is_ts, jnp.maximum(lts, tok), lts)
+                run = jnp.where(is_ts, run + 1, jnp.int32(0))
                 return (i + 1, buf, logp_buf, kv, cur + 1, n_next,
-                        new_logits[:, 0], is_eot, key)
+                        new_logits[:, 0], is_eot, key, lts, run)
 
-            i, buf, logp_buf, kv, cur, n, logits, done, _ = lax.while_loop(
+            (i, buf, logp_buf, kv, cur, n, logits, done, _, last_ts,
+             ts_run) = lax.while_loop(
                 cond, body,
                 (jnp.int32(0), buf0, logp0, kv, cur_len, n_gen,
-                 last_logits, jnp.bool_(False), key))
-            return buf, logp_buf, i, kv, cur, n, logits, done
+                 last_logits, jnp.bool_(False), key, last_ts, ts_run))
+            return (buf, logp_buf, i, kv, cur, n, logits, done, last_ts,
+                    ts_run)
 
         return chunk
 
@@ -365,6 +397,8 @@ class WhisperRunner:
             n_gen = jnp.zeros((), jnp.int32)
             key = jax.random.PRNGKey(seed)
             done = False
+            last_ts = jnp.int32(0)
+            ts_run = jnp.int32(0)
             while not done:
                 key, sub = jax.random.split(key)
                 # lock per CHUNK, not per request: every request's decode
@@ -372,11 +406,11 @@ class WhisperRunner:
                 # transcriptions interleave at chunk granularity instead
                 # of head-of-line-blocking for whole clips
                 with self.lock:
-                    buf, logps, n_emit, kv, cur, n_gen, last, done_dev = \
-                        self._chunk(
-                            self.params, kv, ck, cv, cur, n_gen, last,
-                            jnp.int32(limit), jnp.float32(temperature),
-                            sub, jnp.bool_(timestamps))
+                    (buf, logps, n_emit, kv, cur, n_gen, last, done_dev,
+                     last_ts, ts_run) = self._chunk(
+                        self.params, kv, ck, cv, cur, n_gen, last,
+                        jnp.int32(limit), jnp.float32(temperature),
+                        sub, jnp.bool_(timestamps), last_ts, ts_run)
                 n_emit = int(n_emit)
                 out = np.asarray(buf[:n_emit]).tolist()
                 out_lp = np.asarray(logps[:n_emit]).tolist()

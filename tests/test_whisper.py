@@ -794,3 +794,58 @@ def test_transcriptions_segment_formats(wserver):
         assert (await r.text()).startswith("WEBVTT")
 
     run(with_client(wserver, fn))
+
+
+def test_runner_timestamps_monotonic(runner):
+    """The decoder masks timestamp ids below the last emitted one
+    (upstream ApplyTimestampRules core), so the raw token stream's
+    timestamps never decrease — and a timestamp run never exceeds a
+    pair (progress is forced)."""
+    cfg = runner.cfg
+    feats = _features(runner)
+    toks = runner.transcribe(feats, language="en", timestamps=True)
+    ts = [t for t in toks if t > cfg.notimestamps_id]
+    assert ts == sorted(ts), ts
+    run = 0
+    for t in toks:
+        run = run + 1 if t > cfg.notimestamps_id else 0
+        assert run <= 2, toks
+
+
+def test_timestamp_suppress_mask_rules():
+    """Unit-pin the distilled ApplyTimestampRules mask (cannot pass
+    vacuously — r5 review): non-decreasing, equal only as the pair's
+    second half, full mask after a pair."""
+    import jax.numpy as jnp
+
+    from production_stack_tpu.engine.whisper_runner import (
+        timestamp_suppress_mask,
+    )
+
+    cfg = ModelConfig.from_pretrained("tiny-whisper")
+    ids = jnp.arange(cfg.vocab_size, dtype=jnp.int32)
+    base = cfg.notimestamps_id + 1
+    lt = jnp.int32(base + 2)  # last emitted <|0.04|>
+
+    def masked(ts_run):
+        m = timestamp_suppress_mask(cfg, ids, jnp.bool_(True), lt,
+                                    jnp.int32(ts_run))
+        return np.asarray(m)
+
+    # after text (run 0): equal masked, greater open, lower masked
+    m0 = masked(0)
+    assert m0[base] and m0[base + 1] and m0[base + 2]
+    assert not m0[base + 3]
+    assert not m0[100]  # text never masked by the timestamp rule
+    # immediately after one timestamp (run 1): equal allowed (the pair)
+    m1 = masked(1)
+    assert not m1[base + 2] and not m1[base + 3]
+    assert m1[base + 1]
+    # after a pair (run 2): the whole timestamp range is masked
+    m2 = masked(2)
+    assert m2[base:].all()
+    assert not m2[100]
+    # timestamps=False: rule inert
+    off = timestamp_suppress_mask(cfg, ids, jnp.bool_(False), lt,
+                                  jnp.int32(0))
+    assert not np.asarray(off).any()
