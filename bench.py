@@ -342,7 +342,7 @@ def main() -> None:
     # (leases expire). Keep claiming until the claim budget is spent —
     # each cycle reaps, spawns a fresh child (fresh axon session id),
     # and waits probe_timeout for the heartbeat.
-    claim_budget = float(os.environ.get("PSTPU_BENCH_CLAIM_BUDGET", "2400"))
+    claim_budget = float(os.environ.get("PSTPU_BENCH_CLAIM_BUDGET", "1800"))
     min_attempts = int(os.environ.get("PSTPU_BENCH_ATTEMPTS", "3"))
     errors: list[str] = []
     start = time.monotonic()
