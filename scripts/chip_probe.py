@@ -1,0 +1,31 @@
+#!/usr/bin/env python3
+"""Timeout-bounded TPU availability probe (exit 0 = chip granted).
+
+The axon tunnel's claim loop hangs ``jax.devices()`` forever when the
+pool refuses grants (see scripts/tpu_reaper.py's module docstring for
+the local-holder case) — this probe bounds the wait and prints WHERE it
+hung, so a wedge is diagnosed in seconds instead of wedging the caller.
+
+    python scripts/chip_probe.py [timeout_seconds]   # default 75
+
+Used between rounds to decide whether perf work can be measured; the
+bench's own claim loop (bench.py) retries on a budget instead.
+"""
+
+import faulthandler
+import sys
+
+
+def main() -> int:
+    timeout = float(sys.argv[1]) if len(sys.argv) > 1 else 75.0
+    faulthandler.dump_traceback_later(timeout, exit=True)
+    import jax
+
+    devices = jax.devices()
+    faulthandler.cancel_dump_traceback_later()
+    print(f"TPU-OK {devices}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
