@@ -293,6 +293,38 @@ async def run(args) -> dict:
     return summarize(results, wall)
 
 
+def parse_fault_targets(values: list[str],
+                        default_url: str) -> list[tuple[str, str]]:
+    """``SPEC[@URL]`` → (url, spec) pairs. URL defaults to --base-url —
+    useful only when pointing the bench straight at an engine; in a
+    routed topology each sick backend is named explicitly:
+    ``--fault-injection error_rate=0.5,stall_ms=500@http://pod-2:8100``."""
+    targets = []
+    for v in values:
+        spec, _, url = v.partition("@")
+        spec = spec.strip()
+        if not spec:
+            raise ValueError(f"empty fault spec in {v!r}")
+        targets.append(((url.strip() or default_url).rstrip("/"), spec))
+    return targets
+
+
+async def apply_faults(targets: list[tuple[str, str]],
+                       off: bool = False) -> None:
+    """Arm (or clear) fault injection via each target's POST
+    /debug/faults — the live-flip endpoint both the real engine server
+    and the fake engine expose."""
+    async with aiohttp.ClientSession() as session:
+        for url, spec in targets:
+            query = "off=1" if off else spec.replace(",", "&")
+            async with session.post(f"{url}/debug/faults?{query}") as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    raise SystemExit(
+                        f"fault-injection setup failed on {url}: {body}")
+                print(json.dumps({"fault_target": url, **body}), flush=True)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("multi-round-qa")
     p.add_argument("--base-url", default="http://localhost:8001")
@@ -332,27 +364,47 @@ def main(argv=None):
                         "reference's run.sh methodology: same workload at "
                         "each arrival rate, one summary per point; "
                         "overrides --qps)")
+    p.add_argument("--fault-injection", action="append", default=None,
+                   metavar="SPEC[@URL]",
+                   help="arm fault injection on a backend before the run "
+                        "and clear it after, via POST /debug/faults "
+                        "(repeatable; URL defaults to --base-url), e.g. "
+                        "error_rate=0.5,stall_ms=500@http://pod-2:8100 — "
+                        "drives resilience drills from the same harness "
+                        "that measures them")
     args = p.parse_args(argv)
-    if args.qps_sweep:
-        # parse EVERYTHING up front: a malformed token must fail before
-        # any (potentially hours-long) point runs, not mid-sweep
-        sweep_values = [float(x) for x in args.qps_sweep.split(",") if x.strip()]
-        if not sweep_values:
-            p.error("--qps-sweep has no values")
-        points = []
-        warmup_once = args.warmup_users
-        for qps in sweep_values:
-            args.qps = qps
-            point = asyncio.run(run(args))
-            args.warmup_users = 0  # warm tiers persist across the sweep
-            point["qps_target"] = qps
-            points.append(point)
-            print(json.dumps(point))
-        args.warmup_users = warmup_once
-        summary = {"sweep": points}
-    else:
-        summary = asyncio.run(run(args))
-        print(json.dumps(summary))
+    try:
+        fault_targets = parse_fault_targets(args.fault_injection or [],
+                                            args.base_url)
+    except ValueError as e:
+        p.error(str(e))
+    if fault_targets:
+        asyncio.run(apply_faults(fault_targets))
+    try:
+        if args.qps_sweep:
+            # parse EVERYTHING up front: a malformed token must fail before
+            # any (potentially hours-long) point runs, not mid-sweep
+            sweep_values = [float(x) for x in args.qps_sweep.split(",")
+                            if x.strip()]
+            if not sweep_values:
+                p.error("--qps-sweep has no values")
+            points = []
+            warmup_once = args.warmup_users
+            for qps in sweep_values:
+                args.qps = qps
+                point = asyncio.run(run(args))
+                args.warmup_users = 0  # warm tiers persist across the sweep
+                point["qps_target"] = qps
+                points.append(point)
+                print(json.dumps(point))
+            args.warmup_users = warmup_once
+            summary = {"sweep": points}
+        else:
+            summary = asyncio.run(run(args))
+            print(json.dumps(summary))
+    finally:
+        if fault_targets:
+            asyncio.run(apply_faults(fault_targets, off=True))
     if args.output:
         with open(args.output, "w") as f:
             json.dump(summary, f, indent=2)

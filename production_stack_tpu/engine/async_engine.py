@@ -22,6 +22,15 @@ from production_stack_tpu.engine.sampling import SamplingParams
 from production_stack_tpu.engine.sequence import RequestOutput
 
 
+class RequestAborted(Exception):
+    """Raised on a request's stream when its sequence was aborted
+    (deadline expiry / client disconnect / admin action) while a consumer
+    was still reading. Callers that abort their OWN stream cancel the
+    consumer first and never see this; it exists so an abort from
+    anywhere else can never leave a consumer blocked on q.get()
+    forever."""
+
+
 class AsyncEngine:
     def __init__(self, engine: LLMEngine):
         self.engine = engine
@@ -96,7 +105,17 @@ class AsyncEngine:
                     if self.loop is not None:
                         self.loop.call_soon_threadsafe(self._deliver_error, rid, e)
             elif kind == "abort":
-                self.engine.abort_request(payload)
+                aborted = self.engine.abort_request(payload)
+                if aborted and self.loop is not None:
+                    # wake any consumer still blocked on q.get(): the
+                    # aborted sequence will never emit a finished output.
+                    # Streams whose consumer initiated the abort (stop
+                    # strings, _abort_all) are already deregistered or
+                    # cancelled, so this is a no-op for them.
+                    self.loop.call_soon_threadsafe(
+                        self._deliver_error, payload,
+                        RequestAborted(f"request {payload} aborted"),
+                    )
             elif kind == "call":
                 fn, fut = payload
                 try:

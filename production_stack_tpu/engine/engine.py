@@ -176,6 +176,7 @@ class LLMEngine:
         self.total_output_tokens = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        self.aborted_seqs = 0  # cancelled/expired, KV freed early
 
     # -- request intake ------------------------------------------------------
     def add_request(
@@ -244,6 +245,7 @@ class LLMEngine:
             del self._slot_seq[seq.slot]
         if seq is not None:
             self._release_grammar(seq)
+            self.aborted_seqs += 1
         return seq is not None
 
     # -- constrained decoding (engine/grammar.py) ---------------------------
@@ -971,6 +973,7 @@ class LLMEngine:
             "cpu_prefix_cache_queries_total": 0,
             "spec_decode_num_draft_tokens_total": self.spec_drafted,
             "spec_decode_num_accepted_tokens_total": self.spec_accepted,
+            "aborted_seqs_total": self.aborted_seqs,
         }
         if self.host_kv is not None:
             out["cpu_cache_usage_perc"] = self.host_kv.usage
@@ -1058,6 +1061,17 @@ class LLMEngine:
         """Pre-compile every serving shape variant so no live request pays a
         compile: each prefill bucket at P=1, the P=prefill_batch variant,
         the greedy and general samplers, and the decode program."""
+        # the admission bound is client back-pressure; warmup's internal
+        # bursts must not trip it (a small --max-queue-len would otherwise
+        # kill the server at startup)
+        sched_cfg = self.config.scheduler
+        bound, sched_cfg.max_queue_len = sched_cfg.max_queue_len, 0
+        try:
+            self._warmup_impl()
+        finally:
+            sched_cfg.max_queue_len = bound
+
+    def _warmup_impl(self) -> None:
         import numpy as np
 
         rng = np.random.default_rng(0)

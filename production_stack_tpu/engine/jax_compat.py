@@ -1,0 +1,39 @@
+"""Version compatibility for the jax APIs the engine leans on.
+
+``jax.set_mesh`` (the global-mesh context) only exists in newer jax
+releases; on older ones the ``Mesh`` object itself is the equivalent
+context manager (it installs the physical mesh + resource environment
+for jit/shard_map). Without this shim every ``LLMEngine`` construction
+raises ``AttributeError`` on older jax — the engine, and every test
+that touches it, is dead on arrival. Both versions enter the context
+the same way:
+
+    from production_stack_tpu.engine.jax_compat import set_mesh
+    with set_mesh(mesh):
+        ...
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _mesh_is_context(mesh):
+    # pre-set_mesh jax: entering the Mesh itself is the supported idiom
+    return mesh
+
+
+set_mesh = getattr(jax, "set_mesh", _mesh_is_context)
+
+# jax.shard_map graduated from jax.experimental.shard_map (where the
+# replication-check kwarg was still called check_rep, not check_vma)
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=True, **kwargs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kwargs)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from production_stack_tpu.engine.jax_compat import set_mesh
 from production_stack_tpu.engine.config import CacheConfig, ModelConfig
 from production_stack_tpu.parallel import shardings as ln
 from production_stack_tpu.parallel.shardings import ShardingRules, logical_to_sharding
@@ -74,7 +75,7 @@ def init_kv_cache(
     def _zeros():
         return jnp.zeros(shape, dt)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         return jax.jit(_zeros, out_shardings=sharding)()
 
 

@@ -95,6 +95,12 @@ class EngineStatsCollector:
             s.get("spec_decode_num_accepted_tokens_total", 0),
         )
         yield counter(
+            "vllm:aborted_seqs",
+            "Sequences aborted (client disconnect / deadline expiry); "
+            "KV blocks freed before natural completion",
+            s.get("aborted_seqs_total", 0),
+        )
+        yield counter(
             "vllm:prompt_tokens", "Cumulative prompt tokens", s["prompt_tokens_total"]
         )
         yield counter(

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from production_stack_tpu.engine.jax_compat import set_mesh, shard_map
 from production_stack_tpu.engine.config import EngineConfig, ModelConfig
 from production_stack_tpu.engine import kv_cache as kvmod
 from production_stack_tpu.engine.quant import maybe_quantize
@@ -80,7 +81,7 @@ class ModelRunner:
             )
         self.rules = rules_for_model(self.cfg, mesh)
         self.model = get_model(self.cfg)
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             self.params = maybe_quantize(
                 self.cfg,
                 params
@@ -265,7 +266,7 @@ class ModelRunner:
             P(None),  # q_starts / unused
         )
         out_specs = (q_spec, P(None, None, None, AXIS_TENSOR, None))
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )
@@ -368,7 +369,7 @@ class ModelRunner:
         — logprobs ride every prefill (see _prefill_step)."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
         use_grammar = g_ids is not None and self.grammar_bank is not None
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.kv, result = self._prefill(
                 self.params, self.kv,
                 jnp.asarray(tokens), jnp.asarray(positions),
@@ -408,7 +409,7 @@ class ModelRunner:
         (1,). Long-context path: attention never materialises the full
         S x S score matrix on one device — K/V shards rotate the ring."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.kv, result = self._prefill_ring(
                 self.params, self.kv,
                 jnp.asarray(tokens), jnp.asarray(positions),
@@ -435,7 +436,7 @@ class ModelRunner:
         greedy argmax at EVERY position (B, S). The host accepts the longest
         draft prefix the model reproduces (engine/spec.py)."""
         use_lora = adapter_ids is not None and self.lora_bank is not None
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.kv, out = self._verify(
                 self.params, self.kv,
                 jnp.asarray(tokens), jnp.asarray(positions),
@@ -451,7 +452,7 @@ class ModelRunner:
                block_tables: np.ndarray, context_lens: np.ndarray,
                slot_mapping: np.ndarray):
         """One decode step over all slots. Returns logits (B, V)."""
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.kv, logits = self._decode(
                 self.params, self.kv,
                 jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
@@ -462,7 +463,7 @@ class ModelRunner:
 
     def _ensure_counts(self):
         if self.token_counts is None:
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 self.token_counts = jnp.zeros(
                     (self.config.scheduler.max_num_seqs, self.cfg.vocab_size),
                     jnp.int32,
@@ -482,7 +483,7 @@ class ModelRunner:
         for t in token_ids:
             if 0 <= t < self.cfg.vocab_size:
                 row[t] += 1
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.token_counts = self._set_count_row_fn(
                 self.token_counts, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(row),
@@ -544,7 +545,7 @@ class ModelRunner:
         # dispatch's program — already shaped, no eager ops on the hot path
         tok_in = (tokens_dev if tokens_dev is not None
                   else jnp.asarray(tokens[:, None]))
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             (self.kv, new_counts), (sampled, next_tok, *lp) = self._decode_multi(
                 self.params, self.kv,
                 tok_in, jnp.asarray(positions[:, None]),
@@ -595,7 +596,7 @@ class ModelRunner:
 
     def restore_params(self) -> None:
         if self.params is None:
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 self.params = maybe_quantize(self.cfg, init_or_load(
                     self.cfg, self.mesh, self.rules, self.config.seed
                 ))
@@ -637,7 +638,7 @@ class ModelRunner:
                 return pooled / jnp.maximum(jnp.sum(m, axis=1), 1.0)
 
             self._pooled_fn = jax.jit(_embed, **self._mh_gate_all)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             out = self._pooled_fn(
                 self.params, jnp.asarray(tokens), jnp.asarray(mask)
             )
@@ -686,7 +687,7 @@ class ModelRunner:
                 )
 
             self._seqlp_fn = jax.jit(_score, **self._mh_gate_all)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             out = self._seqlp_fn(
                 self.params, jnp.asarray(tokens), jnp.asarray(cont_mask)
             )
@@ -748,7 +749,7 @@ class ModelRunner:
                         lps.reshape(n, -1)[: S - 1])
 
             self._prompt_lp_fn = jax.jit(_score, **self._mh_gate_all)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             out = self._prompt_lp_fn(self.params, jnp.asarray(tokens))
         return tuple(np.asarray(x) for x in jax.device_get(out))
 
@@ -764,7 +765,7 @@ class ModelRunner:
                 f"grammar needs {fsm.n_states} states > budget {S}"
             )
         if self.grammar_bank is None:
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 self.grammar_bank = jnp.full((G, S, V), -1, jnp.int16)
                 self.grammar_accept = jnp.zeros((G, S), jnp.bool_)
             self._set_grammar_fn = jax.jit(
@@ -775,7 +776,7 @@ class ModelRunner:
         table[: fsm.n_states] = fsm.trans.astype(np.int16)
         acc = np.zeros(S, bool)
         acc[: fsm.n_states] = fsm.accept
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.grammar_bank, self.grammar_accept = self._set_grammar_fn(
                 self.grammar_bank, self.grammar_accept,
                 jnp.asarray(slot, jnp.int32), jnp.asarray(table),
@@ -789,7 +790,7 @@ class ModelRunner:
         dt = self.cfg.jax_dtype
         if self.lora_bank is None:
             self.lora_bank = {}
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for key, (A_st, B_st) in bank_np.items():
                 if key not in self.lora_bank:
                     L = A_st.shape[0]
@@ -806,7 +807,7 @@ class ModelRunner:
     def unregister_lora(self, slot: int) -> None:
         if self.lora_bank is None:
             return
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for key, (A_dev, B_dev) in self.lora_bank.items():
                 self.lora_bank[key] = (
                     A_dev.at[:, slot].set(0.0),
@@ -817,7 +818,7 @@ class ModelRunner:
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:
         """Gather blocks out of HBM → host (L, n, bs, 2KH, D) array."""
         idx = jnp.asarray(block_ids, jnp.int32)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             data = jax.jit(lambda kv, i: kv[:, i],
                            **self._mh_gate_all)(self.kv, idx)
         return np.asarray(jax.device_get(data))
@@ -853,7 +854,7 @@ class ModelRunner:
         instead of serialising a full-pool device_get."""
         idx = jnp.asarray(block_ids, jnp.int32)
         slice_fn, _ = self._range_fns(n_layers)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             data = slice_fn(self.kv, idx, jnp.asarray(layer_lo, jnp.int32))
         return np.asarray(jax.device_get(data))
 
@@ -864,7 +865,7 @@ class ModelRunner:
         def _scatter(kv, i, d):
             return kv.at[:, i].set(d.astype(kv.dtype))
 
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.kv = jax.jit(_scatter, donate_argnums=(0,))(
                 self.kv, idx, jnp.asarray(data)
             )
@@ -874,14 +875,14 @@ class ModelRunner:
         """Scatter one streamed layer group into the pool (donated)."""
         idx = jnp.asarray(block_ids, jnp.int32)
         _, scatter_fn = self._range_fns(int(data.shape[0]))
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.kv = scatter_fn(
                 self.kv, idx, jnp.asarray(data),
                 jnp.asarray(layer_lo, jnp.int32),
             )
 
     def sample(self, logits, temps, top_ps, top_ks, seeds, steps) -> np.ndarray:
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             toks = self._sample(
                 logits, jnp.asarray(temps), jnp.asarray(top_ps),
                 jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(steps),

@@ -28,6 +28,13 @@ from production_stack_tpu.engine.kv_cache import PrefixCachingBlockAllocator
 from production_stack_tpu.engine.sequence import Sequence, SequenceStatus
 
 
+class SchedulerQueueFull(Exception):
+    """Raised by ``Scheduler.add`` when the waiting queue is at
+    ``max_queue_len`` — the server maps it to 429 + Retry-After so the
+    router fails over / backs off instead of piling work onto an
+    overloaded engine."""
+
+
 @dataclasses.dataclass
 class ScheduledPrefill:
     seq: Sequence
@@ -68,6 +75,11 @@ class Scheduler:
 
     # -- queue management ---------------------------------------------------
     def add(self, seq: Sequence) -> None:
+        if (self.config.max_queue_len > 0
+                and len(self.waiting) >= self.config.max_queue_len):
+            raise SchedulerQueueFull(
+                f"waiting queue full ({len(self.waiting)} >= "
+                f"{self.config.max_queue_len})")
         self.waiting.append(seq)
 
     def abort(self, request_id: str) -> Optional[Sequence]:
@@ -87,6 +99,13 @@ class Scheduler:
     @property
     def num_waiting(self) -> int:
         return len(self.waiting)
+
+    @property
+    def num_free_blocks(self) -> int:
+        """Reusable KV blocks (free pool + evictable cached); the
+        deadline/disconnect tests assert this returns to its
+        pre-request baseline after an abort."""
+        return self.allocator.num_free_blocks
 
     @property
     def num_running(self) -> int:

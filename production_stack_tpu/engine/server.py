@@ -142,6 +142,20 @@ def _parse_logit_bias(raw) -> Optional[dict]:
     return {int(k): float(v) for k, v in raw.items()}
 
 
+def _parse_deadline(headers) -> Optional[float]:
+    """Absolute epoch-seconds deadline from the router-propagated
+    ``x-request-deadline`` header; None when absent or malformed (a
+    malformed deadline must degrade to no deadline, never to a 400 —
+    only the router sets this header)."""
+    hdr = headers.get("x-request-deadline")
+    if not hdr:
+        return None
+    try:
+        return float(hdr)
+    except ValueError:
+        return None
+
+
 MAX_CHOICES = 128  # OpenAI caps n at 128; batched prompts share the cap
 
 # echo+logprobs scores the prompt with a dense teacher-forced pass whose
@@ -181,13 +195,17 @@ ENGINE_CAPABILITIES = (
 
 class EngineServer:
     def __init__(self, config: EngineConfig, engine: Optional[LLMEngine] = None,
-                 warmup_on_start: bool = False):
+                 warmup_on_start: bool = False,
+                 overload_retry_after: float = 1.0):
         self.config = config
         self.warmup_on_start = warmup_on_start
         self.model_name = config.model.name
         self.engine = engine or LLMEngine(config)
         self.async_engine = AsyncEngine(self.engine)
         self.metrics = ServerMetrics(self.engine, self.model_name)
+        # Retry-After seconds advertised on overload 429s; the router's
+        # circuit breaker uses it as the ejection cooldown
+        self.overload_retry_after = overload_retry_after
         from production_stack_tpu.engine.lora import LoraManager
 
         self.lora = LoraManager(self.engine)
@@ -1039,7 +1057,9 @@ class EngineServer:
         body = {"active": s is not None}
         if s is not None:
             body.update(error_rate=s.error_rate, latency_ms=s.latency_ms,
-                        drop_rate=s.drop_rate)
+                        drop_rate=s.drop_rate, stall_ms=s.stall_ms,
+                        stream_abort_rate=s.stream_abort_rate,
+                        stream_abort_after_ms=s.stream_abort_after_ms)
         return web.json_response(body)
 
     # -- profiling ------------------------------------------------------------
@@ -1281,6 +1301,15 @@ class EngineServer:
         model = body.get("model", self.model_name)
         stream = bool(body.get("stream", False))
         t_start = time.monotonic()
+        deadline = _parse_deadline(request.headers)
+        if deadline is not None and deadline <= time.time():
+            # expired before admission: refuse without touching the
+            # scheduler — cheapest possible shed
+            return web.json_response(
+                {"error": {"message": "x-request-deadline already expired",
+                           "type": "timeout_error"}},
+                status=504,
+            )
 
         for prompt_ids in prompt_ids_list:
             if len(prompt_ids) > self.config.model.max_model_len - 1:
@@ -1360,17 +1389,16 @@ class EngineServer:
         # when the token FSM is built against the real vocabulary) become
         # clean statuses here instead of mid-flight stream errors.
         from production_stack_tpu.engine.engine import GrammarBankFull
+        from production_stack_tpu.engine.scheduler import SchedulerQueueFull
 
         try:
             gens = await self.async_engine.admit_batch(reqs)
         except GrammarBankFull:
-            return web.json_response(
-                {"error": {"message":
-                           "all guided-decoding grammar slots are in use; "
-                           "retry when in-flight guided requests finish",
-                           "type": "rate_limit_error"}},
-                status=429,
-            )
+            return self._overloaded(
+                "all guided-decoding grammar slots are in use; "
+                "retry when in-flight guided requests finish")
+        except SchedulerQueueFull as e:
+            return self._overloaded(str(e))
         except ValueError as e:
             return web.json_response(
                 {"error": {"message": str(e),
@@ -1396,10 +1424,21 @@ class EngineServer:
                 request, gens, rids, rid, created, model, chat, t_start,
                 n_prompt, sampling,
                 include_usage=bool(so.get("include_usage")),
+                deadline=deadline,
             )
         return await self._full_response(
             gens, rids, rid, created, model, chat, t_start, n_prompt, sampling,
-            produce_kv=produce_kv, echo_info=echo_info,
+            produce_kv=produce_kv, echo_info=echo_info, deadline=deadline,
+        )
+
+    def _overloaded(self, msg: str) -> web.Response:
+        """429 with Retry-After: an HONEST overload signal the router's
+        circuit breaker respects (fails over now, throttles this backend
+        for the advertised interval)."""
+        return web.json_response(
+            {"error": {"message": msg, "type": "rate_limit_error"}},
+            status=429,
+            headers={"Retry-After": f"{self.overload_retry_after:g}"},
         )
 
     async def _abort_all(self, tasks, rids):
@@ -1425,7 +1464,7 @@ class EngineServer:
     async def _full_response(self, gens, rids, rid, created, model, chat,
                              t_start, n_prompt, sampling,
                              produce_kv=False,
-                             echo_info=None) -> web.Response:
+                             echo_info=None, deadline=None) -> web.Response:
         tk = self.engine.tokenizer
 
         async def collect(gen, crid):
@@ -1459,7 +1498,26 @@ class EngineServer:
         tasks = [asyncio.ensure_future(collect(g, r))
                  for g, r in zip(gens, rids)]
         try:
-            results = await asyncio.gather(*tasks)
+            if deadline is not None:
+                results = await asyncio.wait_for(
+                    asyncio.gather(*tasks), deadline - time.time())
+            else:
+                results = await asyncio.gather(*tasks)
+        except asyncio.TimeoutError:
+            # deadline expired mid-generation: remove the sequences from
+            # the scheduler and free their KV blocks before answering
+            await self._abort_all(tasks, rids)
+            return web.json_response(
+                {"error": {"message": "request deadline exceeded",
+                           "type": "timeout_error"}},
+                status=504,
+            )
+        except asyncio.CancelledError:
+            # client disconnected while we buffered the whole response:
+            # without this the sequences would decode to completion with
+            # nobody reading (KV blocks + slots held the entire time)
+            await self._abort_all(tasks, rids)
+            raise
         except ValueError as e:
             await self._abort_all(tasks, rids)
             return web.json_response(
@@ -1686,7 +1744,8 @@ class EngineServer:
 
     async def _stream_response(self, request, gens, rids, rid, created, model,
                                chat, t_start, n_prompt, sampling,
-                               include_usage=False) -> web.StreamResponse:
+                               include_usage=False,
+                               deadline=None) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -1800,8 +1859,19 @@ class EngineServer:
         tasks = [asyncio.ensure_future(stream_one(g, r, i))
                  for i, (g, r) in enumerate(zip(gens, rids))]
         try:
-            kept = await asyncio.gather(*tasks)
+            if deadline is not None:
+                kept = await asyncio.wait_for(
+                    asyncio.gather(*tasks), deadline - time.time())
+            else:
+                kept = await asyncio.gather(*tasks)
             n_out = sum(kept)
+        except asyncio.TimeoutError:
+            # deadline expired mid-stream: abort (frees KV), then tell the
+            # client in-band before [DONE] — the stream already committed 200
+            reaped = await self._abort_all(tasks, rids)
+            n_out = sum(r for r in reaped if isinstance(r, int))
+            await send({"error": {"message": "request deadline exceeded",
+                                  "type": "timeout_error"}})
         except ValueError as e:
             reaped = await self._abort_all(tasks, rids)
             # count whatever completed choices managed to stream so the
@@ -1879,8 +1949,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="longest tail n-gram matched against the history")
     p.add_argument("--fault-injection", default=None,
                    help="inject faults on the OpenAI surface for "
-                        "resilience drills, e.g. "
-                        "error_rate=0.3,latency_ms=100 (testing/faults.py)")
+                        "resilience drills, e.g. error_rate=0.3,"
+                        "latency_ms=100,stall_ms=500,stream_abort_rate=0.1 "
+                        "(testing/faults.py)")
+    p.add_argument("--max-queue-len", type=int, default=None,
+                   help="waiting-queue bound; admissions past it get 429 "
+                        "+ Retry-After so the router fails over instead "
+                        "of piling onto an overloaded engine (0 = "
+                        "unbounded)")
+    p.add_argument("--overload-retry-after", type=float, default=1.0,
+                   help="Retry-After seconds advertised on overload 429s")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
     p.add_argument("--platform", default=None,
@@ -1952,6 +2030,8 @@ def config_from_args(args) -> EngineConfig:
     if args.speculative_ngram:
         cfg.scheduler.spec_ngram_k = args.speculative_ngram
         cfg.scheduler.spec_ngram_max = args.speculative_ngram_max
+    if args.max_queue_len is not None:
+        cfg.scheduler.max_queue_len = args.max_queue_len
     if args.host_offload_blocks:
         cfg.cache.host_offload_blocks = args.host_offload_blocks
     if args.remote_kv_url:
@@ -2137,7 +2217,8 @@ def main(argv=None) -> None:
         engine.runner = MirroredRunner(engine.runner, broadcaster)
         atexit.register(broadcaster.close)
     server = EngineServer(config, engine=engine,
-                          warmup_on_start=not args.skip_warmup)
+                          warmup_on_start=not args.skip_warmup,
+                          overload_retry_after=args.overload_retry_after)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
     if broadcaster is not None:

@@ -314,9 +314,14 @@ class WhisperRunner:
                 emit(start, max(duration, start), text_toks, text_lps))
         return segments
 
-    def _detect_language_from(self, ck, cv) -> str:
-        """argmax over the language tokens after <|startoftranscript|>.
-        Caller holds the lock and supplies the shared cross K/V."""
+    def _sot_logits(self, ck, cv) -> np.ndarray:
+        """Next-token logits at the <|startoftranscript|> position
+        (prefill of the bare SOT token). Caller holds the lock and
+        supplies the shared cross K/V. Feeds both language detection and
+        ``no_speech_prob`` — Whisper defines the no-speech probability
+        HERE, not at the first post-prefix prediction where the forced
+        task/language tokens have already conditioned the model toward
+        emitting text."""
         cfg = self.cfg
         P = PROMPT_BUCKETS[0]
         tokens = np.zeros((1, P), np.int32)
@@ -324,7 +329,13 @@ class WhisperRunner:
         _, last = self._dec_prefill(
             P, self.params, ck, cv, jnp.asarray(tokens),
             jnp.ones((1,), jnp.int32))
-        logits = np.asarray(last[0])
+        return np.asarray(last[0])
+
+    def _detect_language_from(self, ck, cv) -> str:
+        """argmax over the language tokens after <|startoftranscript|>.
+        Caller holds the lock and supplies the shared cross K/V."""
+        cfg = self.cfg
+        logits = self._sot_logits(ck, cv)
         lang_logits = logits[cfg.lang_base_id:cfg.lang_base_id + cfg.n_langs]
         return self.languages[int(np.argmax(lang_logits))]
 
@@ -366,13 +377,28 @@ class WhisperRunner:
         self.admit.acquire()
         try:
             with self.lock:
-                # ONE encoder pass shared by detection and transcription
+                # ONE encoder pass shared by detection and transcription,
+                # and ONE SOT prefill shared by language detection and
+                # the no-speech probability
                 ck, cv = self._encode(self.params,
                                       jnp.asarray(features)[None])
+                sot_logits = None
+                if (language is None and cfg.n_langs) or info is not None:
+                    sot_logits = self._sot_logits(ck, cv)
                 if language is None and cfg.n_langs:
-                    language = self._detect_language_from(ck, cv)
+                    lang_logits = sot_logits[
+                        cfg.lang_base_id:cfg.lang_base_id + cfg.n_langs]
+                    language = self.languages[int(np.argmax(lang_logits))]
             if info is not None:
                 info["language"] = language
+                # Whisper's VAD signal: P(<|nospeech|>) at the SOT
+                # position (vocab layout: nospeech sits right below
+                # notimestamps), from the same prefill language
+                # detection uses
+                z = sot_logits.astype(np.float64)
+                e = np.exp(z - z.max())
+                info["no_speech_prob"] = float(
+                    e[cfg.notimestamps_id - 1] / e.sum())
             forced = self._forced_tokens(language, task, prompt,
                                          timestamps=timestamps)
             P = self._bucket(len(forced))
@@ -386,13 +412,6 @@ class WhisperRunner:
                 kv, last = self._dec_prefill(
                     P, self.params, ck, cv, jnp.asarray(tokens),
                     jnp.full((1,), n_forced, jnp.int32))
-            if info is not None:
-                # Whisper's VAD signal: the <|nospeech|> probability at
-                # the first prediction position (vocab layout: nospeech
-                # sits right below notimestamps)
-                probs = jax.nn.softmax(last[0])
-                info["no_speech_prob"] = float(
-                    probs[cfg.notimestamps_id - 1])
             cur = jnp.full((), n_forced, jnp.int32)
             n_gen = jnp.zeros((), jnp.int32)
             key = jax.random.PRNGKey(seed)

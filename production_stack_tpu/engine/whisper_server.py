@@ -73,6 +73,10 @@ class WhisperServer:
         self.latency = Histogram(
             "pstpu_transcription_latency_seconds",
             "end-to-end transcription latency", registry=self.registry)
+        self.aborted = Counter(
+            "pstpu_transcription_aborted_requests",
+            "streams aborted before completion (client disconnect)",
+            ["endpoint"], registry=self.registry)
 
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=256 * 1024 * 1024)
@@ -185,37 +189,50 @@ class WhisperServer:
                 except StopIteration:
                     return None
 
-            # emit deltas of the CUMULATIVE decode, holding back a
-            # trailing replacement char: a multi-byte character whose
-            # tokens straddle a chunk boundary would otherwise stream as
-            # U+FFFD garbage the non-streaming path doesn't have
-            all_toks: list[int] = []
-            emitted = 0
-            while True:
-                piece = await loop.run_in_executor(None, next_piece)
-                if piece is None:
-                    break
-                all_toks.extend(piece)
+            try:
+                # emit deltas of the CUMULATIVE decode, holding back a
+                # trailing replacement char: a multi-byte character whose
+                # tokens straddle a chunk boundary would otherwise stream as
+                # U+FFFD garbage the non-streaming path doesn't have
+                all_toks: list[int] = []
+                emitted = 0
+                while True:
+                    piece = await loop.run_in_executor(None, next_piece)
+                    if piece is None:
+                        break
+                    all_toks.extend(piece)
+                    full = self.runner.tokenizer.decode(
+                        self.runner.strip_timestamps(all_toks))
+                    safe = full.rstrip("�")
+                    if len(safe) > emitted:
+                        await resp.write(
+                            b"data: "
+                            + json.dumps({"text": safe[emitted:]}).encode()
+                            + b"\n\n")
+                        emitted = len(safe)
                 full = self.runner.tokenizer.decode(
                     self.runner.strip_timestamps(all_toks))
-                safe = full.rstrip("�")
-                if len(safe) > emitted:
+                if len(full) > emitted:  # flush genuinely-unmappable tail
                     await resp.write(
                         b"data: "
-                        + json.dumps({"text": safe[emitted:]}).encode()
+                        + json.dumps({"text": full[emitted:]}).encode()
                         + b"\n\n")
-                    emitted = len(safe)
-            full = self.runner.tokenizer.decode(
-                self.runner.strip_timestamps(all_toks))
-            if len(full) > emitted:  # flush any genuinely-unmappable tail
-                await resp.write(
-                    b"data: " + json.dumps({"text": full[emitted:]}).encode()
-                    + b"\n\n")
-            await resp.write(b"data: [DONE]\n\n")
-            await resp.write_eof()
-            self.requests.labels(endpoint, "200").inc()
-            self.audio_seconds.inc(duration)
-            self.latency.observe(time.monotonic() - t0)
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                self.requests.labels(endpoint, "200").inc()
+                self.audio_seconds.inc(duration)
+                self.latency.observe(time.monotonic() - t0)
+            except (ConnectionResetError, asyncio.CancelledError):
+                self.aborted.labels(endpoint).inc()
+                raise
+            finally:
+                # a disconnect mid-stream leaves the generator suspended
+                # holding the runner's admission slot; close() runs its
+                # finally blocks (slot release) on the executor — generator
+                # frames execute device work and must stay off the loop.
+                # shield: even if this handler is cancelled again the close
+                # keeps running to completion on the executor thread
+                await asyncio.shield(loop.run_in_executor(None, gen.close))
             return resp
 
         try:

@@ -217,12 +217,17 @@ def encode(cfg: ModelConfig, params: dict, mel: jnp.ndarray) -> jnp.ndarray:
     p = params["enc"]
     x = mel.astype(cfg.jax_dtype).transpose(0, 2, 1)  # (B, T, n_mels)
     dn = ("NWC", "WIO", "NWC")  # feature-last: TPU-native conv layout
+    # exact (erf) GELU throughout: Whisper was trained with nn.GELU, and
+    # the tanh approximation drifts logits enough to flip borderline
+    # tokens in quiet segments
     x = jax.nn.gelu(lax.conv_general_dilated(
         x, p["conv1_w"].astype(cfg.jax_dtype), window_strides=(1,),
-        padding=((1, 1),), dimension_numbers=dn) + p["conv1_b"])
+        padding=((1, 1),), dimension_numbers=dn) + p["conv1_b"],
+        approximate=False)
     x = jax.nn.gelu(lax.conv_general_dilated(
         x, p["conv2_w"].astype(cfg.jax_dtype), window_strides=(2,),
-        padding=((1, 1),), dimension_numbers=dn) + p["conv2_b"])
+        padding=((1, 1),), dimension_numbers=dn) + p["conv2_b"],
+        approximate=False)
     pos = jnp.asarray(_sinusoid_pos(cfg.n_audio_ctx, cfg.hidden_size),
                       cfg.jax_dtype)
     x = x + pos[None]
@@ -238,7 +243,7 @@ def encode(cfg: ModelConfig, params: dict, mel: jnp.ndarray) -> jnp.ndarray:
         h = h + jnp.einsum("bthd,hde->bte", a, lp["wo"]) + lp["bo"]
         n2 = layer_norm(h, lp["mlp_norm_w"], lp["mlp_norm_b"])
         m = jax.nn.gelu(jnp.einsum("bte,ef->btf", n2, lp["fc1"])
-                        + lp["fc1_b"])
+                        + lp["fc1_b"], approximate=False)
         h = h + jnp.einsum("btf,fe->bte", m, lp["fc2"]) + lp["fc2_b"]
         return h, None
 
@@ -328,7 +333,7 @@ def decode_tokens(
         h = h + jnp.einsum("bthd,hde->bte", ca, lp["cwo"]) + lp["cbo"]
         n2 = layer_norm(h, lp["mlp_norm_w"], lp["mlp_norm_b"])
         m = jax.nn.gelu(jnp.einsum("bte,ef->btf", n2, lp["fc1"])
-                        + lp["fc1_b"])
+                        + lp["fc1_b"], approximate=False)
         h = h + jnp.einsum("btf,fe->bte", m, lp["fc2"]) + lp["fc2_b"]
         return (h, li + 1, kv), None
 

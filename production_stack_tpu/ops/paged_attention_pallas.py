@@ -35,6 +35,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams across pallas releases; the
+# old class also lacks has_side_effects (the aliased output keeps the
+# kernel live there, so dropping the knob is safe)
+def _compiler_params(has_side_effects: bool):
+    if hasattr(pltpu, "CompilerParams"):
+        return pltpu.CompilerParams(has_side_effects=has_side_effects)
+    return pltpu.TPUCompilerParams()
+
 NEG_INF = -1e30
 
 
@@ -540,5 +548,5 @@ def kv_cache_write_pallas(
         grid_spec=grid_spec,
         interpret=interpret,
         input_output_aliases={3: 0},  # kv_hbm input → output buffer
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
     )(slot_mapping, layer_arr, newkv, kv_cache)

@@ -21,8 +21,11 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from production_stack_tpu.engine.jax_compat import shard_map
 
 
 def _stage_body(layer_fn: Callable, params_stage, x):
@@ -95,7 +98,7 @@ def pipelined_forward(
         return outputs
 
     stage_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(stage_specs, P()),

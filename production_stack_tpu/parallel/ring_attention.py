@@ -19,8 +19,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from production_stack_tpu.engine.jax_compat import shard_map
 
 NEG_INF = -1e30
 
@@ -95,7 +98,7 @@ def ring_causal_attention(
     the head dim (tensor parallelism composes: heads are independent, so the
     ring only ever talks over ``axis_name``)."""
     spec = P(None, axis_name, head_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           soft_cap=soft_cap),
         mesh=mesh,

@@ -106,6 +106,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-model-label", default="prefill")
     p.add_argument("--decode-model-label", default="decode")
     p.add_argument("--max-instance-failover-reroute-attempts", type=int, default=0)
+    # resilience (router/resilience.py; docs/resilience.md)
+    p.add_argument("--circuit-breaker", dest="circuit_breaker",
+                   action="store_true", default=True,
+                   help="per-backend circuit breaker (default on)")
+    p.add_argument("--no-circuit-breaker", dest="circuit_breaker",
+                   action="store_false")
+    p.add_argument("--cb-error-threshold", type=float, default=0.5,
+                   help="EWMA error rate that opens a backend's circuit")
+    p.add_argument("--cb-min-samples", type=int, default=10,
+                   help="attempts before the breaker may open")
+    p.add_argument("--cb-ewma-alpha", type=float, default=0.2)
+    p.add_argument("--cb-open-cooldown", type=float, default=10.0,
+                   help="seconds an open circuit waits before half-open "
+                        "probes (a backend Retry-After overrides per trip)")
+    p.add_argument("--cb-half-open-probes", type=int, default=3,
+                   help="concurrent live probes while half-open")
+    p.add_argument("--cb-latency-factor", type=float, default=3.0,
+                   help="eject a backend whose TTFB EWMA exceeds the fleet "
+                        "median by this factor (0 disables)")
+    p.add_argument("--retry-budget-ratio", type=float, default=0.2,
+                   help="fraction of recent traffic that may be retries")
+    p.add_argument("--retry-budget-min", type=int, default=3,
+                   help="retries always allowed per window")
+    p.add_argument("--retry-budget-window", type=float, default=60.0)
+    p.add_argument("--enable-hedging", action="store_true",
+                   help="hedge non-streaming requests to a second backend "
+                        "after a p95-based delay")
+    p.add_argument("--hedge-delay-ms", type=float, default=0.0,
+                   help="fixed hedge delay; 0 = derive from observed p95")
+    p.add_argument("--no-deadline-propagation", dest="deadline_propagation",
+                   action="store_false", default=True,
+                   help="do not derive/propagate x-request-deadline")
     # stats
     p.add_argument("--engine-stats-interval", type=float, default=10.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -260,6 +292,31 @@ class RouterApp:
         initialize_engine_stats_scraper(args.engine_stats_interval)
         initialize_request_stats_monitor(args.request_stats_window)
 
+        from production_stack_tpu.router.resilience import (
+            ResilienceConfig,
+            initialize_resilience,
+        )
+
+        resilience = initialize_resilience(
+            ResilienceConfig(
+                breaker_enabled=args.circuit_breaker,
+                error_threshold=args.cb_error_threshold,
+                min_samples=args.cb_min_samples,
+                ewma_alpha=args.cb_ewma_alpha,
+                open_cooldown=args.cb_open_cooldown,
+                half_open_probes=args.cb_half_open_probes,
+                latency_factor=args.cb_latency_factor,
+                retry_budget_ratio=args.retry_budget_ratio,
+                retry_budget_min=args.retry_budget_min,
+                retry_budget_window=args.retry_budget_window,
+                hedge_enabled=args.enable_hedging,
+                hedge_delay_ms=args.hedge_delay_ms,
+                deadline_propagation=args.deadline_propagation,
+            ),
+            breaker_state_hook=lambda url, state:
+                m.circuit_breaker_state.labels(server=url).set(state),
+        )
+
         routing_kwargs = {
             "session_key": args.session_key,
             "prefix_min_match_length": args.prefix_min_match_length,
@@ -295,6 +352,7 @@ class RouterApp:
             rewriter=get_rewriter(),
             callbacks=callbacks,
             external_providers=external,
+            resilience=resilience,
         )
 
         if args.enable_batch_api:

@@ -75,6 +75,18 @@ request_latency_seconds = Histogram(
     ["server", "model", "status"],
     buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
 )
+# resilience layer (router/resilience.py)
+circuit_breaker_state = Gauge(
+    "vllm:circuit_breaker_state",
+    "Per-backend circuit state (0=closed, 1=half-open, 2=open)", ["server"]
+)
+retry_budget_remaining = Gauge(
+    "vllm:retry_budget_remaining",
+    "Retries the sliding-window budget would still allow"
+)
+hedged_requests_total = Counter(
+    "vllm:hedged_requests", "Hedged (speculative second) attempts fired"
+)
 # router self-metrics (reference: routers/metrics_router.py:43-57)
 router_cpu_percent = Gauge("router:cpu_usage_perc", "Router CPU usage percent")
 router_mem_percent = Gauge("router:memory_usage_perc", "Router memory usage percent")

@@ -23,8 +23,14 @@ from aiohttp import web
 from production_stack_tpu.router import metrics as m
 from production_stack_tpu.router.log import init_logger
 from production_stack_tpu.router.protocols import EndpointInfo
+from production_stack_tpu.router.resilience import (
+    Resilience,
+    ResilienceConfig,
+    get_resilience,
+)
 from production_stack_tpu.router.routing import (
     DisaggregatedPrefillOrchestratedRouter,
+    breaker_filter,
     get_routing_logic,
 )
 from production_stack_tpu.router.service_discovery import get_service_discovery
@@ -113,6 +119,7 @@ class RequestService:
         rewriter=None,
         callbacks=None,
         external_providers=None,
+        resilience: Optional[Resilience] = None,
     ):
         self.max_failover_attempts = max_failover_attempts
         self.request_timeout = request_timeout
@@ -122,6 +129,15 @@ class RequestService:
         self.external_providers = external_providers
         self.post_response = None  # optional (body, response_tail) hook
         self._session: Optional[aiohttp.ClientSession] = None
+        self._resilience = resilience
+
+    @property
+    def resilience(self) -> Resilience:
+        if self._resilience is None:
+            # late-bind the app singleton; default-config fallback keeps
+            # directly-constructed services (tests) working
+            self._resilience = get_resilience() or Resilience(ResilienceConfig())
+        return self._resilience
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
@@ -243,25 +259,55 @@ class RequestService:
         engine_stats = get_engine_stats_scraper().get_engine_stats()
         request_stats = get_request_stats_monitor().get_request_stats()
 
+        res = self.resilience
+        deadline = self._request_deadline(request, t_start)
+        res.budget.on_request()
+        m.retry_budget_remaining.set(res.budget.remaining())
+
+        if raw_body is None and not body.get("stream", False) \
+                and len(endpoints) > 1:
+            hedge_delay = res.hedge.delay()
+            if hedge_delay is not None:
+                return await self._hedged_request(
+                    request, endpoint_path, body, endpoints, router,
+                    engine_stats, request_stats, resolved, request_id,
+                    t_start, deadline, hedge_delay,
+                )
+
         attempts = 1 + max(self.max_failover_attempts, 0)
         failed: set[str] = set()
         last_error: Optional[str] = None
         for attempt in range(attempts):
-            candidates = [e for e in endpoints if e.url not in failed] or endpoints
+            if attempt > 0:
+                if deadline is not None and time.time() >= deadline:
+                    return web.json_response(
+                        {"error": {"message": "deadline exceeded during "
+                                   f"failover: {last_error}"}}, status=504)
+                if not res.budget.try_acquire():
+                    logger.warning(
+                        "retry budget exhausted; shedding retry of request "
+                        "%s", request_id)
+                    break
+                m.retry_budget_remaining.set(res.budget.remaining())
+            avail = [e for e in endpoints if e.url not in failed] or endpoints
+            candidates = breaker_filter(avail)
             url = await router.route_request(
                 candidates, engine_stats, request_stats,
                 dict(request.headers), body,
             )
+            res.breaker.on_attempt_start(url)
             logger.info("Routing request %s to %s (attempt %d)", request_id,
                         url, attempt + 1)
             try:
                 return await self._proxy_and_stream(
                     request, endpoint_path, body, url, resolved, request_id,
-                    t_start, raw_body=raw_body,
+                    t_start, raw_body=raw_body, deadline=deadline,
                 )
             except BackendError as e:
                 last_error = str(e)
                 failed.add(url)
+                res.breaker.record_failure(url, e.kind,
+                                           retry_after=e.retry_after)
                 m.request_errors_total.labels(
                     server=url, model=resolved, error_type=e.kind
                 ).inc()
@@ -273,9 +319,115 @@ class RequestService:
             {"error": {"message": f"all backends failed: {last_error}"}}, status=503
         )
 
+    def _request_deadline(self, request: web.Request,
+                          t_start: float) -> Optional[float]:
+        """Absolute epoch deadline propagated to engines: min of a
+        client-supplied ``x-request-deadline`` and the router timeout."""
+        if not self.resilience.config.deadline_propagation:
+            return None
+        deadline = t_start + self.request_timeout
+        hdr = request.headers.get("x-request-deadline")
+        if hdr:
+            try:
+                deadline = min(deadline, float(hdr))
+            except ValueError:
+                logger.warning("ignoring malformed x-request-deadline %r", hdr)
+        return deadline
+
+    # -- hedged requests ------------------------------------------------------
+    async def _hedged_request(
+        self, request, endpoint_path, body, endpoints, router, engine_stats,
+        request_stats, model, request_id, t_start, deadline, hedge_delay,
+    ) -> web.StreamResponse:
+        """Race a primary attempt against a delayed hedge on a different
+        backend; first success wins, the loser is cancelled. Buffered
+        (non-streaming) only — a prepared stream cannot be discarded.
+        Hedges and failover replacements both draw from the retry budget."""
+        res = self.resilience
+        failed: set[str] = set()
+        tasks: dict[asyncio.Task, str] = {}
+        last_error: Optional[str] = None
+        extra_attempts = max(self.max_failover_attempts, 0)
+
+        async def launch(exclude: set[str]) -> None:
+            avail = [e for e in endpoints
+                     if e.url not in failed and e.url not in exclude]
+            avail = avail or [e for e in endpoints if e.url not in failed] \
+                or endpoints
+            candidates = breaker_filter(avail)
+            url = await router.route_request(
+                candidates, engine_stats, request_stats,
+                dict(request.headers), body,
+            )
+            res.breaker.on_attempt_start(url)
+            logger.info("Routing request %s to %s (hedged, %d in flight)",
+                        request_id, url, len(tasks))
+            tasks[asyncio.ensure_future(self._buffered_attempt(
+                request, endpoint_path, body, url, model, request_id,
+                t_start, deadline))] = url
+
+        try:
+            await launch(set())
+            hedged = False
+            while tasks:
+                timeout = None
+                if not hedged:
+                    elapsed = time.time() - t_start
+                    timeout = max(0.0, hedge_delay - elapsed)
+                done, _ = await asyncio.wait(
+                    tasks, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    # hedge timer fired with the primary still in flight
+                    hedged = True
+                    in_flight = set(tasks.values())
+                    others = [e for e in endpoints
+                              if e.url not in in_flight | failed]
+                    if others and res.budget.try_acquire():
+                        m.hedged_requests_total.inc()
+                        m.retry_budget_remaining.set(res.budget.remaining())
+                        await launch(in_flight)
+                    continue
+                for t in done:
+                    url = tasks.pop(t)
+                    try:
+                        return t.result()
+                    except BackendError as e:
+                        last_error = str(e)
+                        failed.add(url)
+                        res.breaker.record_failure(
+                            url, e.kind, retry_after=e.retry_after)
+                        m.request_errors_total.labels(
+                            server=url, model=model, error_type=e.kind
+                        ).inc()
+                        logger.warning(
+                            "backend %s failed for request %s (%s); hedge "
+                            "race continues", url, request_id, e)
+                if not tasks and extra_attempts > 0:
+                    if deadline is not None and time.time() >= deadline:
+                        return web.json_response(
+                            {"error": {"message": "deadline exceeded during "
+                                       f"failover: {last_error}"}}, status=504)
+                    if not res.budget.try_acquire():
+                        logger.warning("retry budget exhausted; shedding "
+                                       "retry of request %s", request_id)
+                        break
+                    m.retry_budget_remaining.set(res.budget.remaining())
+                    extra_attempts -= 1
+                    await launch(set())
+            return web.json_response(
+                {"error": {"message": f"all backends failed: {last_error}"}},
+                status=503)
+        finally:
+            for t in tasks:  # cancel the losing attempt(s)
+                if t.done():
+                    t.exception()  # consume, avoid "never retrieved" noise
+                else:
+                    t.cancel()
+
     async def _proxy_and_stream(
         self, request, endpoint_path, body, url, model, request_id, t_start,
-        raw_body: Optional[bytes] = None,
+        raw_body: Optional[bytes] = None, deadline: Optional[float] = None,
     ) -> web.StreamResponse:
         """One backend attempt. Raises BackendError before any byte has been
         relayed (so failover is safe); after first byte, errors terminate the
@@ -298,6 +450,8 @@ class RequestService:
         monitor.on_new_request(url, request_id, time.time())
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
+        if deadline is not None:
+            headers["x-request-deadline"] = f"{deadline:.3f}"
         # CLIENT span per backend attempt; W3C context continues into the
         # engine so its logs/traces join the request
         span_cm = tracing.request_span(
@@ -345,6 +499,21 @@ class RequestService:
                 monitor.on_request_complete(url, request_id, time.time())
             raise BackendError("http_5xx", f"HTTP {backend.status}: {text[:200]}")
 
+        retry_after = _overload_retry_after(backend)
+        if retry_after is not None:
+            # honest overload signal: fail over elsewhere and let the
+            # breaker throttle this backend for Retry-After seconds
+            try:
+                text = await backend.text()
+            except aiohttp.ClientError:
+                text = "<unreadable body>"
+            finally:
+                backend.release()
+                monitor.on_request_complete(url, request_id, time.time())
+            raise BackendError("overload", f"HTTP 429: {text[:200]}",
+                               retry_after=retry_after)
+
+        self.resilience.breaker.record_success(url, time.time() - t_start)
         resp = web.StreamResponse(
             status=backend.status,
             headers={
@@ -383,6 +552,13 @@ class RequestService:
             if pending:
                 await resp.write(pending)
             await resp.write_eof()
+        except aiohttp.ClientError:
+            # backend died mid-stream (e.g. stream_abort_rate fault); the
+            # client already got bytes so we can't fail over, but the
+            # breaker should know
+            status_label = "stream_abort"
+            self.resilience.breaker.record_failure(url, "stream_abort")
+            raise
         except (ConnectionResetError, asyncio.CancelledError):
             status_label = "client_disconnect"
             raise
@@ -405,6 +581,8 @@ class RequestService:
             if span_cm.span is not None:
                 span_cm.span.set_attribute("http.status_code", backend.status)
             if status_label == "200":
+                if not stream:  # hedge delay tracks full-response p95
+                    self.resilience.hedge.observe(now - t_start)
                 if self.post_response is not None and not stream:
                     try:
                         self.post_response(body, buffer)
@@ -413,6 +591,90 @@ class RequestService:
                 if self.callbacks is not None:
                     self.callbacks.post_request(request, body, buffer)
         return resp
+
+    async def _buffered_attempt(self, request, endpoint_path, body, url,
+                                model, request_id, t_start,
+                                deadline: Optional[float] = None,
+                                ) -> web.Response:
+        """One fully-buffered backend attempt for the hedging path: a
+        buffered response can be discarded when the other attempt wins,
+        a prepared StreamResponse cannot. Raises BackendError on connect
+        failure / 5xx / overload-429, mirroring ``_attempt``'s contract,
+        and keeps the same stats/usage accounting."""
+        monitor = get_request_stats_monitor()
+        res = self.resilience
+        headers = sanitize_headers(request.headers)
+        headers["x-request-id"] = request_id
+        if deadline is not None:
+            headers["x-request-deadline"] = f"{deadline:.3f}"
+        monitor.on_new_request(url, request_id, time.time())
+        try:
+            backend = await self.session.post(
+                f"{url}{endpoint_path}", json=body, headers=headers
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            monitor.on_request_complete(url, request_id, time.time())
+            raise BackendError("connect", f"{type(e).__name__}: {e}") from e
+
+        try:
+            if backend.status >= 500:
+                try:
+                    text = await backend.text()
+                except aiohttp.ClientError:
+                    text = "<unreadable body>"
+                monitor.on_request_complete(url, request_id, time.time())
+                raise BackendError("http_5xx",
+                                   f"HTTP {backend.status}: {text[:200]}")
+            retry_after = _overload_retry_after(backend)
+            if retry_after is not None:
+                try:
+                    text = await backend.text()
+                except aiohttp.ClientError:
+                    text = "<unreadable body>"
+                monitor.on_request_complete(url, request_id, time.time())
+                raise BackendError("overload", f"HTTP 429: {text[:200]}",
+                                   retry_after=retry_after)
+            try:
+                monitor.on_request_response(url, request_id, time.time())
+                payload = await backend.read()
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                monitor.on_request_complete(url, request_id, time.time())
+                raise BackendError("read",
+                                   f"{type(e).__name__}: {e}") from e
+        finally:
+            backend.release()
+
+        now = time.time()
+        res.breaker.record_success(url, now - t_start)
+        res.hedge.observe(now - t_start)
+        n_output_tokens = 0
+        usage = _extract_usage(payload[-65536:], False)
+        if usage:
+            n_output_tokens = usage.get("completion_tokens", 0) or 0
+            m.input_tokens_total.labels(server=url, model=model).inc(
+                usage.get("prompt_tokens", 0) or 0
+            )
+            m.output_tokens_total.labels(server=url, model=model).inc(
+                n_output_tokens
+            )
+        monitor.on_request_complete(url, request_id, now, n_output_tokens)
+        m.request_latency_seconds.labels(
+            server=url, model=model, status=str(backend.status)
+        ).observe(now - t_start)
+        if backend.status == 200:
+            if self.post_response is not None:
+                try:
+                    self.post_response(body, payload[-65536:])
+                except Exception as e:
+                    logger.warning("post_response hook failed: %s", e)
+            if self.callbacks is not None:
+                self.callbacks.post_request(request, body, payload[-65536:])
+        return web.Response(
+            body=payload,
+            status=backend.status,
+            headers={**sanitize_headers(backend.headers),
+                     "x-request-id": request_id},
+        )
 
     # -- orchestrated disaggregated prefill -----------------------------------
     async def _orchestrated_disagg(
@@ -502,9 +764,27 @@ class RequestService:
 
 
 class BackendError(Exception):
-    def __init__(self, kind: str, msg: str):
+    def __init__(self, kind: str, msg: str,
+                 retry_after: Optional[float] = None):
         super().__init__(msg)
         self.kind = kind
+        #: backend-requested back-off (429 Retry-After) in seconds; the
+        #: circuit breaker uses it as the open-state cooldown
+        self.retry_after = retry_after
+
+
+def _overload_retry_after(backend) -> Optional[float]:
+    """Seconds from a 429's Retry-After header, or None when the 429
+    should be relayed to the client verbatim (no/malformed header)."""
+    if backend.status != 429:
+        return None
+    ra = backend.headers.get("Retry-After")
+    if ra is None:
+        return None
+    try:
+        return max(0.0, float(ra))
+    except ValueError:
+        return None
 
 
 def _split_sse_event(buf: bytes):

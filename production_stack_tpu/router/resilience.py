@@ -1,0 +1,354 @@
+"""Router-side resilience layer: circuit breakers, retry budget, hedging.
+
+The failover loop in ``request_service.py`` is stateless per request —
+it retries, but remembers nothing, so a sick-but-alive backend keeps
+absorbing first attempts and taxes every request that lands on it.
+This module adds the passive-health memory the reference stack lacks
+(its failover story is "kill the pod and wait for service discovery"):
+
+* :class:`CircuitBreaker` — per-backend EWMA error rate plus latency
+  outlier ejection, with the classic closed → open → half-open → closed
+  state machine.  Routing consults :meth:`CircuitBreaker.filter` so an
+  ejected backend stops receiving *first* attempts; a limited number of
+  half-open probes discover recovery.
+* :class:`RetryBudget` — a sliding-window budget (≤ ``ratio`` of recent
+  traffic may be retries, with a small floor so low-QPS deployments can
+  still fail over).  Failover and hedging both draw from it, so a fleet
+  brown-out cannot amplify into a retry storm.
+* :class:`HedgePolicy` — optional hedged requests for non-streaming
+  endpoints: after a p95-based delay, fire one extra attempt on a
+  different backend and cancel the loser.
+
+All knobs live on :class:`ResilienceConfig` and are surfaced as router
+CLI flags (``--circuit-breaker`` … ``--hedge-delay-ms``) and Helm values
+(``routerSpec.resilience.*``).  State transitions are exported via the
+``vllm:circuit_breaker_state`` / ``vllm:retry_budget_remaining`` /
+``vllm:hedged_requests_total`` metrics (see ``router/metrics.py``).
+
+Everything here is synchronous and allocation-light: it sits on the
+proxy hot path and must never await.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+# circuit states — numeric values are the gauge encoding
+# (vllm:circuit_breaker_state), chosen so "bigger is sicker"
+CLOSED = 0
+HALF_OPEN = 1
+OPEN = 2
+
+_STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
+
+
+@dataclass
+class ResilienceConfig:
+    """Knobs for the router resilience layer (defaults are production-
+    lean: breaker+budget on, hedging opt-in)."""
+
+    # -- circuit breaker --
+    breaker_enabled: bool = True
+    #: EWMA error rate above which a backend opens (volume-guarded).
+    error_threshold: float = 0.5
+    #: attempts a backend must absorb before the breaker may open —
+    #: stops one unlucky 500 at startup from ejecting a healthy pod.
+    min_samples: int = 10
+    #: EWMA smoothing factor for both error rate and latency.
+    ewma_alpha: float = 0.2
+    #: seconds an open breaker waits before allowing half-open probes
+    #: (overridden per-trip by a backend-supplied ``Retry-After``).
+    open_cooldown: float = 10.0
+    #: concurrent real-traffic probes allowed while half-open.
+    half_open_probes: int = 3
+    #: eject a backend whose TTFB EWMA exceeds the fleet median by this
+    #: factor (0 disables latency ejection).
+    latency_factor: float = 3.0
+    #: latency samples required before outlier ejection can trigger.
+    latency_min_samples: int = 20
+
+    # -- retry budget --
+    #: fraction of recent first-attempt traffic that may be retries.
+    retry_budget_ratio: float = 0.2
+    #: floor of always-allowed retries per window (low-QPS escape hatch).
+    retry_budget_min: int = 3
+    #: sliding-window length in seconds.
+    retry_budget_window: float = 60.0
+
+    # -- hedging --
+    hedge_enabled: bool = False
+    #: fixed hedge delay in ms; 0 = derive from observed p95 latency.
+    hedge_delay_ms: float = 0.0
+
+    # -- deadlines --
+    #: propagate/derive ``x-request-deadline`` toward engines.
+    deadline_propagation: bool = True
+
+
+@dataclass
+class _BackendState:
+    state: int = CLOSED
+    err_ewma: float = 0.0
+    lat_ewma: float | None = None
+    samples: int = 0
+    lat_samples: int = 0
+    #: epoch time before which an OPEN breaker refuses to half-open
+    open_until: float = 0.0
+    probes_in_flight: int = 0
+
+
+class CircuitBreaker:
+    """Per-backend passive health with open/half-open/closed states.
+
+    Thread-compatible but not thread-safe — the router is a single
+    asyncio loop and every method is synchronous, so no locking.
+    """
+
+    def __init__(self, config: ResilienceConfig,
+                 state_hook=None):
+        self.config = config
+        self._backends: dict[str, _BackendState] = {}
+        # called as state_hook(url, state_int) on every transition so
+        # metrics.py can mirror state into the Prometheus gauge without
+        # this module importing prometheus
+        self._state_hook = state_hook
+
+    # -- introspection ------------------------------------------------------
+
+    def state(self, url: str) -> int:
+        return self._backends[url].state if url in self._backends else CLOSED
+
+    def state_name(self, url: str) -> str:
+        return _STATE_NAMES[self.state(url)]
+
+    def states(self) -> dict[str, int]:
+        return {u: b.state for u, b in self._backends.items()}
+
+    # -- routing-side API ---------------------------------------------------
+
+    def filter(self, urls: list[str],
+               now: float | None = None) -> list[str]:
+        """Return the subset of ``urls`` eligible for a first attempt.
+
+        OPEN backends whose cooldown expired flip to HALF_OPEN here (the
+        breaker is passive — traffic is its clock).  HALF_OPEN backends
+        are admitted only while they have probe slots free.  If the
+        policy would eject *everything*, the full list is returned:
+        degraded backends beat no backends.
+        """
+        if not self.config.breaker_enabled or not urls:
+            return urls
+        now = time.time() if now is None else now
+        keep = []
+        for url in urls:
+            b = self._backends.get(url)
+            if b is None:
+                keep.append(url)
+                continue
+            if b.state == OPEN and now >= b.open_until:
+                self._transition(url, b, HALF_OPEN)
+                b.probes_in_flight = 0
+            if b.state == CLOSED:
+                keep.append(url)
+            elif (b.state == HALF_OPEN
+                  and b.probes_in_flight < self.config.half_open_probes):
+                keep.append(url)
+        return keep or urls
+
+    def on_attempt_start(self, url: str, now: float | None = None) -> None:
+        """Reserve a half-open probe slot when the chosen backend is
+        convalescing."""
+        b = self._backends.get(url)
+        if b is not None and b.state == HALF_OPEN:
+            b.probes_in_flight += 1
+
+    # -- outcome recording --------------------------------------------------
+
+    def record_success(self, url: str, ttfb: float | None = None,
+                       now: float | None = None) -> None:
+        cfg = self.config
+        if not cfg.breaker_enabled:
+            return
+        b = self._backends.setdefault(url, _BackendState())
+        b.samples += 1
+        b.err_ewma = (1 - cfg.ewma_alpha) * b.err_ewma
+        if b.state == HALF_OPEN:
+            b.probes_in_flight = max(0, b.probes_in_flight - 1)
+            # one good probe closes the circuit; err_ewma decays from
+            # wherever it tripped, so reset it below threshold to avoid
+            # an immediate re-trip on the next isolated error
+            b.err_ewma = 0.0
+            self._transition(url, b, CLOSED)
+        if ttfb is not None:
+            b.lat_samples += 1
+            b.lat_ewma = (ttfb if b.lat_ewma is None else
+                          (1 - cfg.ewma_alpha) * b.lat_ewma
+                          + cfg.ewma_alpha * ttfb)
+            self._check_latency_outlier(url, b)
+
+    def record_failure(self, url: str, kind: str = "error",
+                       retry_after: float | None = None,
+                       now: float | None = None) -> None:
+        cfg = self.config
+        if not cfg.breaker_enabled:
+            # disabled = fully inert: no state tracking, so the gauge can
+            # never claim a backend is open while routing ignores it
+            return
+        now = time.time() if now is None else now
+        b = self._backends.setdefault(url, _BackendState())
+        b.samples += 1
+        b.err_ewma = (1 - cfg.ewma_alpha) * b.err_ewma + cfg.ewma_alpha
+        if b.state == HALF_OPEN:
+            # a failed probe slams the circuit shut again
+            b.probes_in_flight = max(0, b.probes_in_flight - 1)
+            self._open(url, b, now, retry_after, reason=f"probe {kind}")
+        elif b.state == CLOSED and b.samples >= cfg.min_samples:
+            if b.err_ewma >= cfg.error_threshold:
+                self._open(url, b, now, retry_after,
+                           reason=f"error rate {b.err_ewma:.2f} ({kind})")
+            elif retry_after is not None:
+                # overloaded-but-honest backend: respect its back-off
+                # without waiting for the error EWMA to catch up
+                self._open(url, b, now, retry_after,
+                           reason=f"retry-after {retry_after:.1f}s ({kind})")
+        elif b.state == OPEN and retry_after is not None:
+            b.open_until = max(b.open_until, now + retry_after)
+
+    # -- internals ----------------------------------------------------------
+
+    def _check_latency_outlier(self, url: str, b: _BackendState) -> None:
+        cfg = self.config
+        if (cfg.latency_factor <= 0 or b.state != CLOSED
+                or b.lat_samples < cfg.latency_min_samples):
+            return
+        peers = [o.lat_ewma for u, o in self._backends.items()
+                 if u != url and o.lat_ewma is not None]
+        if not peers:  # single backend: no fleet to compare against
+            return
+        fleet = statistics.median(peers)
+        if fleet > 0 and b.lat_ewma is not None \
+                and b.lat_ewma > cfg.latency_factor * fleet:
+            self._open(url, b, time.time(), None,
+                       reason=(f"latency outlier {b.lat_ewma * 1e3:.0f}ms "
+                               f"vs fleet median {fleet * 1e3:.0f}ms"))
+
+    def _open(self, url: str, b: _BackendState, now: float,
+              retry_after: float | None, reason: str) -> None:
+        b.open_until = now + (retry_after if retry_after is not None
+                              else self.config.open_cooldown)
+        b.probes_in_flight = 0
+        # latency ejection must re-qualify after recovery
+        b.lat_samples = 0
+        self._transition(url, b, OPEN, reason)
+
+    def _transition(self, url: str, b: _BackendState, state: int,
+                    reason: str = "") -> None:
+        if b.state == state:
+            return
+        logger.info("circuit breaker %s: %s -> %s%s", url,
+                    _STATE_NAMES[b.state], _STATE_NAMES[state],
+                    f" ({reason})" if reason else "")
+        b.state = state
+        if self._state_hook is not None:
+            try:
+                self._state_hook(url, state)
+            except Exception:  # metrics must never break routing
+                logger.exception("circuit breaker state hook failed")
+
+
+class RetryBudget:
+    """Sliding-window retry budget: at most ``min + ratio * requests``
+    retries (failover re-attempts and hedges both count) per window."""
+
+    def __init__(self, config: ResilienceConfig):
+        self.config = config
+        self._requests: deque[float] = deque()
+        self._retries: deque[float] = deque()
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.config.retry_budget_window
+        while self._requests and self._requests[0] < cutoff:
+            self._requests.popleft()
+        while self._retries and self._retries[0] < cutoff:
+            self._retries.popleft()
+
+    def on_request(self, now: float | None = None) -> None:
+        """Deposit: one first-attempt request entered the window."""
+        now = time.time() if now is None else now
+        self._trim(now)
+        self._requests.append(now)
+
+    def remaining(self, now: float | None = None) -> int:
+        now = time.time() if now is None else now
+        self._trim(now)
+        cap = (self.config.retry_budget_min
+               + int(self.config.retry_budget_ratio * len(self._requests)))
+        return max(0, cap - len(self._retries))
+
+    def try_acquire(self, now: float | None = None) -> bool:
+        """Withdraw one retry if the budget allows; False = shed it."""
+        now = time.time() if now is None else now
+        if self.remaining(now) <= 0:
+            return False
+        self._retries.append(now)
+        return True
+
+
+class HedgePolicy:
+    """When enabled, answers "how long to wait before hedging?" from a
+    rolling latency sample (p95) or a fixed operator override."""
+
+    _SAMPLE_WINDOW = 300.0  # seconds of latency history for the p95
+
+    def __init__(self, config: ResilienceConfig):
+        from production_stack_tpu.router.stats import MovingAverageMonitor
+
+        self.config = config
+        self._latencies = MovingAverageMonitor(self._SAMPLE_WINDOW)
+
+    def observe(self, latency: float, now: float | None = None) -> None:
+        self._latencies.update(time.time() if now is None else now, latency)
+
+    def delay(self) -> float | None:
+        """Seconds to wait before firing the hedge; None = don't hedge."""
+        if not self.config.hedge_enabled:
+            return None
+        if self.config.hedge_delay_ms > 0:
+            return self.config.hedge_delay_ms / 1000.0
+        self._latencies.trim()
+        if self._latencies.count < 10:
+            return 1.0  # conservative until the sample warms up
+        return max(0.0, self._latencies.percentile(0.95))
+
+
+class Resilience:
+    """Facade bundling the three policies plus deadline config; one
+    instance per router process (see :func:`initialize_resilience`)."""
+
+    def __init__(self, config: ResilienceConfig | None = None,
+                 breaker_state_hook=None):
+        self.config = config or ResilienceConfig()
+        self.breaker = CircuitBreaker(self.config,
+                                      state_hook=breaker_state_hook)
+        self.budget = RetryBudget(self.config)
+        self.hedge = HedgePolicy(self.config)
+
+
+_resilience: Resilience | None = None
+
+
+def initialize_resilience(config: ResilienceConfig | None = None,
+                          breaker_state_hook=None) -> Resilience:
+    global _resilience
+    _resilience = Resilience(config, breaker_state_hook=breaker_state_hook)
+    return _resilience
+
+
+def get_resilience() -> Resilience | None:
+    return _resilience

@@ -276,6 +276,23 @@ class DisaggregatedPrefillOrchestratedRouter(Router):
         return d
 
 
+def breaker_filter(endpoints: list[EndpointInfo]) -> list[EndpointInfo]:
+    """Drop endpoints whose circuit breaker is open before the routing
+    logic sees them, so ejected backends stop receiving first attempts.
+
+    HALF_OPEN backends stay in the pool only while they have probe slots
+    free; if every endpoint is ejected the full list is returned
+    (degraded beats unreachable). No-op when the resilience layer is not
+    initialized (e.g. unit tests driving a Router directly)."""
+    from production_stack_tpu.router.resilience import get_resilience
+
+    res = get_resilience()
+    if res is None or not endpoints:
+        return endpoints
+    keep = set(res.breaker.filter([e.url for e in endpoints]))
+    return [e for e in endpoints if e.url in keep] or endpoints
+
+
 _ROUTERS = {
     "roundrobin": RoundRobinRouter,
     "session": SessionRouter,

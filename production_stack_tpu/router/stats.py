@@ -50,6 +50,16 @@ class MovingAverageMonitor:
     def count(self) -> int:
         return len(self.values)
 
+    def percentile(self, q: float) -> float:
+        """q-quantile (0..1, nearest-rank) of the windowed values; -1.0
+        when the window is empty. Used by the resilience layer's hedging
+        policy (p95 hedge delay) — call ``trim()`` first for a fresh
+        window."""
+        if not self.values:
+            return -1.0
+        data = sorted(self.values)
+        return data[min(len(data) - 1, int(q * len(data)))]
+
 
 class EngineStatsScraper:
     def __init__(self, interval: float = 10.0):

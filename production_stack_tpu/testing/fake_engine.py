@@ -16,15 +16,23 @@ import asyncio
 import json
 import time
 import uuid
+from typing import Optional
 
 from aiohttp import web
+
+from production_stack_tpu.testing.faults import (
+    FaultSpec,
+    FaultState,
+    fault_middleware,
+)
 
 
 class FakeEngine:
     def __init__(self, model: str = "fake-model", tokens_per_second: float = 500.0,
                  ttft: float = 0.02, max_tokens_default: int = 32,
                  kv_hit_tokens: int = 0,
-                 capabilities: "list[str] | None" = None):
+                 capabilities: "list[str] | None" = None,
+                 faults: Optional[FaultSpec] = None):
         self.model = model
         self.tps = tokens_per_second
         self.ttft = ttft
@@ -39,9 +47,15 @@ class FakeEngine:
         self.lora_loaded: list[str] = []
         self.lora_unloaded: list[str] = []
         self.start = time.time()
+        # same fault surface as the real engine server: faults armed at
+        # construction or flipped live via POST /debug/faults, so breaker
+        # drills can sicken one fake backend of a fleet mid-test
+        self.fault_state = FaultState(faults)
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(
+            middlewares=[fault_middleware(self.fault_state)])
+        app.router.add_post("/debug/faults", self.debug_faults)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat)
         app.router.add_get("/v1/models", self.models)
@@ -55,6 +69,32 @@ class FakeEngine:
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         return app
+
+    async def debug_faults(self, request):
+        """Flip fault injection live — same contract as the real engine's
+        POST /debug/faults (?error_rate=0.5&stall_ms=500...; ?off=1
+        clears), so drills drive fake and real backends identically."""
+        q = request.rel_url.query
+        try:
+            off = q.get("off")
+            if off is not None:
+                if off.lower() not in ("1", "true"):
+                    raise ValueError("off must be 1 or true")
+                self.fault_state.set(None)
+            else:
+                spec = ",".join(f"{k}={v}" for k, v in q.items())
+                self.fault_state.set(FaultSpec.parse(spec))
+        except (TypeError, ValueError) as e:
+            return web.json_response({"error": {"message": str(e)}},
+                                     status=400)
+        s = self.fault_state.spec
+        body = {"active": s is not None}
+        if s is not None:
+            body.update(error_rate=s.error_rate, latency_ms=s.latency_ms,
+                        drop_rate=s.drop_rate, stall_ms=s.stall_ms,
+                        stream_abort_rate=s.stream_abort_rate,
+                        stream_abort_after_ms=s.stream_abort_after_ms)
+        return web.json_response(body)
 
     async def load_lora(self, request):
         body = await request.json()
@@ -189,9 +229,20 @@ def main(argv=None):
     p.add_argument("--tokens-per-second", type=float, default=500)
     p.add_argument("--ttft", type=float, default=0.02)
     p.add_argument("--kv-hit-tokens", type=int, default=0)
+    p.add_argument(
+        "--fault-injection", default=None, metavar="SPEC",
+        help="fault spec string, e.g. error_rate=0.5,stall_ms=500 "
+             "(env FAULT_INJECTION honored when the flag is unset; "
+             "also flippable live via POST /debug/faults)")
     args = p.parse_args(argv)
+    spec_str = args.fault_injection
+    if spec_str is None:
+        import os
+
+        spec_str = os.environ.get("FAULT_INJECTION")
+    faults = FaultSpec.parse(spec_str) if spec_str else None
     engine = FakeEngine(args.model, args.tokens_per_second, args.ttft,
-                        kv_hit_tokens=args.kv_hit_tokens)
+                        kv_hit_tokens=args.kv_hit_tokens, faults=faults)
     web.run_app(engine.build_app(), host=args.host, port=args.port,
                 access_log=None)
 

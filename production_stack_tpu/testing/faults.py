@@ -9,16 +9,29 @@ deterministically — in tests, in CI, or on a canary pod.
 Spec string (flag ``--fault-injection`` or env ``FAULT_INJECTION``;
 the flag wins when both are set):
 
-    error_rate=0.3,latency_ms=250,drop_rate=0.05,seed=7
+    error_rate=0.3,latency_ms=250,drop_rate=0.05,stall_ms=500,seed=7
 
   error_rate   probability a request returns 500 before reaching the engine
   latency_ms   added latency per request (before any error/drop decision)
   drop_rate    probability the connection is closed before any response
                byte (a connect-class failure: abrupt reset instead of a
                clean 500 — exercises the client-error failover branch)
+  stall_ms     first-byte stall: requests that SURVIVE the error/drop roll
+               sleep this long before reaching the handler, modelling a
+               sick-but-responding backend (drives the router's latency
+               outlier ejection; distinct from latency_ms, which applies
+               before the roll and so also delays the injected errors)
+  stream_abort_rate      probability the connection is torn down
+               ``stream_abort_after_ms`` after the handler starts — the
+               client sees valid response bytes, then a mid-stream
+               truncation (second independent roll; exercises both the
+               router's stream-abort accounting and the engine's
+               disconnect-abort KV cleanup)
+  stream_abort_after_ms  delay before the mid-stream teardown (default 50)
   seed         deterministic PRNG seed (omit for nondeterministic)
 
-error_rate + drop_rate must not exceed 1 (they partition one roll).
+error_rate + drop_rate must not exceed 1 (they partition one roll);
+stream_abort_rate rolls independently.
 
 Faults apply to POST /v1/* only: health, metrics, and discovery endpoints
 stay truthful, mirroring a sick-but-alive backend — the hardest failure
@@ -40,6 +53,9 @@ class FaultSpec:
     error_rate: float = 0.0
     latency_ms: float = 0.0
     drop_rate: float = 0.0
+    stall_ms: float = 0.0
+    stream_abort_rate: float = 0.0
+    stream_abort_after_ms: float = 50.0
     seed: Optional[int] = None
 
     @classmethod
@@ -51,22 +67,29 @@ class FaultSpec:
                 continue
             key, _, value = item.partition("=")
             key = key.strip()
-            if key not in ("error_rate", "latency_ms", "drop_rate", "seed"):
+            if key not in ("error_rate", "latency_ms", "drop_rate",
+                           "stall_ms", "stream_abort_rate",
+                           "stream_abort_after_ms", "seed"):
                 raise ValueError(f"unknown fault key {key!r}")
             kwargs[key] = int(value) if key == "seed" else float(value)
         spec_obj = cls(**kwargs)
         if not 0 <= spec_obj.error_rate <= 1 or not 0 <= spec_obj.drop_rate <= 1:
             raise ValueError("rates must be in [0, 1]")
+        if not 0 <= spec_obj.stream_abort_rate <= 1:
+            raise ValueError("rates must be in [0, 1]")
         if spec_obj.error_rate + spec_obj.drop_rate > 1:
             raise ValueError("error_rate + drop_rate must not exceed 1 "
                              "(they partition one roll)")
-        if spec_obj.latency_ms < 0:
-            raise ValueError("latency_ms must be >= 0")
+        if spec_obj.latency_ms < 0 or spec_obj.stall_ms < 0 \
+                or spec_obj.stream_abort_after_ms < 0:
+            raise ValueError("latency_ms/stall_ms/stream_abort_after_ms "
+                             "must be >= 0")
         return spec_obj
 
     @property
     def active(self) -> bool:
-        return bool(self.error_rate or self.latency_ms or self.drop_rate)
+        return bool(self.error_rate or self.latency_ms or self.drop_rate
+                    or self.stall_ms or self.stream_abort_rate)
 
 
 class FaultState:
@@ -91,9 +114,9 @@ def fault_middleware(state: FaultState):
         if (spec is None or request.method != "POST"
                 or not request.path.startswith("/v1/")):
             return await handler(request)
-        if spec.latency_ms:
-            import asyncio
+        import asyncio
 
+        if spec.latency_ms:
             await asyncio.sleep(spec.latency_ms / 1000.0)
         roll = rng.random()
         if roll < spec.error_rate:
@@ -109,6 +132,26 @@ def fault_middleware(state: FaultState):
             if request.transport is not None:
                 request.transport.close()
             raise web.HTTPInternalServerError(text="injected drop")
+        if spec.stall_ms:
+            # first-byte stall AFTER the roll: only surviving requests
+            # pay it, so the backend looks slow-but-correct (latency
+            # outlier, not error source)
+            await asyncio.sleep(spec.stall_ms / 1000.0)
+        if spec.stream_abort_rate and rng.random() < spec.stream_abort_rate:
+            # mid-stream truncation: let the handler start responding,
+            # then kill the transport under it — the peer sees a
+            # ClientPayloadError/ConnectionResetError after real bytes
+            async def _abort_later(transport):
+                await asyncio.sleep(spec.stream_abort_after_ms / 1000.0)
+                if transport is not None:
+                    transport.close()
+
+            killer = asyncio.ensure_future(_abort_later(request.transport))
+            try:
+                return await handler(request)
+            finally:
+                # handler beat the timer: the response completed intact
+                killer.cancel()
         return await handler(request)
 
     return middleware

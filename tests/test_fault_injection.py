@@ -251,3 +251,121 @@ def test_spec_range_validation():
         FaultSpec.parse("error_rate=1.5")
     with pytest.raises(ValueError):
         FaultSpec.parse("latency_ms=-5")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("stream_abort_rate=1.5")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("stall_ms=-1")
+
+
+def test_stall_and_stream_abort_spec_parsing():
+    s = FaultSpec.parse("stall_ms=500,stream_abort_rate=0.3,"
+                        "stream_abort_after_ms=80")
+    assert s.stall_ms == 500 and s.stream_abort_rate == 0.3
+    assert s.stream_abort_after_ms == 80
+    assert s.active
+    assert FaultSpec.parse("stall_ms=10").active
+    assert FaultSpec.parse("stream_abort_rate=0.1").active
+
+
+def test_stall_delays_survivors_only():
+    """stall_ms applies AFTER the error roll: a stalled backend looks
+    slow-but-correct (the latency-outlier shape), and injected errors
+    return without paying the stall."""
+    import time
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def main():
+        fe = FakeEngine(model="fake-model", tokens_per_second=2000,
+                        ttft=0.001, faults=FaultSpec.parse("stall_ms=300"))
+        async with TestClient(TestServer(fe.build_app())) as c:
+            t0 = time.perf_counter()
+            r = await c.post("/v1/completions",
+                             json={"model": "fake-model", "prompt": "x",
+                                   "max_tokens": 2})
+            assert r.status == 200
+            assert time.perf_counter() - t0 >= 0.3
+
+        fe = FakeEngine(model="fake-model", tokens_per_second=2000,
+                        ttft=0.001,
+                        faults=FaultSpec.parse("error_rate=1.0,stall_ms=300"))
+        async with TestClient(TestServer(fe.build_app())) as c:
+            t0 = time.perf_counter()
+            r = await c.post("/v1/completions",
+                             json={"model": "fake-model", "prompt": "x",
+                                   "max_tokens": 2})
+            assert r.status == 500
+            assert time.perf_counter() - t0 < 0.3  # errors skip the stall
+
+    asyncio.run(main())
+
+
+def test_stream_abort_truncates_mid_stream():
+    """stream_abort_rate kills the transport after real response bytes:
+    the client sees a mid-stream truncation, not a clean error."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def main():
+        fe = FakeEngine(
+            model="fake-model", tokens_per_second=20, ttft=0.001,
+            faults=FaultSpec.parse(
+                "stream_abort_rate=1.0,stream_abort_after_ms=120"))
+        ts = TestServer(fe.build_app())
+        await ts.start_server()
+        try:
+            got = b""
+            async with aiohttp.ClientSession() as s:
+                with pytest.raises((aiohttp.ClientError, ConnectionError,
+                                    asyncio.IncompleteReadError)):
+                    async with s.post(
+                        f"http://127.0.0.1:{ts.port}/v1/completions",
+                        json={"model": "fake-model", "prompt": "x",
+                              "max_tokens": 32, "stream": True},
+                    ) as r:
+                        assert r.status == 200
+                        async for chunk in r.content.iter_any():
+                            got += chunk
+            assert b"data: " in got  # real bytes arrived before the cut
+            assert b"[DONE]" not in got  # ...but the stream never finished
+        finally:
+            await ts.close()
+
+    asyncio.run(main())
+
+
+def test_fake_engine_live_fault_toggle():
+    """FakeEngine exposes the same POST /debug/faults live-flip contract
+    as the real engine server, so drills drive both identically."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def main():
+        fe = FakeEngine(model="fake-model", tokens_per_second=2000,
+                        ttft=0.001)
+        async with TestClient(TestServer(fe.build_app())) as c:
+            r = await c.post("/v1/completions",
+                             json={"model": "fake-model", "prompt": "x",
+                                   "max_tokens": 2})
+            assert r.status == 200  # starts clean
+            r = await c.post("/debug/faults?error_rate=1.0")
+            assert (await r.json())["active"]
+            r = await c.post("/v1/completions",
+                             json={"model": "fake-model", "prompt": "x",
+                                   "max_tokens": 2})
+            assert r.status == 500
+            r = await c.post("/debug/faults?off=1")
+            assert not (await r.json())["active"]
+            r = await c.post("/v1/completions",
+                             json={"model": "fake-model", "prompt": "x",
+                                   "max_tokens": 2})
+            assert r.status == 200
+            r = await c.post("/debug/faults?stream_abort_rate=2.0")
+            assert r.status == 400
+
+    asyncio.run(main())

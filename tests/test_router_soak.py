@@ -114,3 +114,129 @@ def test_router_soak_zero_errors_flat_memory():
               f"->{end_rss:.1f} MB over {DURATION:.0f}s")
 
     asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_router_soak_flapping_backend():
+    """Long breaker drill (docs/resilience.md): one of three backends
+    flaps — sick (error_rate=0.8 + first-byte stall) for a window, then
+    healthy again, repeatedly, flipped live via POST /debug/faults. The
+    breaker must eject it while sick (open at least once per sick phase)
+    and re-admit it after recovery (close again), with ≥99% of client
+    requests succeeding across the whole run."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import RouterApp, build_parser
+    from production_stack_tpu.router.resilience import (
+        CLOSED, OPEN, get_resilience,
+    )
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    flap_period = float(os.environ.get("PSTPU_SOAK_FLAP_PERIOD", "20"))
+    duration = float(os.environ.get("PSTPU_SOAK_DURATION", "300"))
+
+    async def main():
+        engines, servers, urls = [], [], []
+        for _ in range(3):
+            fe = FakeEngine(model="fake-model", tokens_per_second=500,
+                            ttft=0.002)
+            ts = TestServer(fe.build_app())
+            await ts.start_server()
+            engines.append(fe)
+            servers.append(ts)
+            urls.append(f"http://127.0.0.1:{ts.port}")
+        flappy_url = urls[0]
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake-model"] * 3),
+            "--routing-logic", "roundrobin",
+            "--max-instance-failover-reroute-attempts", "3",
+            "--cb-min-samples", "5",
+            "--cb-ewma-alpha", "0.4",
+            "--cb-open-cooldown", str(flap_period / 4),
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        res = get_resilience()
+        assert res is not None
+
+        stats = {"ok": 0, "total": 0, "opens": 0, "closes": 0}
+        stop = asyncio.Event()
+
+        async def flapper():
+            sick = False
+            while not stop.is_set():
+                sick = not sick
+                query = ("error_rate=0.8&stall_ms=500&seed=11" if sick
+                         else "off=1")
+                r = await client.session.post(
+                    f"{flappy_url}/debug/faults?{query}")
+                assert r.status == 200
+                await r.release()
+                try:
+                    await asyncio.wait_for(stop.wait(), flap_period)
+                except asyncio.TimeoutError:
+                    pass
+
+        async def watcher():
+            """Count breaker open/close transitions on the flappy pod."""
+            last = res.breaker.state(flappy_url)
+            while not stop.is_set():
+                cur = res.breaker.state(flappy_url)
+                if cur != last:
+                    if cur == OPEN:
+                        stats["opens"] += 1
+                    elif cur == CLOSED:
+                        stats["closes"] += 1
+                    last = cur
+                await asyncio.sleep(0.1)
+
+        async def one(i):
+            stats["total"] += 1
+            try:
+                r = await client.post(
+                    "/v1/completions",
+                    json={"model": "fake-model", "prompt": f"flap {i}",
+                          "max_tokens": 8})
+                ok = r.status == 200
+                await r.release()
+                stats["ok"] += ok
+            except Exception:
+                pass
+
+        bg = [asyncio.create_task(flapper()), asyncio.create_task(watcher())]
+        inflight: set = set()
+        t0 = time.monotonic()
+        i = 0
+        try:
+            while time.monotonic() - t0 < duration:
+                task = asyncio.create_task(one(i))
+                inflight.add(task)
+                task.add_done_callback(inflight.discard)
+                i += 1
+                await asyncio.sleep(1.0 / QPS)
+            if inflight:
+                await asyncio.wait(inflight, timeout=60)
+        finally:
+            stop.set()
+            for t in bg:
+                await t
+            await client.close()
+            for ts in servers:
+                await ts.close()
+
+        assert stats["total"] > 0
+        success = stats["ok"] / stats["total"]
+        assert success >= 0.99, (
+            f"only {success:.1%} of {stats['total']} requests succeeded "
+            f"under the flapping backend")
+        assert stats["opens"] >= 1, "breaker never ejected the flappy pod"
+        assert stats["closes"] >= 1, (
+            "breaker never re-admitted the recovered pod")
+        print(f"flap soak: {stats['ok']}/{stats['total']} ok, "
+              f"{stats['opens']} opens / {stats['closes']} closes "
+              f"over {duration:.0f}s")
+
+    asyncio.run(main())
