@@ -40,6 +40,10 @@ class AsyncEngine:
         self.running = False
         self.paused = False  # sleep mode
         self.step_count = 0
+        # called with each step's wall duration (seconds) from the engine
+        # thread; the server points this at its scheduler-step histogram.
+        # Only real steps are timed — the worker blocks on intake when idle.
+        self.step_observer = None
         self.thread: Optional[threading.Thread] = None
 
     async def start(self) -> None:
@@ -62,6 +66,7 @@ class AsyncEngine:
             self._drain_intake(block=not self.engine.has_unfinished())
             if self.paused or not self.engine.has_unfinished():
                 continue
+            t_step = time.monotonic()
             try:
                 outputs = self.engine.step()
             except Exception as e:
@@ -84,6 +89,11 @@ class AsyncEngine:
                     self.engine.abort_request(rid)
                 continue
             self.step_count += 1
+            if self.step_observer is not None:
+                try:
+                    self.step_observer(time.monotonic() - t_step)
+                except Exception:
+                    pass
             if outputs and self.loop is not None:
                 self.loop.call_soon_threadsafe(self._deliver, outputs)
 

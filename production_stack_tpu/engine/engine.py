@@ -890,6 +890,14 @@ class LLMEngine:
                     num_cached_tokens=seq.num_cached_tokens,
                     block_ids=(seq.released_block_ids if status is not None
                                else None),
+                    arrival_time=(seq.arrival_time if status is not None
+                                  else None),
+                    admit_time=(seq.admit_time if status is not None
+                                else None),
+                    first_token_time=(seq.first_token_time
+                                      if status is not None else None),
+                    finish_time=(seq.finish_time if status is not None
+                                 else None),
                     new_logprobs=(lp_lists[j] if lp_lists is not None
                                   else None),
                 )
@@ -974,6 +982,11 @@ class LLMEngine:
             "spec_decode_num_draft_tokens_total": self.spec_drafted,
             "spec_decode_num_accepted_tokens_total": self.spec_accepted,
             "aborted_seqs_total": self.aborted_seqs,
+            # per-step occupancy / KV-pool utilization (observability layer)
+            "batch_occupancy": (self.scheduler.num_running
+                                / max(1, self.config.scheduler.max_num_seqs)),
+            "kv_blocks_total": self.runner.num_blocks,
+            "kv_blocks_free": self.scheduler.num_free_blocks,
         }
         if self.host_kv is not None:
             out["cpu_cache_usage_perc"] = self.host_kv.usage

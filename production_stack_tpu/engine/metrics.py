@@ -108,6 +108,22 @@ class EngineStatsCollector:
             "Cumulative generated tokens",
             s["generation_tokens_total"],
         )
+        # request-lifecycle observability: per-step batch/KV-pool utilization
+        yield gauge(
+            "vllm:batch_occupancy",
+            "Running sequences / max_num_seqs (decode-slot utilization)",
+            s.get("batch_occupancy", 0.0),
+        )
+        yield gauge(
+            "vllm:kv_blocks_total",
+            "KV block pool capacity (HBM)",
+            s.get("kv_blocks_total", 0),
+        )
+        yield gauge(
+            "vllm:kv_blocks_free",
+            "Free KV blocks (allocatable right now)",
+            s.get("kv_blocks_free", 0),
+        )
 
 
 _BUCKETS_TTFT = (
@@ -146,6 +162,35 @@ class ServerMetrics:
             "End-to-end request latency",
             _BUCKETS_E2E,
         )
+        # per-stage decomposition (queue → prefill → decode), observed from
+        # the sequence lifecycle stamps carried on finished RequestOutputs
+        self.queue_time = hist(
+            "vllm:request_queue_time_seconds",
+            "Time from arrival to scheduler admission (queue wait)",
+            _BUCKETS_TTFT,
+        )
+        self.prefill_time = hist(
+            "vllm:request_prefill_time_seconds",
+            "Time from admission to first token (prefill incl. chunking)",
+            _BUCKETS_TTFT,
+        )
+        self.decode_time = hist(
+            "vllm:request_decode_time_seconds",
+            "Time from first token to finish (decode)",
+            _BUCKETS_E2E,
+        )
+        self.itl = hist(
+            "vllm:inter_token_latency_seconds",
+            "Mean inter-token latency per finished request",
+            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+             0.2, 0.3, 0.4, 0.5, 0.75, 1.0, 2.5),
+        )
+        self.step_duration = hist(
+            "vllm:scheduler_step_duration_seconds",
+            "Engine step wall time (schedule + dispatch + postprocess)",
+            (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0),
+        )
 
     def generate(self) -> bytes:
         from prometheus_client import generate_latest
@@ -167,3 +212,25 @@ class ServerMetrics:
                     (end - first_token) / (n_output - 1)
                 )
         self.e2e.labels(self.model_name).observe(end - start)
+
+    def observe_stages(self, out) -> None:
+        """Per-stage decomposition from a FINISHED RequestOutput's lifecycle
+        stamps (all monotonic, stamped scheduler/engine-side). Partial
+        stamps — e.g. an abort before first token — observe only the stages
+        that completed."""
+        lv = self.model_name
+        if out.arrival_time is not None and out.admit_time is not None:
+            self.queue_time.labels(lv).observe(
+                max(0.0, out.admit_time - out.arrival_time))
+        if out.admit_time is not None and out.first_token_time is not None:
+            self.prefill_time.labels(lv).observe(
+                max(0.0, out.first_token_time - out.admit_time))
+        if out.first_token_time is not None and out.finish_time is not None:
+            decode = max(0.0, out.finish_time - out.first_token_time)
+            self.decode_time.labels(lv).observe(decode)
+            if out.num_output_tokens > 1:
+                self.itl.labels(lv).observe(decode /
+                                            (out.num_output_tokens - 1))
+
+    def observe_step(self, duration: float) -> None:
+        self.step_duration.labels(self.model_name).observe(duration)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Optional
 
 from production_stack_tpu.engine.config import CacheConfig, SchedulerConfig
@@ -177,6 +178,10 @@ class Scheduler:
             seq.num_computed_tokens = cached
             seq.slot = self.free_slots.pop()
             seq.status = SequenceStatus.PREFILLING
+            # queue-exit stamp; kept across preemption-readmits so
+            # queue_time measures the FIRST wait (the user-visible one)
+            if seq.admit_time is None:
+                seq.admit_time = time.monotonic()
             self.seqs[seq.request_id] = seq
             if self.admission_hook is not None:
                 self.admission_hook(seq)

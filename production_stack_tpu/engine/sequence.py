@@ -52,6 +52,7 @@ class Sequence:
     num_computed_tokens: int = 0  # tokens whose KV sits in the cache
     num_cached_tokens: int = 0  # prefix-cache hits at admission (for metrics)
     slot: int = -1  # decode slot index, -1 = none
+    admit_time: Optional[float] = None  # waiting → scheduled (queue exit)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     # block ids held at release time (they stay content-addressed in the
@@ -103,6 +104,12 @@ class RequestOutput:
     num_output_tokens: int
     num_cached_tokens: int = 0
     block_ids: Optional[list[int]] = None  # set on finish (KV export handle)
+    # lifecycle stamps (monotonic clock), set on finish like block_ids —
+    # the server derives queue/prefill/decode stage histograms from them
+    arrival_time: Optional[float] = None
+    admit_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
     # aligned with new_token_ids when the request asked for logprobs: each
     # entry is (token_logprob, [(token_id, logprob), ...] top-N) — the
     # server slices top-N down to the request's asked-for count

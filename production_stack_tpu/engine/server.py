@@ -30,6 +30,12 @@ from production_stack_tpu.engine.async_engine import AsyncEngine
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.metrics import ServerMetrics
+from production_stack_tpu.engine import tracing as etracing
+from production_stack_tpu.flight_recorder import FlightRecorder
+
+import logging
+
+_log = logging.getLogger("engine.server")
 from production_stack_tpu.engine.sampling import (
     SamplingParams,
     make_token_controls,
@@ -196,13 +202,22 @@ ENGINE_CAPABILITIES = (
 class EngineServer:
     def __init__(self, config: EngineConfig, engine: Optional[LLMEngine] = None,
                  warmup_on_start: bool = False,
-                 overload_retry_after: float = 1.0):
+                 overload_retry_after: float = 1.0,
+                 otel_endpoint: Optional[str] = None,
+                 otel_service_name: str = "tpu-engine",
+                 otel_secure: bool = False,
+                 flight_recorder_size: int = 256):
         self.config = config
         self.warmup_on_start = warmup_on_start
         self.model_name = config.model.name
         self.engine = engine or LLMEngine(config)
         self.async_engine = AsyncEngine(self.engine)
         self.metrics = ServerMetrics(self.engine, self.model_name)
+        self.async_engine.step_observer = self.metrics.observe_step
+        etracing.initialize_tracing(otel_endpoint, otel_service_name,
+                                    otel_secure)
+        self.flight_recorder = FlightRecorder(flight_recorder_size)
+        self._inflight: dict = {}  # root rid → open flight record
         # Retry-After seconds advertised on overload 429s; the router's
         # circuit breaker uses it as the ejection cooldown
         self.overload_retry_after = overload_retry_after
@@ -260,6 +275,7 @@ class EngineServer:
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/debug/profile", self.profile)
         app.router.add_get("/debug/memory", self.memory_profile)
+        app.router.add_get("/debug/requests", self.debug_requests)
         if self._faults_armed:
             app.router.add_post("/debug/faults", self.debug_faults)
         app.router.add_post("/sleep", self.sleep)
@@ -876,6 +892,20 @@ class EngineServer:
             content_type=CONTENT_TYPE_LATEST.split(";")[0],
         )
 
+    async def debug_requests(self, request: web.Request) -> web.Response:
+        """Flight recorder: recent per-request timelines (newest first) so
+        a slow request can be dissected after the fact without a tracing
+        backend. ?limit=N bounds the response."""
+        try:
+            limit = int(request.query["limit"]) if "limit" in request.query \
+                else None
+        except ValueError:
+            limit = None
+        return web.json_response({
+            "recorder": self.flight_recorder.stats(),
+            "requests": self.flight_recorder.snapshot(limit),
+        })
+
     async def tokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
         text = body.get("prompt") or body.get("text") or ""
@@ -1224,6 +1254,112 @@ class EngineServer:
 
     async def _run(self, request: web.Request, body: dict, prompts: list,
                    chat: bool) -> web.StreamResponse:
+        """Observability shell around the request lifecycle: joins the
+        router's trace via the propagated W3C traceparent (child SERVER
+        span carrying queue/prefill/decode stage timing), opens a flight-
+        recorder record keyed by the propagated x-request-id, and logs a
+        completion line per request."""
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex}"
+        client_rid = request.headers.get("x-request-id") or rid
+        model = str(body.get("model", self.model_name))
+        inbound_ctx = etracing.extract_context(request.headers)
+        span_cm = etracing.request_span(
+            f"engine {request.path}",
+            context=inbound_ctx,
+            kind="server",
+            attributes={"request.id": rid, "client.request.id": client_rid,
+                        "http.target": request.path, "model": model},
+        )
+        rec = self.flight_recorder.begin(
+            request_id=rid, client_request_id=client_rid,
+            endpoint=request.path, model=model,
+            streaming=bool(body.get("stream", False)),
+            trace_id=None, outcome=None, status=None,
+            num_prompt_tokens=0, num_output_tokens=0,
+        )
+        self._inflight[rid] = rec
+        status = 500
+        try:
+            with span_cm as span:
+                # current-span id when the SDK records spans; the router's
+                # propagated id in API-only (propagation-only) mode
+                rec["trace_id"] = (etracing.trace_id_hex()
+                                   or etracing.trace_id_hex(inbound_ctx))
+                try:
+                    resp = await self._run_inner(
+                        request, body, prompts, chat, rid)
+                    status = resp.status
+                    # streamed responses set this at prepare time; echo on
+                    # buffered/error responses too so direct clients can
+                    # correlate with logs and /debug/requests
+                    if not resp.prepared and \
+                            "x-request-id" not in resp.headers:
+                        resp.headers["x-request-id"] = client_rid
+                finally:
+                    self._finalize_span(span, rec, status)
+                return resp
+        except asyncio.CancelledError:
+            if rec.get("outcome") is None:
+                rec["outcome"] = "client_disconnect"
+            raise
+        finally:
+            self._inflight.pop(rid, None)
+            if rec.get("outcome") is None:
+                rec["outcome"] = ("completed" if status < 400
+                                  else "deadline_exceeded" if status == 504
+                                  else "rejected")
+            rec["status"] = status
+            self.flight_recorder.finish(rec)
+            tl = rec["timeline"]
+            _log.info(
+                "request %s x-request-id=%s status=%s outcome=%s "
+                "prompt_tokens=%d output_tokens=%d e2e=%.3fs",
+                rid, client_rid, status, rec["outcome"],
+                rec["num_prompt_tokens"], rec["num_output_tokens"],
+                tl["finished"] - tl["received"],
+            )
+
+    def _finalize_span(self, span, rec: dict, status: int) -> None:
+        """Stamp per-stage durations (from the sequence lifecycle stamps
+        merged into the flight record) onto the engine SERVER span."""
+        if span is None:
+            return
+        tl = rec["timeline"]
+        span.set_attribute("http.status_code", status)
+        if "admitted" in tl:
+            span.set_attribute("stage.queue_s", tl["admitted"] - tl["received"])
+            span.add_event("admitted")
+        if "first_token" in tl and "admitted" in tl:
+            span.set_attribute("stage.prefill_s",
+                               tl["first_token"] - tl["admitted"])
+            span.add_event("first_token")
+        if "last_token" in tl and "first_token" in tl:
+            span.set_attribute("stage.decode_s",
+                               tl["last_token"] - tl["first_token"])
+        span.set_attribute("tokens.prompt", rec["num_prompt_tokens"])
+        span.set_attribute("tokens.output", rec["num_output_tokens"])
+
+    def _observe_finished(self, root_rid: str, out) -> None:
+        """Per-choice finished output: feed the per-stage histograms and
+        merge the sequence's lifecycle stamps into the request's flight
+        record (min across choices for admission/first-token, max for
+        finish)."""
+        self.metrics.observe_stages(out)
+        rec = self._inflight.get(root_rid)
+        if rec is None:
+            return
+        tl = rec["timeline"]
+        for key, val, pick in (("admitted", out.admit_time, min),
+                               ("first_token", out.first_token_time, min),
+                               ("last_token", out.finish_time, max)):
+            if val is not None:
+                tl[key] = val if key not in tl else pick(tl[key], val)
+        rec["num_prompt_tokens"] += out.num_prompt_tokens
+        rec["num_output_tokens"] += out.num_output_tokens
+
+    async def _run_inner(self, request: web.Request, body: dict,
+                         prompts: list, chat: bool,
+                         rid: str) -> web.StreamResponse:
         try:
             sampling = _sampling_from_body(body)
             lp_n = _parse_logprobs(body, chat)
@@ -1296,7 +1432,6 @@ class EngineServer:
         prompt_ids_list = [
             tk.encode(p) if isinstance(p, str) else list(p) for p in prompts
         ]
-        rid = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex}"
         created = int(time.time())
         model = body.get("model", self.model_name)
         stream = bool(body.get("stream", False))
@@ -1477,6 +1612,8 @@ class EngineServer:
             async for out in gen:
                 if first_token_t is None:
                     first_token_t = time.monotonic()
+                if out.finished:
+                    self._observe_finished(rid, out)
                 token_ids.extend(out.new_token_ids)
                 if out.new_logprobs:
                     lps.extend(out.new_logprobs)
@@ -1751,7 +1888,9 @@ class EngineServer:
             headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
-                "X-Request-Id": rid,
+                # echo the propagated id so direct clients (no router in
+                # front) can join logs/flight records too
+                "X-Request-Id": request.headers.get("x-request-id") or rid,
             },
         )
         await resp.prepare(request)
@@ -1794,6 +1933,8 @@ class EngineServer:
             async for out in gen:
                 if shared["first_token_t"] is None:
                     shared["first_token_t"] = time.monotonic()
+                if out.finished:
+                    self._observe_finished(rid, out)
                 token_ids.extend(out.new_token_ids)
                 if out.new_logprobs:
                     all_lps.extend(out.new_logprobs)
@@ -1870,6 +2011,9 @@ class EngineServer:
             # client in-band before [DONE] — the stream already committed 200
             reaped = await self._abort_all(tasks, rids)
             n_out = sum(r for r in reaped if isinstance(r, int))
+            inflight = self._inflight.get(rid)
+            if inflight is not None:  # a 200 stream that timed out in-band
+                inflight["outcome"] = "deadline_exceeded"
             await send({"error": {"message": "request deadline exceeded",
                                   "type": "timeout_error"}})
         except ValueError as e:
@@ -1959,6 +2103,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "unbounded)")
     p.add_argument("--overload-retry-after", type=float, default=1.0,
                    help="Retry-After seconds advertised on overload 429s")
+    p.add_argument("--otel-endpoint", default=None,
+                   help="OTLP gRPC endpoint; engine spans JOIN the "
+                        "router's trace via the propagated traceparent "
+                        "(requires opentelemetry-sdk in the image; "
+                        "degrades to propagation-only without it)")
+    p.add_argument("--otel-service-name", default="tpu-engine")
+    p.add_argument("--otel-secure", action="store_true",
+                   help="use TLS for the OTLP exporter connection")
+    p.add_argument("--flight-recorder-size", type=int, default=256,
+                   help="per-request timelines kept in the /debug/requests "
+                        "ring buffer")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
     p.add_argument("--platform", default=None,
@@ -2119,6 +2274,17 @@ def main(argv=None) -> None:
     from production_stack_tpu.yaml_args import parse_with_yaml_config
 
     args = parse_with_yaml_config(build_parser(), argv)
+    # per-request completion lines (x-request-id correlation,
+    # docs/observability.md) are INFO on "engine.server"; give that logger
+    # a handler when the embedding process hasn't configured logging
+    import logging  # the multihost branch below has a local import too
+
+    if not logging.getLogger().handlers and not _log.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s] %(levelname)s %(name)s: %(message)s"))
+        _log.addHandler(handler)
+        _log.setLevel(logging.INFO)
     platform = args.platform or os.environ.get("PSTPU_PLATFORM")
     if platform:
         import jax
@@ -2218,7 +2384,11 @@ def main(argv=None) -> None:
         atexit.register(broadcaster.close)
     server = EngineServer(config, engine=engine,
                           warmup_on_start=not args.skip_warmup,
-                          overload_retry_after=args.overload_retry_after)
+                          overload_retry_after=args.overload_retry_after,
+                          otel_endpoint=args.otel_endpoint,
+                          otel_service_name=args.otel_service_name,
+                          otel_secure=args.otel_secure,
+                          flight_recorder_size=args.flight_recorder_size)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
     if broadcaster is not None:

@@ -1,29 +1,29 @@
-"""Distributed tracing (reference: src/vllm_router/experimental/otel/
-tracing.py — OTLP gRPC exporter + BatchSpanProcessor, W3C context extract
-from inbound headers and inject into backend requests, SERVER span per
-router request and CLIENT span per backend attempt).
+"""Engine-side distributed tracing: the same graceful-degradation layering
+as router/experimental/tracing.py, so engine spans JOIN the router's trace
+instead of dying at the proxy boundary. The router injects W3C
+``traceparent`` into the backend request (request_service._proxy_and_stream);
+here we extract it and open a child SERVER span around the engine's
+admission → queue → prefill → decode lifecycle.
 
-This image ships only the OpenTelemetry *API*: W3C traceparent propagation
-works unconditionally (so engines and downstream services join the trace);
-spans become recording + exported when opentelemetry-sdk and the OTLP
-exporter are installed in the deployment image (the Dockerfiles can add
-them; init degrades gracefully otherwise).
+This image ships only the OpenTelemetry *API*: trace-context propagation
+works unconditionally; spans become recording + exported when
+opentelemetry-sdk and the OTLP exporter are installed in the deployment
+image (init degrades gracefully otherwise).
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
-from production_stack_tpu.router.log import init_logger
-
-logger = init_logger(__name__)
+logger = logging.getLogger("engine.tracing")
 
 _tracer = None
 _propagator = None
 _enabled = False
 
 
-def initialize_tracing(endpoint: Optional[str], service_name: str = "tpu-router",
+def initialize_tracing(endpoint: Optional[str], service_name: str = "tpu-engine",
                        secure: bool = False) -> bool:
     """Returns True when spans will actually be recorded+exported."""
     global _tracer, _propagator, _enabled
@@ -34,7 +34,7 @@ def initialize_tracing(endpoint: Optional[str], service_name: str = "tpu-router"
         )
     except ImportError:
         # opentelemetry-api not in this image: tracing is a no-op (the
-        # router must boot fine without it)
+        # engine must boot fine without it)
         if endpoint:
             logger.warning(
                 "--otel-endpoint set but opentelemetry-api is not installed; "
@@ -70,7 +70,7 @@ def initialize_tracing(endpoint: Optional[str], service_name: str = "tpu-router"
                 "--otel-endpoint set but opentelemetry-sdk/exporter not "
                 "installed; running with W3C propagation only"
             )
-    _tracer = trace.get_tracer("production_stack_tpu.router")
+    _tracer = trace.get_tracer("production_stack_tpu.engine")
     _enabled = True
     return exporting
 

@@ -1,0 +1,75 @@
+"""Flight recorder: a bounded in-memory ring of per-request timelines so a
+slow or failed request can be reconstructed after the fact WITHOUT a
+tracing backend (the observability tentpole's "black box"). Both the router
+and the engine keep one; records are joined across tiers by the propagated
+x-request-id.
+
+A record is a plain dict. The producer calls begin() when the request
+arrives, mutates the dict as stages complete (timeline stamps, attempts,
+token counts), and finish() freezes it into the ring. Only finished
+records are served from GET /debug/requests — in-flight dicts stay
+private to their request handler, so there is no partially-written state
+to race on (aiohttp handlers run on one event loop; the engine's server
+mutates records only from coroutines).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DEFAULT_SIZE = 256
+
+
+class FlightRecorder:
+    def __init__(self, size: int = DEFAULT_SIZE):
+        self.size = max(1, int(size))
+        self._ring: deque = deque(maxlen=self.size)
+        # begin()/finish() may be reached from the engine worker thread via
+        # callbacks as well as the event loop; a lock keeps append/snapshot
+        # consistent either way.
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self._total = 0
+
+    def begin(self, **fields: Any) -> Dict[str, Any]:
+        """Open a record. Not yet visible in snapshot()."""
+        rec: Dict[str, Any] = {
+            "received_unix": time.time(),
+            "timeline": {"received": time.monotonic()},
+            "attempts": [],
+        }
+        rec.update(fields)
+        return rec
+
+    def stamp(self, rec: Dict[str, Any], stage: str,
+              at: Optional[float] = None) -> None:
+        rec["timeline"][stage] = time.monotonic() if at is None else at
+
+    def finish(self, rec: Dict[str, Any], **fields: Any) -> Dict[str, Any]:
+        """Freeze the record into the ring (idempotent per dict identity is
+        NOT guaranteed — call once per record)."""
+        rec.update(fields)
+        rec["timeline"].setdefault("finished", time.monotonic())
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(rec)
+            self._total += 1
+        return rec
+
+    def snapshot(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Finished records, newest first."""
+        with self._lock:
+            records = list(self._ring)
+        records.reverse()
+        if limit is not None:
+            records = records[: max(0, int(limit))]
+        return records
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": self.size, "recorded": len(self._ring),
+                    "total": self._total, "dropped": self._dropped}

@@ -13,6 +13,7 @@ import asyncio
 import json
 import os
 import time
+import uuid
 from typing import Optional
 
 from aiohttp import web
@@ -179,6 +180,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="OTLP gRPC endpoint; W3C propagation is always on")
     p.add_argument("--otel-service-name", default="tpu-router")
     p.add_argument("--otel-secure", action="store_true")
+    p.add_argument("--flight-recorder-size", type=int, default=256,
+                   help="per-request timelines kept in the router's "
+                        "/debug/requests ring buffer")
     p.add_argument("--external-providers-config", default=None,
                    help="YAML file mapping model ids to external providers")
     p.add_argument("--api-key-file", default=None)
@@ -345,6 +349,10 @@ class RouterApp:
             )
         from production_stack_tpu.router.services.rewriter import get_rewriter
 
+        from production_stack_tpu.flight_recorder import FlightRecorder
+
+        self.flight_recorder = FlightRecorder(
+            getattr(args, "flight_recorder_size", 256))
         self.request_service = RequestService(
             max_failover_attempts=args.max_instance_failover_reroute_attempts,
             request_timeout=args.request_timeout,
@@ -353,6 +361,7 @@ class RouterApp:
             callbacks=callbacks,
             external_providers=external,
             resilience=resilience,
+            flight_recorder=self.flight_recorder,
         )
 
         if args.enable_batch_api:
@@ -415,9 +424,24 @@ class RouterApp:
                 return denied
         return await handler(request)
 
+    @web.middleware
+    async def _request_id_middleware(self, request: web.Request, handler):
+        """x-request-id end to end: accept the client's id (or mint one),
+        stash it for the proxy path, and echo it on EVERY response —
+        including error JSON paths that never reach a backend. Streamed
+        responses are already prepared with the header set by the proxy."""
+        rid = request.headers.get("x-request-id") or str(uuid.uuid4())
+        request["request_id"] = rid
+        resp = await handler(request)
+        if not resp.prepared and "x-request-id" not in resp.headers:
+            resp.headers["x-request-id"] = rid
+        return resp
+
     def build_app(self) -> web.Application:
         self.initialize()
-        middlewares = [self._auth_middleware] if self._api_keys else []
+        middlewares = [self._request_id_middleware]
+        if self._api_keys:
+            middlewares.append(self._auth_middleware)
         app = web.Application(client_max_size=256 * 1024 * 1024,
                               middlewares=middlewares)
         for path in PROXY_POST_PATHS:
@@ -430,6 +454,7 @@ class RouterApp:
         app.router.add_get("/version", self.version)
         app.router.add_get("/engines", self.engines)
         app.router.add_get("/metrics", self.prometheus)
+        app.router.add_get("/debug/requests", self.debug_requests)
         async def _sleep(r):
             return await self.request_service.sleep_wake(r, "sleep")
 
@@ -585,6 +610,40 @@ class RouterApp:
                 }
             )
         return web.json_response({"engines": out})
+
+    async def debug_requests(self, request: web.Request) -> web.Response:
+        """Aggregated flight-recorder view: the router's own per-request
+        timelines (backend attempts included) plus each engine's
+        /debug/requests ring, joined offline by x-request-id. ?limit=N
+        bounds every ring; ?local=1 skips the engine fan-out."""
+        limit = None
+        try:
+            if "limit" in request.query:
+                limit = int(request.query["limit"])
+        except ValueError:
+            limit = None
+        out = {
+            "router": {
+                "recorder": self.flight_recorder.stats(),
+                "requests": self.flight_recorder.snapshot(limit),
+            },
+            "engines": {},
+        }
+        if request.query.get("local") not in ("1", "true"):
+            session = self.request_service.session
+            for ep in get_service_discovery().get_endpoint_info():
+                url = f"{ep.url}/debug/requests"
+                if limit is not None:
+                    url += f"?limit={limit}"
+                try:
+                    async with session.get(url) as r:
+                        if r.status == 200:
+                            out["engines"][ep.url] = await r.json()
+                        else:
+                            out["engines"][ep.url] = {"error": r.status}
+                except Exception as e:
+                    out["engines"][ep.url] = {"error": str(e)}
+        return web.json_response(out)
 
     # -- files / batches -------------------------------------------------------
     async def upload_file(self, request: web.Request) -> web.Response:

@@ -20,7 +20,9 @@ from typing import AsyncIterator, Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.flight_recorder import FlightRecorder
 from production_stack_tpu.router import metrics as m
+from production_stack_tpu.router.experimental import tracing
 from production_stack_tpu.router.log import init_logger
 from production_stack_tpu.router.protocols import EndpointInfo
 from production_stack_tpu.router.resilience import (
@@ -49,6 +51,28 @@ HOP_BY_HOP = {
 
 def sanitize_headers(headers) -> dict[str, str]:
     return {k: v for k, v in headers.items() if k.lower() not in HOP_BY_HOP}
+
+
+def _record_attempt(rec: Optional[dict], url: str,
+                    t_start: float) -> Optional[dict]:
+    """Append a backend-attempt entry to a flight record (None-safe)."""
+    if rec is None:
+        return None
+    info = {"backend": url, "offset_s": round(time.time() - t_start, 6)}
+    rec.setdefault("attempts", []).append(info)
+    return info
+
+
+def _mark_attempt(rec: Optional[dict], url: str, **fields) -> None:
+    """Annotate the newest still-unresolved attempt entry for ``url``
+    (hedged attempts resolve out of launch order)."""
+    if rec is None:
+        return
+    for info in reversed(rec.get("attempts", [])):
+        if info.get("backend") == url and "status" not in info \
+                and "error" not in info:
+            info.update(fields)
+            return
 
 
 def multipart_fields(raw: bytes, content_type: str,
@@ -120,6 +144,7 @@ class RequestService:
         callbacks=None,
         external_providers=None,
         resilience: Optional[Resilience] = None,
+        flight_recorder: Optional[FlightRecorder] = None,
     ):
         self.max_failover_attempts = max_failover_attempts
         self.request_timeout = request_timeout
@@ -130,6 +155,8 @@ class RequestService:
         self.post_response = None  # optional (body, response_tail) hook
         self._session: Optional[aiohttp.ClientSession] = None
         self._resilience = resilience
+        # default keeps directly-constructed services (tests) working
+        self.flight_recorder = flight_recorder or FlightRecorder()
 
     @property
     def resilience(self) -> Resilience:
@@ -165,8 +192,64 @@ class RequestService:
     async def route_general_request(
         self, request: web.Request, endpoint_path: str
     ) -> web.StreamResponse:
+        """Observability wrapper around the proxy hot path: opens the
+        router SERVER span (joining any client trace), starts a flight
+        record, and classifies the outcome — then delegates to
+        :meth:`_route_general_request`, which does the actual routing."""
         t_start = time.time()
-        request_id = request.headers.get("x-request-id") or str(uuid.uuid4())
+        request_id = (request.get("request_id")
+                      if hasattr(request, "get") else None) \
+            or request.headers.get("x-request-id") or str(uuid.uuid4())
+        rec = self.flight_recorder.begin(
+            request_id=request_id, endpoint=endpoint_path, model=None,
+            trace_id=None, outcome=None, status=None,
+        )
+        try:
+            request["flight_record"] = rec
+        except TypeError:
+            pass  # non-aiohttp mocks in unit tests
+        inbound_ctx = tracing.extract_context(request.headers)
+        span_cm = tracing.request_span(
+            f"router {endpoint_path}",
+            context=inbound_ctx,
+            kind="server",
+            attributes={"http.target": endpoint_path,
+                        "request.id": request_id},
+        )
+        status: Optional[int] = None
+        try:
+            with span_cm as span:
+                # current-span id when the SDK records spans; the inbound
+                # context's id in API-only (propagation-only) mode
+                rec["trace_id"] = (tracing.trace_id_hex()
+                                   or tracing.trace_id_hex(inbound_ctx))
+                resp = await self._route_general_request(
+                    request, endpoint_path, request_id, t_start, rec
+                )
+                status = resp.status
+                if span is not None:
+                    span.set_attribute("http.status_code", status)
+                return resp
+        except asyncio.CancelledError:
+            rec["outcome"] = "client_disconnect"
+            raise
+        finally:
+            rec["status"] = status
+            if rec.get("outcome") is None:
+                if status is None:
+                    rec["outcome"] = "error"
+                elif status == 504:
+                    rec["outcome"] = "deadline_exceeded"
+                elif status < 400:
+                    rec["outcome"] = "completed"
+                else:
+                    rec["outcome"] = "error"
+            self.flight_recorder.finish(rec)
+
+    async def _route_general_request(
+        self, request: web.Request, endpoint_path: str, request_id: str,
+        t_start: float, rec: dict,
+    ) -> web.StreamResponse:
         raw_body: Optional[bytes] = None
         if request.content_type.startswith("multipart/"):
             # audio uploads: relay the original bytes; pull only the
@@ -197,6 +280,7 @@ class RequestService:
         model = body.get("model", "")
         resolved = self.resolve_model(model)
         body["model"] = resolved
+        rec["model"] = resolved
         m.num_incoming_requests_total.labels(model=resolved or "unknown").inc()
 
         if self.external_providers is not None and self.external_providers.handles(
@@ -348,6 +432,7 @@ class RequestService:
         tasks: dict[asyncio.Task, str] = {}
         last_error: Optional[str] = None
         extra_attempts = max(self.max_failover_attempts, 0)
+        rec = request.get("flight_record") if hasattr(request, "get") else None
 
         async def launch(exclude: set[str]) -> None:
             avail = [e for e in endpoints
@@ -362,6 +447,7 @@ class RequestService:
             res.breaker.on_attempt_start(url)
             logger.info("Routing request %s to %s (hedged, %d in flight)",
                         request_id, url, len(tasks))
+            _record_attempt(rec, url, t_start)
             tasks[asyncio.ensure_future(self._buffered_attempt(
                 request, endpoint_path, body, url, model, request_id,
                 t_start, deadline))] = url
@@ -391,8 +477,11 @@ class RequestService:
                 for t in done:
                     url = tasks.pop(t)
                     try:
-                        return t.result()
+                        resp = t.result()
+                        _mark_attempt(rec, url, status=resp.status)
+                        return resp
                     except BackendError as e:
+                        _mark_attempt(rec, url, error=e.kind)
                         last_error = str(e)
                         failed.add(url)
                         res.breaker.record_failure(
@@ -433,8 +522,6 @@ class RequestService:
         relayed (so failover is safe); after first byte, errors terminate the
         stream. ``raw_body`` (multipart audio) is relayed byte-identical
         instead of re-serialising ``body``."""
-        from production_stack_tpu.router.experimental import tracing
-
         monitor = get_request_stats_monitor()
         stream = bool(body.get("stream", False))
         strip_usage = False
@@ -452,23 +539,33 @@ class RequestService:
         headers["x-request-id"] = request_id
         if deadline is not None:
             headers["x-request-deadline"] = f"{deadline:.3f}"
-        # CLIENT span per backend attempt; W3C context continues into the
-        # engine so its logs/traces join the request
+        # CLIENT span per backend attempt, child of the router SERVER span
+        # opened in route_general_request (which already joined any client
+        # traceparent); the W3C context continues into the engine so its
+        # spans/logs join the same trace
         span_cm = tracing.request_span(
             f"backend {endpoint_path}",
-            context=tracing.extract_context(request.headers),
             kind="client",
             attributes={"backend.url": url, "model": model,
                         "request.id": request_id, "streaming": stream},
         )
         span_cm.__enter__()
         tracing.inject_headers(headers)
+        rec = request.get("flight_record") if hasattr(request, "get") else None
+        attempt_info = _record_attempt(rec, url, t_start)
         try:
-            return await self._attempt(
+            resp = await self._attempt(
                 request, endpoint_path, body, url, model, request_id, t_start,
                 monitor, stream, headers, span_cm, strip_usage=strip_usage,
                 raw_body=raw_body,
             )
+            if attempt_info is not None:
+                attempt_info["status"] = resp.status
+            return resp
+        except BackendError as e:
+            if attempt_info is not None:
+                attempt_info["error"] = e.kind
+            raise
         finally:
             span_cm.__exit__(None, None, None)
 

@@ -649,3 +649,73 @@ def test_scenario_11_whisper_renders():
     # TPU resources, zero CUDA — same contract as every scenario
     c = eng[0]["spec"]["template"]["spec"]["containers"][0]
     assert c["resources"]["requests"]["google.com/tpu"]
+
+
+def test_observability_values_render_flags():
+    """routerSpec.observability.* and engineConfig otel/flight-recorder
+    keys map onto the corresponding CLI flags on each tier."""
+    objs = render_objects(HELM, {
+        "routerSpec": {"observability": {
+            "otelEndpoint": "otel-collector:4317",
+            "otelServiceName": "my-router",
+            "otelSecure": True,
+            "flightRecorderSize": 64,
+        }},
+    })
+    args = router_args(objs)
+    for flag, value in (("--otel-endpoint", "otel-collector:4317"),
+                        ("--otel-service-name", "my-router"),
+                        ("--flight-recorder-size", "64")):
+        assert flag in args, f"router missing {flag}"
+        assert args[args.index(flag) + 1] == value
+    assert "--otel-secure" in args
+
+    # defaults: empty endpoint renders NO --otel-endpoint (pass-through
+    # mode), but service name and recorder size still render
+    args = router_args(render_objects(HELM))
+    assert "--otel-endpoint" not in args
+    assert "--otel-secure" not in args
+    assert "--otel-service-name" in args
+    assert "--flight-recorder-size" in args
+
+    # engine side (per-model engineConfig); defaults ship an empty
+    # endpoint too, so no --otel-endpoint by default either
+    engines = engine_deployments(render_objects(HELM))
+    eargs = container_args(engines[0])
+    assert "--otel-endpoint" not in eargs
+    assert "--flight-recorder-size" in eargs
+    assert eargs[eargs.index("--otel-service-name") + 1] == "tpu-engine"
+
+
+def test_request_lifecycle_dashboard():
+    """The request-lifecycle dashboard covers both tiers' stage metrics
+    with a distinct uid and non-empty panel targets."""
+    with open(os.path.join(HELM, "dashboards",
+                           "request-lifecycle-dashboard.json")) as f:
+        dash = json.load(f)
+    text = json.dumps(dash)
+    for metric in (
+        # router row
+        "vllm:num_incoming_requests_total",
+        "vllm:request_latency_seconds_bucket",
+        "vllm:circuit_breaker_state",
+        "vllm:retry_budget_remaining",
+        "vllm:hedged_requests_total",
+        # engine stage row
+        "vllm:request_queue_time_seconds_bucket",
+        "vllm:request_prefill_time_seconds_bucket",
+        "vllm:request_decode_time_seconds_bucket",
+        "vllm:inter_token_latency_seconds_bucket",
+        "vllm:scheduler_step_duration_seconds_bucket",
+        "vllm:batch_occupancy",
+        "vllm:kv_blocks_total",
+        "vllm:gpu_prefix_cache_hit_rate",
+    ):
+        assert metric in text, f"request-lifecycle dashboard missing {metric}"
+    assert dash["uid"] == "tpu-request-lifecycle"
+    assert all(p["targets"] for p in dash["panels"])
+    # the observability/ copy stays in sync with the chart's
+    repo_root = os.path.dirname(HELM)
+    with open(os.path.join(repo_root, "observability",
+                           "request-lifecycle-dashboard.json")) as f:
+        assert json.load(f) == dash

@@ -1,0 +1,47 @@
+"""Tier-1 guard: dashboards, docs and code agree on metric names
+(tools/metrics_lint.py)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import metrics_lint  # noqa: E402
+
+
+def test_normalize_strips_exposition_suffixes():
+    assert metrics_lint.normalize("vllm:foo_total") == "vllm:foo"
+    assert metrics_lint.normalize("vllm:foo_seconds_bucket") == \
+        "vllm:foo_seconds"
+    assert metrics_lint.normalize("vllm:foo_seconds_sum") == "vllm:foo_seconds"
+    assert metrics_lint.normalize("vllm:foo_seconds_count") == \
+        "vllm:foo_seconds"
+    assert metrics_lint.normalize("vllm:bar") == "vllm:bar"
+
+
+def test_name_pattern_skips_lookalikes():
+    hits = metrics_lint._NAME.findall(
+        'image: ghcr.io/x/tpu-serving-router:0.1.0\n'
+        '``vllm:gpu_prefix_cache_{hits,queries}_total``\n'
+        'vllm:num_requests_waiting{pod!=""} and vllm:request_errors_total'
+    )
+    assert hits == ["vllm:num_requests_waiting", "vllm:request_errors_total"]
+
+
+def test_repo_metrics_are_consistent():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "metrics_lint.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dashboards_reference_only_defined_metrics():
+    code = metrics_lint.code_metrics()
+    refs = metrics_lint.dashboard_refs()
+    assert refs, "no dashboards found"
+    for source, names in refs.items():
+        assert names, f"{source} references no stack metrics"
+        assert names <= code, f"{source}: unknown {sorted(names - code)}"

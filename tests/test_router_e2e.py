@@ -407,3 +407,180 @@ def test_static_model_types_length_mismatch_fails_at_startup():
         StaticServiceDiscovery(["http://a", "http://b", "http://c"],
                                ["m"] * 3,
                                model_types=["chat", "transcription"])
+
+
+# -- request-lifecycle observability (docs/observability.md) -----------------
+
+def test_x_request_id_echoed_on_every_router_response():
+    async def main():
+        servers, urls = await spawn_engines(1)
+        router, client = await router_client(urls)
+        try:
+            # success path, client-supplied id echoed
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny-llama", "prompt": "hi", "max_tokens": 2,
+                      "temperature": 0, "ignore_eos": True},
+                headers={"x-request-id": "my-id-1"},
+            )
+            assert r.status == 200
+            assert r.headers["x-request-id"] == "my-id-1"
+
+            # error paths carry one too (generated when absent)
+            r = await client.post("/v1/completions", data=b"{not json",
+                                  headers={"Content-Type": "application/json"})
+            assert r.status == 400
+            assert r.headers["x-request-id"]
+
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "no-such-model", "prompt": "x"},
+                headers={"x-request-id": "my-id-2"},
+            )
+            assert r.status == 404
+            assert r.headers["x-request-id"] == "my-id-2"
+
+            # non-proxy surfaces are covered by the middleware as well
+            r = await client.get("/health")
+            assert r.headers["x-request-id"]
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())
+
+
+def test_request_lifecycle_observability_acceptance(monkeypatch):
+    """ISSUE acceptance: one trace across router and engine with per-stage
+    timing, non-empty per-stage histograms, and a /debug/requests timeline
+    carrying the propagated x-request-id.
+
+    The image ships only the opentelemetry API (NoOp tracer), so span
+    recording is faked: one shared RecordingTracer is patched into BOTH
+    tracing modules; parenting is tracked with a contextvar and trace ids
+    come from the explicitly extracted W3C context (raw traceparent
+    forwarding is what carries the id between tiers, as in production
+    API-only mode)."""
+    import contextlib
+    import contextvars
+
+    from opentelemetry import trace as ot
+
+    from production_stack_tpu.engine import tracing as etracing
+    from production_stack_tpu.router.experimental import tracing as rtracing
+
+    recorded = []
+    current = contextvars.ContextVar("fake_span", default=None)
+
+    class FakeSpan:
+        def __init__(self, name, kind, attributes, trace_id, parent):
+            self.name = name
+            # request_span passes an otel SpanKind enum
+            self.kind = getattr(kind, "name", str(kind)).lower()
+            self.attributes = dict(attributes or {})
+            self.events = []
+            self.trace_id = trace_id
+            self.parent = parent
+
+        def set_attribute(self, key, value):
+            self.attributes[key] = value
+
+        def add_event(self, name, attributes=None):
+            self.events.append(name)
+
+    class RecordingTracer:
+        @contextlib.contextmanager
+        def start_as_current_span(self, name, context=None, kind=None,
+                                  attributes=None, **kw):
+            parent = current.get()
+            if context is not None:
+                ctx = ot.get_current_span(context).get_span_context()
+                trace_id = (format(ctx.trace_id, "032x")
+                            if ctx.trace_id else None)
+            else:
+                trace_id = parent.trace_id if parent else None
+            span = FakeSpan(name, kind, attributes, trace_id,
+                            parent.name if parent else None)
+            recorded.append(span)
+            token = current.set(span)
+            try:
+                yield span
+            finally:
+                current.reset(token)
+
+    shared = RecordingTracer()
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+
+    async def main():
+        import aiohttp
+
+        servers, urls = await spawn_engines(1)
+        router, client = await router_client(urls)
+        # patch AFTER both tiers booted: initialize_tracing (called at
+        # startup on each tier) rebuilds the module-global _tracer
+        monkeypatch.setattr(rtracing, "_tracer", shared)
+        monkeypatch.setattr(etracing, "_tracer", shared)
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny-llama", "prompt": "hello world",
+                      "max_tokens": 6, "temperature": 0, "ignore_eos": True},
+                headers={"traceparent":
+                         f"00-{trace_id}-b7ad6b7169203331-01",
+                         "x-request-id": "acc-1"},
+            )
+            assert r.status == 200
+            assert r.headers["x-request-id"] == "acc-1"
+
+            # (a) one trace: router SERVER span with engine child spans
+            # carrying queue/prefill/decode stage timing
+            by_name = {s.name: s for s in recorded}
+            rs = by_name["router /v1/completions"]
+            cs = by_name["backend /v1/completions"]
+            es = by_name["engine /v1/completions"]
+            assert rs.kind == "server" and rs.trace_id == trace_id
+            assert cs.kind == "client" and cs.trace_id == trace_id
+            assert cs.parent == rs.name  # child via current-context nesting
+            assert es.kind == "server" and es.trace_id == trace_id
+            assert rs.attributes["http.status_code"] == 200
+            assert rs.attributes["request.id"] == "acc-1"
+            assert es.attributes["client.request.id"] == "acc-1"
+            for key in ("stage.queue_s", "stage.prefill_s", "stage.decode_s"):
+                assert es.attributes[key] >= 0.0, es.attributes
+            assert "admitted" in es.events and "first_token" in es.events
+
+            # (b) new per-stage histograms exported and non-empty
+            async with aiohttp.ClientSession() as s:
+                async with s.get(urls[0] + "/metrics") as mr:
+                    text = await mr.text()
+            for name in ("vllm:request_queue_time_seconds_count",
+                         "vllm:request_prefill_time_seconds_count",
+                         "vllm:request_decode_time_seconds_count",
+                         "vllm:inter_token_latency_seconds_count",
+                         "vllm:scheduler_step_duration_seconds_count"):
+                count = sum(
+                    float(line.rsplit(" ", 1)[1])
+                    for line in text.splitlines() if line.startswith(name))
+                assert count > 0, f"{name} empty"
+
+            # (c) /debug/requests: ordered timeline + propagated id,
+            # aggregated across both tiers by the router
+            r = await client.get("/debug/requests")
+            data = await r.json()
+            rrec = next(x for x in data["router"]["requests"]
+                        if x["request_id"] == "acc-1")
+            assert rrec["trace_id"] == trace_id
+            assert rrec["outcome"] == "completed" and rrec["status"] == 200
+            assert rrec["attempts"][0]["status"] == 200
+            assert rrec["attempts"][0]["backend"] == urls[0]
+            (engine_view,) = data["engines"].values()
+            erec = next(x for x in engine_view["requests"]
+                        if x["client_request_id"] == "acc-1")
+            assert erec["trace_id"] == trace_id
+            tl = erec["timeline"]
+            stamps = [tl[k] for k in ("received", "admitted", "first_token",
+                                      "last_token", "finished")]
+            assert stamps == sorted(stamps), f"out of order: {tl}"
+        finally:
+            await teardown(servers, client)
+
+    asyncio.run(main())

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Metrics drift lint: every metric name referenced by a Grafana dashboard
+or the observability docs must exist in code, and every engine/router
+``vllm:*`` metric defined in code must be documented in
+docs/observability.md. Run from the repo root:
+
+    python tools/metrics_lint.py
+
+Exit status is non-zero on any drift; tests/test_metrics_lint.py runs this
+in tier-1 so a renamed metric fails CI instead of silently flat-lining a
+dashboard panel.
+
+Name normalization: prometheus_client appends ``_total`` to counters at
+exposition time, and histograms export ``_bucket``/``_sum``/``_count``
+series — a dashboard legitimately references those derived names, so
+suffixes are stripped back to the base name before comparison (and
+``_total`` may be part of the declared name itself, so both spellings of a
+counter collapse to one key).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# vllm:foo / router:foo / kvserver:foo — the stack's metric namespaces.
+# Guards against non-metric lookalikes: a leading [\w-] lookbehind skips
+# image tags ("tpu-serving-router:0.1.0"), the first-char [a-z] skips
+# ":0.1.0"-style versions, and requiring the name to end on [a-z0-9] with
+# no word char following rejects brace templates in docstrings
+# ("vllm:gpu_prefix_cache_{hits,queries}" ends on "_{") while still
+# matching PromQL selectors ("vllm:num_requests_waiting{pod=...}").
+_NAME = re.compile(
+    r"(?<![\w-])(?:vllm|router|kvserver):[a-z][a-z0-9_]*[a-z0-9](?!\w)"
+)
+_SUFFIXES = ("_bucket", "_sum", "_count", "_created", "_total")
+
+
+def normalize(name: str) -> str:
+    for suffix in _SUFFIXES:
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def code_metrics() -> set[str]:
+    """Metric names declared anywhere under production_stack_tpu/.
+
+    Declaration sites are plain string literals (prometheus_client
+    constructors and MetricFamily yields), so a namespace-pattern scan of
+    the source is the inventory — no import side effects needed."""
+    found: set[str] = set()
+    for path in (REPO / "production_stack_tpu").rglob("*.py"):
+        found |= {normalize(m) for m in _NAME.findall(path.read_text())}
+    return found
+
+
+def dashboard_refs() -> dict[str, set[str]]:
+    refs: dict[str, set[str]] = {}
+    for pattern in ("helm/dashboards/*.json", "observability/*.json"):
+        for path in sorted(REPO.glob(pattern)):
+            names = {normalize(m) for m in _NAME.findall(path.read_text())}
+            refs[str(path.relative_to(REPO))] = names
+    return refs
+
+
+def doc_refs(doc: Path) -> set[str]:
+    if not doc.exists():
+        return set()
+    return {normalize(m) for m in _NAME.findall(doc.read_text())}
+
+
+def run() -> int:
+    code = code_metrics()
+    failures: list[str] = []
+
+    for source, names in dashboard_refs().items():
+        for name in sorted(names - code):
+            failures.append(
+                f"{source}: references {name!r}, not defined in code"
+            )
+
+    doc = REPO / "docs" / "observability.md"
+    documented = doc_refs(doc)
+    for name in sorted(documented - code):
+        failures.append(
+            f"docs/observability.md: documents {name!r}, not defined in code"
+        )
+    # the docs are the metrics reference: every vllm:* metric the stack
+    # exports must appear there (router:* host gauges are internal)
+    undocumented = {n for n in code - documented if n.startswith("vllm:")}
+    for name in sorted(undocumented):
+        failures.append(
+            f"docs/observability.md: missing {name!r} (defined in code)"
+        )
+
+    if failures:
+        print(f"metrics lint: {len(failures)} problem(s)")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print(f"metrics lint: OK ({len(code)} metrics in code, "
+          f"{len(documented)} documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())
