@@ -91,13 +91,22 @@ class HostKVStore:
 
 class RemoteKVClient:
     """Engine-side client for the shared remote tier
-    (production_stack_tpu/kv_server). Puts are fire-and-forget on a daemon
-    thread (the serving loop never blocks on the network); gets run at
-    admission with a short timeout — a miss just means recompute."""
+    (production_stack_tpu/kv_server).
+
+    All network IO runs on a dedicated thread pool — the engine's serving
+    thread (and the event loop above it) never blocks on a socket. Puts
+    are fire-and-forget with a bounded pending count (past it, drop: the
+    warm tier is best-effort). Gets run at admission: the whole candidate
+    chain is fetched CONCURRENTLY and consumed in order under one batch
+    deadline, so a cold remote tier costs at most ``get_timeout`` per
+    admission instead of ``get_timeout`` per block (the old serial loop
+    stalled the serving thread for up to N x timeout)."""
+
+    _MAX_PENDING_PUTS = 1024
 
     def __init__(self, base_url: str, block_size: int,
-                 get_timeout: float = 2.0):
-        import queue
+                 get_timeout: float = 2.0, io_threads: int = 4):
+        import concurrent.futures
         import threading
 
         self.base_url = base_url.rstrip("/")
@@ -105,42 +114,58 @@ class RemoteKVClient:
         self.get_timeout = get_timeout
         self.hits = 0
         self.queries = 0
-        self._q: "queue.Queue" = queue.Queue(maxsize=1024)
-        self._thread = threading.Thread(target=self._writer, daemon=True)
-        self._thread.start()
+        self._io = concurrent.futures.ThreadPoolExecutor(
+            max_workers=io_threads, thread_name_prefix="remote-kv")
+        self._local = threading.local()  # one Session per IO thread
+        self._pending_puts = 0
+        self._pending_lock = threading.Lock()
 
-    def _writer(self) -> None:
+    def _session(self):
         import requests
 
-        session = requests.Session()
-        while True:
-            key, data, meta = self._q.get()
-            try:
-                session.put(
-                    f"{self.base_url}/blocks/{key}", data=data,
-                    headers={"X-KV-Meta": meta}, timeout=10,
-                )
-            except Exception:
-                pass  # warm tier is best-effort
+        if getattr(self._local, "session", None) is None:
+            # stackcheck: disable=async-blocking — all requests IO in this
+            # client runs on the remote-kv executor threads, never the
+            # serving thread or the event loop (see class docstring)
+            self._local.session = requests.Session()
+        return self._local.session
+
+    # -- puts: fire-and-forget on the pool -------------------------------
+    def _put_one(self, key: str, data: bytes, meta: str) -> None:
+        try:
+            self._session().put(
+                f"{self.base_url}/blocks/{key}", data=data,
+                headers={"X-KV-Meta": meta}, timeout=10,
+            )
+        except Exception:
+            pass  # warm tier is best-effort
+        finally:
+            with self._pending_lock:
+                self._pending_puts -= 1
 
     def put_slab(self, chain_hash: int, slab: np.ndarray) -> None:
         import json
 
+        with self._pending_lock:
+            if self._pending_puts >= self._MAX_PENDING_PUTS:
+                return  # backlog: drop rather than grow without bound
+            self._pending_puts += 1
         meta = json.dumps({"shape": list(slab.shape), "dtype": str(slab.dtype)})
         try:
-            self._q.put_nowait((str(chain_hash), slab.tobytes(), meta))
-        except Exception:
-            pass  # queue full: drop
+            self._io.submit(self._put_one, str(chain_hash), slab.tobytes(),
+                            meta)
+        except RuntimeError:  # executor shut down (interpreter teardown)
+            with self._pending_lock:
+                self._pending_puts -= 1
 
-    def get_slab(self, chain_hash: int) -> Optional[np.ndarray]:
+    # -- gets: pipelined fetch with a batch deadline ----------------------
+    def _fetch_one(self, chain_hash: int) -> Optional[np.ndarray]:
         import json
 
-        import requests
-
-        self.queries += 1
         try:
-            r = requests.get(
-                f"{self.base_url}/blocks/{chain_hash}", timeout=self.get_timeout
+            r = self._session().get(
+                f"{self.base_url}/blocks/{chain_hash}",
+                timeout=self.get_timeout,
             )
             if r.status_code != 200:
                 return None
@@ -149,20 +174,48 @@ class RemoteKVClient:
 
             dtype = (jnp_.bfloat16 if meta.get("dtype") == "bfloat16"
                      else np.dtype(meta.get("dtype", "float32")))
-            slab = np.frombuffer(r.content, dtype).reshape(meta["shape"])
-            self.hits += 1
-            return slab
+            return np.frombuffer(r.content, dtype).reshape(meta["shape"])
         except Exception:
             return None
 
+    def get_slab(self, chain_hash: int) -> Optional[np.ndarray]:
+        self.queries += 1
+        slab = self._fetch_one(chain_hash)
+        if slab is not None:
+            self.hits += 1
+        return slab
+
     def match_extension(self, hashes: list[int], start: int,
                         max_usable: int) -> list[np.ndarray]:
-        slabs = []
-        for i in range(start, min(len(hashes), max_usable)):
-            slab = self.get_slab(hashes[i])
+        """Longest remote-cached run continuing the chain from ``start``.
+
+        Every candidate block is fetched concurrently; results are
+        consumed in chain order and the run stops at the first miss
+        (later completions are discarded — the chain is broken anyway).
+        One batch deadline bounds the admission stall regardless of run
+        length."""
+        import time
+
+        todo = list(range(start, min(len(hashes), max_usable)))
+        if not todo:
+            return []
+        futures = [self._io.submit(self._fetch_one, hashes[i])
+                   for i in todo]
+        deadline = time.monotonic() + self.get_timeout
+        slabs: list[np.ndarray] = []
+        for fut in futures:
+            self.queries += 1
+            try:
+                slab = fut.result(timeout=max(deadline - time.monotonic(),
+                                              0.0))
+            except Exception:  # timeout or fetch error: treat as miss
+                slab = None
             if slab is None:
                 break
+            self.hits += 1
             slabs.append(slab)
+        for fut in futures[len(slabs):]:
+            fut.cancel()  # not yet started → never hits the network
         return slabs
 
 

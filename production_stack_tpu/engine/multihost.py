@@ -211,6 +211,9 @@ class LeaderBroadcaster:
         self.server.settimeout(accept_timeout)
         # (socket, per-session frame-MAC key) — see _session_key
         self.conns: list[tuple[socket.socket, bytes]] = []
+        # threading.Lock (not asyncio) is correct here: broadcast() runs
+        # on the engine's sync worker thread and never awaits while held
+        # (audited by stackcheck's lock-across-await pass)
         self.lock = threading.Lock()
         self.seq = 0
 
@@ -369,6 +372,9 @@ def follower_loop(runner, leader_host: str, control_port: int,
                 raise TimeoutError(
                     f"could not reach leader at {leader_host}:{control_port}"
                 )
+            # stackcheck: disable=async-blocking — follower bootstrap runs
+            # on a dedicated sync thread before any event loop exists; a
+            # 0.5 s connect-retry backoff here blocks nothing but itself
             time.sleep(0.5)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     f_nonce = os.urandom(_NONCE_BYTES)

@@ -29,7 +29,9 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=self.size)
         # begin()/finish() may be reached from the engine worker thread via
         # callbacks as well as the event loop; a lock keeps append/snapshot
-        # consistent either way.
+        # consistent either way. threading.Lock (not asyncio.Lock) is
+        # correct: the critical sections are pure in-memory deque ops with
+        # no awaits inside (audited by stackcheck's lock-across-await pass).
         self._lock = threading.Lock()
         self._dropped = 0
         self._total = 0

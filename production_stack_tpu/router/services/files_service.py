@@ -3,11 +3,14 @@ files_service/ — Storage ABC + local-disk FileStorage + OpenAI file objects).
 
 Files are stored under ``<root>/<user>/<file_id>`` with a JSON sidecar of
 metadata; the default user is "anonymous" (matching the reference's
-per-user pathing)."""
+per-user pathing). Batch-API inputs arrive here as multi-megabyte JSONL
+uploads, so the disk IO runs in worker threads (``asyncio.to_thread``) —
+the handlers are async and must not stall the router's event loop."""
 
 from __future__ import annotations
 
 import abc
+import asyncio
 import dataclasses
 import json
 import os
@@ -63,39 +66,54 @@ class FileStorage(Storage):
     def _data_path(self, user: str, file_id: str) -> str:
         return os.path.join(self._dir(user), file_id)
 
+    def _write_file(self, user: str, file_id: str, content: bytes,
+                    obj: FileObject) -> None:
+        with open(self._data_path(user, file_id), "wb") as f:
+            f.write(content)
+        with open(self._meta_path(user, file_id), "w") as f:
+            json.dump(obj.to_dict(), f)
+
     async def save_file(self, filename, content, purpose, user="anonymous"):
         file_id = f"file-{uuid.uuid4().hex[:24]}"
         obj = FileObject(
             id=file_id, bytes=len(content), created_at=int(time.time()),
             filename=filename, purpose=purpose,
         )
-        with open(self._data_path(user, file_id), "wb") as f:
-            f.write(content)
-        with open(self._meta_path(user, file_id), "w") as f:
-            json.dump(obj.to_dict(), f)
+        await asyncio.to_thread(self._write_file, user, file_id, content,
+                                obj)
         return obj
+
+    def _read_meta(self, path: str) -> FileObject:
+        with open(path) as f:
+            return FileObject(**json.load(f))
 
     async def get_file(self, file_id, user="anonymous"):
         try:
-            with open(self._meta_path(user, file_id)) as f:
-                return FileObject(**json.load(f))
+            return await asyncio.to_thread(
+                self._read_meta, self._meta_path(user, file_id))
         except FileNotFoundError:
             raise KeyError(file_id) from None
+
+    def _read_data(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
 
     async def get_file_content(self, file_id, user="anonymous"):
         try:
-            with open(self._data_path(user, file_id), "rb") as f:
-                return f.read()
+            return await asyncio.to_thread(
+                self._read_data, self._data_path(user, file_id))
         except FileNotFoundError:
             raise KeyError(file_id) from None
 
-    async def list_files(self, user="anonymous"):
+    def _list_files(self, d: str) -> list[FileObject]:
         out = []
-        d = self._dir(user)
         for name in os.listdir(d):
             if name.endswith(".json"):
-                with open(os.path.join(d, name)) as f:
-                    out.append(FileObject(**json.load(f)))
+                out.append(self._read_meta(os.path.join(d, name)))
+        return out
+
+    async def list_files(self, user="anonymous"):
+        out = await asyncio.to_thread(self._list_files, self._dir(user))
         return sorted(out, key=lambda o: o.created_at, reverse=True)
 
     async def delete_file(self, file_id, user="anonymous"):

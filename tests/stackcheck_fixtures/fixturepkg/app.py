@@ -1,0 +1,10 @@
+"""Flag surface for the config-drift fixture template (never executed)."""
+import argparse
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host")
+    p.add_argument("--port", type=int)
+    p.add_argument("--max-model-len", type=int)
+    return p

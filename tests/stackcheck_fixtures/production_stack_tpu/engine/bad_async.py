@@ -1,0 +1,29 @@
+"""Known-POSITIVE async-blocking cases (all three rules).
+
+tests/test_stackcheck.py asserts the exact finding set from this file —
+update fixture and test together. Never imported: AST-scanned only.
+"""
+import queue
+import subprocess
+import time
+
+import requests
+
+work_queue = queue.Queue()
+
+# rule 2: sync HTTP at module scope in an async-tier directory
+_PROBE = requests.get("http://engine:8000/health", timeout=1)
+
+
+async def handler(worker_thread):
+    time.sleep(1)                         # rule 1: blocks the loop
+    requests.post("http://kv:8100/put")   # rule 1: sync HTTP in coroutine
+    subprocess.run(["sync"])              # rule 1: subprocess spawn
+    open("/tmp/state")                    # rule 1: sync file IO
+    work_queue.get()                      # rule 1: blocking queue get
+    worker_thread.join()                  # rule 1: thread join
+
+
+def poll_forever():
+    while True:
+        time.sleep(0.5)                   # rule 3: busy-wait poll loop

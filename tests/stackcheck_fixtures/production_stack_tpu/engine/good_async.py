@@ -1,0 +1,21 @@
+"""Known-NEGATIVE async cases: none of these may produce a finding.
+
+tests/test_stackcheck.py asserts this file stays silent. Never
+imported: AST-scanned only.
+"""
+import asyncio
+import time
+
+
+async def fine(request, q):
+    await asyncio.sleep(0.01)        # awaited sleep is the fix, not a bug
+    params = request.rel_url.query
+    limit = params.get("limit")      # dict-style .get(key), not a queue
+    item = await q.get()             # awaited queue get is an awaitable
+    return limit, item
+
+
+def sync_path():
+    time.sleep(0.2)                  # sync code, not in a loop
+    with open("/tmp/ok") as fh:      # sync file IO outside coroutines
+        return fh.read()

@@ -1,0 +1,12 @@
+"""Suppression fixture: the finding exists but a multi-line comment-block
+directive silences it — it must land in the report as *suppressed*, not
+active. Never imported: AST-scanned only.
+"""
+import time
+
+
+async def bootstrap():
+    # stackcheck: disable=async-blocking — fixture rationale line one,
+    # continuing on a second comment line to prove the directive covers
+    # the whole block plus the first code line after it
+    time.sleep(0.5)

@@ -1,0 +1,40 @@
+"""jit-purity positives and negatives.
+
+tests/test_stackcheck.py asserts the exact finding set (five in
+bad_kernel, one in bad_static, one in the jitted lambda, none in
+good_kernel/host_helper). Never imported: AST-scanned only.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_kernel(x):
+    print("tracing")                 # trace-time print
+    noise = np.random.rand()         # host RNG baked into the trace
+    t = time.time()                  # host clock read
+    y = x * noise
+    return float(x) + t + y.item()   # two device->host syncs
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def bad_static(x, cfg=[]):           # unhashable static default
+    return x
+
+
+scale = jax.jit(lambda x: float(x))  # call-site jit of a lambda
+
+
+@jax.jit
+def good_kernel(x):
+    jax.debug.print("value {}", x)
+    return jnp.sum(x) * 2
+
+
+def host_helper(x):
+    print("host-side logging is fine")
+    return float(x)

@@ -1,0 +1,22 @@
+"""metric-hygiene label and registration cases (drift cases live in the
+fixture docs/ and helm/dashboards/ files).
+
+tests/test_stackcheck.py asserts the exact finding set. Never imported:
+AST-scanned only.
+"""
+from prometheus_client import CollectorRegistry, Counter, Gauge
+
+REQS = Counter("vllm:fixture_requests_total", "total requests", ["model"])
+
+# duplicate: normalizes to the same name as REQS
+DUP = Counter("vllm:fixture_requests", "requests again")
+
+# per-request id label: unbounded cardinality
+INFLIGHT = Gauge("router:fixture_inflight", "in flight", ["request_id"])
+
+# custom registry: exempt from duplicate-registration checking
+_REG = CollectorRegistry()
+SCOPED = Counter("vllm:fixture_requests", "scoped twin", registry=_REG)
+
+# defined in code but absent from the fixture docs/observability.md
+UNDOC = Counter("vllm:fixture_undocumented", "missing from docs")

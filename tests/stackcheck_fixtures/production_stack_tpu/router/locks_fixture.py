@@ -1,0 +1,38 @@
+"""lock-across-await positives and negatives.
+
+tests/test_stackcheck.py asserts exactly two findings here (bad_hold and
+bad_inline) and none for the good_* functions. Never imported:
+AST-scanned only.
+"""
+import asyncio
+import threading
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+async def bad_hold():
+    with _lock:
+        await asyncio.sleep(0)       # held across the yield: finding
+
+
+async def bad_inline():
+    with threading.Lock():
+        async for _ in _gen():       # async-for is a yield point too
+            pass
+
+
+async def good_async_with():
+    async with _alock:
+        await asyncio.sleep(0)       # asyncio lock via async with: fine
+
+
+async def good_no_await():
+    with _lock:
+        x = 1                        # no yield inside the section: fine
+    await asyncio.sleep(0)
+    return x
+
+
+async def _gen():
+    yield 1

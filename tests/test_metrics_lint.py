@@ -1,5 +1,8 @@
-"""Tier-1 guard: dashboards, docs and code agree on metric names
-(tools/metrics_lint.py)."""
+"""Tier-1 guard: dashboards, docs and code agree on metric names.
+
+tools/metrics_lint.py is now a thin shim over stackcheck's
+metric-hygiene pass — these tests pin the shim's import/CLI contract;
+the pass itself is exercised by tests/test_stackcheck.py."""
 
 import subprocess
 import sys

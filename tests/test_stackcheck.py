@@ -1,0 +1,217 @@
+"""Tier-1 guard for tools/stackcheck: the five passes detect their
+fixture positives (and stay silent on the negatives), suppressions and
+the baseline round-trip, the --json shape is stable, and — the gate that
+matters — the real repo runs clean.
+
+The fixture mini-repo lives in tests/stackcheck_fixtures/ (see its
+README); fixture files and the expectations here are updated together.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "stackcheck_fixtures"
+sys.path.insert(0, str(REPO))
+
+from tools.stackcheck import core  # noqa: E402
+
+
+def fixture_report(only=None, baseline=None):
+    return core.run_passes(FIXTURES, only=only, baseline_path=baseline)
+
+
+def by_file(report, name):
+    """All findings (any status) whose path ends with name."""
+    return [f for f in report.findings if f.path.endswith(name)]
+
+
+# ---- registry / framework -------------------------------------------------
+
+def test_all_five_passes_registered():
+    assert sorted(core.all_passes()) == [
+        "async-blocking", "config-drift", "jit-purity",
+        "lock-across-await", "metric-hygiene",
+    ]
+
+
+# ---- async-blocking -------------------------------------------------------
+
+def test_async_blocking_positives():
+    r = fixture_report(only="async-blocking")
+    msgs = sorted(f.message for f in by_file(r, "bad_async.py"))
+    assert len(msgs) == 8, msgs
+    joined = "\n".join(msgs)
+    assert "time.sleep() blocks the event loop" in joined
+    assert "sync HTTP (requests) blocks the event loop" in joined
+    assert "subprocess blocks the event loop" in joined
+    assert "sync file IO blocks the event loop" in joined
+    assert "queue.get() blocks the event loop" in joined
+    assert "Thread.join() blocks the event loop" in joined
+    assert "sync HTTP (requests.get) in async-tier module" in joined
+    assert "busy-wait time.sleep loop" in joined
+
+
+def test_async_blocking_negatives():
+    r = fixture_report(only="async-blocking")
+    assert by_file(r, "good_async.py") == []
+
+
+def test_comment_block_suppression():
+    r = fixture_report(only="async-blocking")
+    found = by_file(r, "suppressed_async.py")
+    assert len(found) == 1
+    assert found[0] in r.suppressed
+    assert found[0] not in r.active
+
+
+# ---- lock-across-await ----------------------------------------------------
+
+def test_lock_across_await():
+    r = fixture_report(only="lock-across-await")
+    found = by_file(r, "locks_fixture.py")
+    assert sorted(f.message.split(":")[0] for f in found) == [
+        "in async def bad_hold", "in async def bad_inline"]
+    assert r.findings == found  # nothing elsewhere in the fixtures
+
+
+# ---- jit-purity -----------------------------------------------------------
+
+def test_jit_purity():
+    r = fixture_report(only="jit-purity")
+    found = by_file(r, "kernels_fixture.py")
+    assert r.findings == found
+    msgs = "\n".join(f.message for f in found)
+    bad = [f for f in found if "in jitted bad_kernel" in f.message]
+    assert len(bad) == 5, msgs
+    assert "print() traces to nothing" in msgs
+    assert "np.random.rand() is host-side RNG" in msgs
+    assert "time.time() bakes a host clock read" in msgs
+    assert ".item() forces a device" in msgs
+    assert "float() on traced argument 'x'" in msgs
+    assert sum("unhashable list default" in f.message for f in found) == 1
+    assert sum("in jitted <lambda>" in f.message for f in found) == 1
+    assert not any("good_kernel" in f.message for f in found)
+    assert not any("host_helper" in f.message for f in found)
+
+
+# ---- config-drift ---------------------------------------------------------
+
+def test_config_drift():
+    r = fixture_report(only="config-drift")
+    msgs = "\n".join(f"{f.path}: {f.message}" for f in r.findings)
+    assert len(r.findings) == 6, msgs
+    assert "renders '--bogus-flag' for fixturepkg.app" in msgs
+    assert "'fixturepkg.missing' has no source file" in msgs
+    assert "engineConfig.ghostKnob is dead config" in msgs
+    assert "routerSpec.deadScalar is dead config" in msgs
+    assert "routerSpec.resilience.ghostResilience is dead config" in msgs
+    assert "overlay key routerSpec.typoScalar does not exist" in msgs
+    # negatives: consumed keys and real flags stay silent
+    for ok in ("maxModelLen", "replicaCount", "circuitBreaker",
+               "--host", "--max-model-len"):
+        assert ok not in msgs
+
+
+# ---- metric-hygiene -------------------------------------------------------
+
+def test_metric_hygiene():
+    r = fixture_report(only="metric-hygiene")
+    msgs = "\n".join(f"{f.path}: {f.message}" for f in r.findings)
+    assert len(r.findings) == 5, msgs
+    assert "references 'vllm:fixture_dashboard_ghost', not defined" in msgs
+    assert "documents 'vllm:fixture_ghost', not defined" in msgs
+    assert "missing 'vllm:fixture_undocumented'" in msgs
+    assert "label 'request_id' looks per-request" in msgs
+    assert "already registered on the default registry" in msgs
+    # the registry=... constructor is exempt from duplicate checking
+    assert sum("already registered" in f.message for f in r.findings) == 1
+
+
+# ---- baseline round-trip --------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    first = fixture_report()
+    assert first.active and first.suppressed
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(bl, first.active)
+
+    second = fixture_report(baseline=bl)
+    assert second.active == []
+    assert len(second.baselined) == len(first.active)
+    # suppressed findings stay suppressed, never baselined
+    assert len(second.suppressed) == len(first.suppressed)
+
+
+def test_baseline_is_line_free(tmp_path):
+    f = core.Finding("async-blocking", "a/b.py", 42, "msg")
+    assert "42" not in f.baseline_key
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(bl, [f])
+    assert core.load_baseline(bl) == {"async-blocking a/b.py msg"}
+
+
+# ---- JSON shape -----------------------------------------------------------
+
+def test_json_report_is_stable():
+    a = fixture_report().to_json()
+    b = fixture_report().to_json()
+    assert json.dumps(a) == json.dumps(b)
+    assert a["version"] == 1
+    assert a["passes"] == sorted(core.all_passes())
+    assert set(a["counts"]) == {"active", "suppressed", "baselined"}
+    rows = a["findings"]
+    assert rows == sorted(
+        rows, key=lambda r: (r["path"], r["line"], r["pass"], r["message"]))
+    for row in rows:
+        assert list(row) == ["pass", "path", "line", "message", "status"]
+        assert row["status"] in ("active", "suppressed", "baselined")
+
+
+# ---- CLI ------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.stackcheck", *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_repo_runs_clean():
+    proc = _cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_fixture_json_and_exit_code():
+    proc = _cli("--root", str(FIXTURES), "--json")
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["counts"]["active"] > 0
+
+
+def test_cli_unknown_pass():
+    proc = _cli("--pass", "no-such-pass")
+    assert proc.returncode == 2
+    assert "unknown pass" in proc.stderr
+
+
+def test_cli_list():
+    proc = _cli("--list")
+    assert proc.returncode == 0
+    for name in core.all_passes():
+        assert name in proc.stdout
+
+
+# ---- the gate: this repo is clean -----------------------------------------
+
+def test_repo_has_no_active_findings():
+    report = core.run_passes(
+        REPO, baseline_path=REPO / core.BASELINE_DEFAULT)
+    assert not report.active, "\n".join(f.render() for f in report.active)
+    # every suppression in the repo proper carries a rationale (text after
+    # the directive on the same line)
+    for f in report.suppressed:
+        text = (REPO / f.path).read_text().splitlines()
+        directive = [ln for ln in text if "stackcheck: disable=" in ln]
+        assert directive, f.path

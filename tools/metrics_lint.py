@@ -1,71 +1,39 @@
 #!/usr/bin/env python
-"""Metrics drift lint: every metric name referenced by a Grafana dashboard
-or the observability docs must exist in code, and every engine/router
-``vllm:*`` metric defined in code must be documented in
-docs/observability.md. Run from the repo root:
+"""Metrics drift lint — now a thin shim over stackcheck's metric-hygiene
+pass (tools/stackcheck/passes/metric_hygiene.py), kept for the old entry
+point and import surface:
 
-    python tools/metrics_lint.py
+    python tools/metrics_lint.py        # == python -m tools.stackcheck \
+                                        #      --pass metric-hygiene
 
-Exit status is non-zero on any drift; tests/test_metrics_lint.py runs this
-in tier-1 so a renamed metric fails CI instead of silently flat-lining a
-dashboard panel.
-
-Name normalization: prometheus_client appends ``_total`` to counters at
-exposition time, and histograms export ``_bucket``/``_sum``/``_count``
-series — a dashboard legitimately references those derived names, so
-suffixes are stripped back to the base name before comparison (and
-``_total`` may be part of the declared name itself, so both spellings of a
-counter collapse to one key).
+The regex, normalization and inventory helpers (``_NAME``, ``normalize``,
+``code_metrics``, ``dashboard_refs``, ``doc_refs``) re-export the pass's
+implementations; tests/test_metrics_lint.py pins this contract. New
+rules land in the pass, not here — see docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import json
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-# vllm:foo / router:foo / kvserver:foo — the stack's metric namespaces.
-# Guards against non-metric lookalikes: a leading [\w-] lookbehind skips
-# image tags ("tpu-serving-router:0.1.0"), the first-char [a-z] skips
-# ":0.1.0"-style versions, and requiring the name to end on [a-z0-9] with
-# no word char following rejects brace templates in docstrings
-# ("vllm:gpu_prefix_cache_{hits,queries}" ends on "_{") while still
-# matching PromQL selectors ("vllm:num_requests_waiting{pod=...}").
-_NAME = re.compile(
-    r"(?<![\w-])(?:vllm|router|kvserver):[a-z][a-z0-9_]*[a-z0-9](?!\w)"
-)
-_SUFFIXES = ("_bucket", "_sum", "_count", "_created", "_total")
+from tools.stackcheck import core  # noqa: E402
+from tools.stackcheck.passes import metric_hygiene as _pass  # noqa: E402
 
-
-def normalize(name: str) -> str:
-    for suffix in _SUFFIXES:
-        if name.endswith(suffix):
-            return name[: -len(suffix)]
-    return name
+_NAME = _pass.NAME_RE
+normalize = _pass.normalize
 
 
 def code_metrics() -> set[str]:
-    """Metric names declared anywhere under production_stack_tpu/.
-
-    Declaration sites are plain string literals (prometheus_client
-    constructors and MetricFamily yields), so a namespace-pattern scan of
-    the source is the inventory — no import side effects needed."""
-    found: set[str] = set()
-    for path in (REPO / "production_stack_tpu").rglob("*.py"):
-        found |= {normalize(m) for m in _NAME.findall(path.read_text())}
-    return found
+    return _pass.code_metrics(core.Context(REPO))
 
 
 def dashboard_refs() -> dict[str, set[str]]:
-    refs: dict[str, set[str]] = {}
-    for pattern in ("helm/dashboards/*.json", "observability/*.json"):
-        for path in sorted(REPO.glob(pattern)):
-            names = {normalize(m) for m in _NAME.findall(path.read_text())}
-            refs[str(path.relative_to(REPO))] = names
-    return refs
+    return _pass.dashboard_refs(core.Context(REPO))
 
 
 def doc_refs(doc: Path) -> set[str]:
@@ -75,36 +43,15 @@ def doc_refs(doc: Path) -> set[str]:
 
 
 def run() -> int:
-    code = code_metrics()
-    failures: list[str] = []
-
-    for source, names in dashboard_refs().items():
-        for name in sorted(names - code):
-            failures.append(
-                f"{source}: references {name!r}, not defined in code"
-            )
-
-    doc = REPO / "docs" / "observability.md"
-    documented = doc_refs(doc)
-    for name in sorted(documented - code):
-        failures.append(
-            f"docs/observability.md: documents {name!r}, not defined in code"
-        )
-    # the docs are the metrics reference: every vllm:* metric the stack
-    # exports must appear there (router:* host gauges are internal)
-    undocumented = {n for n in code - documented if n.startswith("vllm:")}
-    for name in sorted(undocumented):
-        failures.append(
-            f"docs/observability.md: missing {name!r} (defined in code)"
-        )
-
-    if failures:
-        print(f"metrics lint: {len(failures)} problem(s)")
-        for f in failures:
-            print(f"  {f}")
+    report = core.run_passes(
+        REPO, only=_pass.PASS,
+        baseline_path=REPO / core.BASELINE_DEFAULT)
+    if report.active:
+        print(f"metrics lint: {len(report.active)} problem(s)")
+        for f in report.active:
+            print(f"  {f.path}: {f.message}")
         return 1
-    print(f"metrics lint: OK ({len(code)} metrics in code, "
-          f"{len(documented)} documented)")
+    print(f"metrics lint: OK ({len(code_metrics())} metrics in code)")
     return 0
 
 

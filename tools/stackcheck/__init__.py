@@ -1,0 +1,33 @@
+"""stackcheck: the repo-native static-analysis suite.
+
+Usage::
+
+    python -m tools.stackcheck [--pass NAME] [--json] [--baseline FILE]
+                               [--write-baseline] [--root DIR] [--list]
+
+Passes (tools/stackcheck/passes/):
+
+* ``async-blocking``    — blocking calls inside ``async def`` bodies, and
+  sync-HTTP / busy-wait hazards in the async serving tiers
+* ``lock-across-await`` — an ``await`` while a sync lock is held
+* ``jit-purity``        — host syncs / impure traces inside jitted code
+* ``config-drift``      — Helm values vs. the router/engine flag surface
+* ``metric-hygiene``    — metric name drift (ex-``tools/metrics_lint.py``),
+  label cardinality, duplicate registration
+
+See docs/static-analysis.md for the catalog, suppression syntax
+(``# stackcheck: disable=<pass>``) and the baseline workflow.
+"""
+
+from tools.stackcheck.core import (  # noqa: F401
+    BASELINE_DEFAULT,
+    Context,
+    Finding,
+    Pass,
+    Report,
+    all_passes,
+    load_baseline,
+    register,
+    run_passes,
+    write_baseline,
+)

@@ -1,0 +1,70 @@
+"""CLI driver — ``python -m tools.stackcheck``. Exit status 0 iff there
+are no active (unsuppressed, un-baselined) findings."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.stackcheck import core
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "stackcheck", description="repo-native static analysis suite")
+    p.add_argument("--pass", dest="only", default=None, metavar="NAME",
+                   help="run a single pass (default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout (stable shape)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file of grandfathered findings "
+                        f"(default: {core.BASELINE_DEFAULT} if it exists)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write every unsuppressed finding to the baseline "
+                        "file and exit 0")
+    p.add_argument("--root", default=None,
+                   help="repo root to analyse (default: this checkout)")
+    p.add_argument("--list", action="store_true",
+                   help="list registered passes and exit")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name, pa in sorted(core.all_passes().items()):
+            print(f"{name:18s} {pa.doc}")
+        return 0
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parent.parent.parent
+    baseline = Path(args.baseline) if args.baseline else \
+        root / core.BASELINE_DEFAULT
+    try:
+        report = core.run_passes(
+            root, only=args.only,
+            baseline_path=baseline if baseline.exists() else None)
+    except KeyError as e:
+        print(f"stackcheck: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        core.write_baseline(baseline, report.baselined + report.active)
+        print(f"stackcheck: wrote {len(report.baselined + report.active)} "
+              f"finding(s) to {baseline}")
+        return 0
+
+    if args.json:
+        json.dump(report.to_json(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in report.active:
+            print(f.render())
+        print(f"stackcheck: {len(report.active)} active, "
+              f"{len(report.suppressed)} suppressed, "
+              f"{len(report.baselined)} baselined "
+              f"({', '.join(report.passes_run)})")
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

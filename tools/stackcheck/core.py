@@ -1,0 +1,244 @@
+"""stackcheck core: findings, the pass registry, inline suppressions and
+the committed baseline.
+
+A *pass* is a named analysis over the repo tree (AST for Python, text for
+Helm/Grafana/docs). Passes emit :class:`Finding`s; the driver then filters
+them through two escape hatches:
+
+* inline suppressions — ``# stackcheck: disable=<pass>[,<pass>...]`` on the
+  finding's line, or in the comment block directly above it (the directive
+  covers the rest of the comment block plus the first code line after it,
+  so multi-line rationales work). ``disable=all`` silences every pass. A
+  suppression should always carry a rationale.
+* the committed baseline — grandfathered findings recorded by
+  ``--write-baseline``. Baseline identity is ``pass path message`` (no line
+  number, so unrelated edits don't churn it).
+
+Anything not suppressed and not baselined is *active* and fails the run —
+tests/test_stackcheck.py runs the suite in tier-1, so an active finding
+fails CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional
+
+BASELINE_DEFAULT = "tools/stackcheck/baseline.json"
+
+_SUPPRESS = re.compile(r"#\s*stackcheck:\s*disable=([a-z\-,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One problem at one place. ``line`` is 1-based; 0 means whole-file."""
+
+    pass_name: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def baseline_key(self) -> str:
+        # line-free identity: edits elsewhere in the file must not churn
+        # the baseline
+        return f"{self.pass_name} {self.path} {self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+class Context:
+    """Shared repo view handed to every pass: cached sources and ASTs.
+
+    ``root`` is the repo root. Fixture tests point it at a mini-repo under
+    tests/stackcheck_fixtures/, so passes must resolve everything through
+    the context rather than the real repo.
+    """
+
+    def __init__(self, root: Path):
+        self.root = Path(root).resolve()
+        self._text: Dict[Path, str] = {}
+        self._tree: Dict[Path, Optional[ast.AST]] = {}
+
+    def rel(self, path: Path) -> str:
+        return path.resolve().relative_to(self.root).as_posix()
+
+    def read(self, path: Path) -> str:
+        path = Path(path)
+        if path not in self._text:
+            self._text[path] = path.read_text(encoding="utf-8")
+        return self._text[path]
+
+    def parse(self, path: Path) -> Optional[ast.AST]:
+        """AST for a Python file; None if it fails to parse (a syntactically
+        broken file is the interpreter's problem, not stackcheck's)."""
+        path = Path(path)
+        if path not in self._tree:
+            try:
+                self._tree[path] = ast.parse(self.read(path),
+                                             filename=str(path))
+            except SyntaxError:
+                self._tree[path] = None
+        return self._tree[path]
+
+    def py_files(self, *subdirs: str) -> List[Path]:
+        """Sorted .py files under ``root/<subdir>`` for each existing
+        subdir (skips missing ones so fixture mini-repos stay small)."""
+        out: List[Path] = []
+        for sub in subdirs:
+            base = self.root / sub
+            if base.is_dir():
+                out.extend(sorted(base.rglob("*.py")))
+            elif base.is_file():
+                out.append(base)
+        return out
+
+    def glob(self, pattern: str) -> List[Path]:
+        return sorted(self.root.glob(pattern))
+
+
+@dataclasses.dataclass(frozen=True)
+class Pass:
+    name: str
+    doc: str
+    run: Callable[[Context], List[Finding]]
+
+
+_REGISTRY: Dict[str, Pass] = {}
+
+
+def register(name: str, doc: str):
+    """Decorator: ``@register("async-blocking", "...")`` on a
+    ``run(ctx) -> list[Finding]`` function."""
+
+    def deco(fn: Callable[[Context], List[Finding]]) -> Pass:
+        p = Pass(name=name, doc=doc, run=fn)
+        _REGISTRY[name] = p
+        return fn
+
+    return deco
+
+
+def all_passes() -> Dict[str, Pass]:
+    # import for side effect: the passes package registers itself
+    from tools.stackcheck import passes  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# -- suppressions -----------------------------------------------------------
+
+def suppressed_passes(ctx: Context, path: str) -> Dict[int, set]:
+    """line -> set of pass names disabled on that line (by a directive on
+    the line itself or in the comment block directly above it)."""
+    try:
+        lines = ctx.read(ctx.root / path).splitlines()
+    except (OSError, UnicodeDecodeError):
+        return {}
+    out: Dict[int, set] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS.search(text)
+        if not m:
+            continue
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        out.setdefault(i, set()).update(names)
+        # a directive inside a comment block covers the whole block and
+        # the first code line after it (multi-line rationales welcome)
+        j = i + 1
+        while j <= len(lines) and lines[j - 1].lstrip().startswith("#"):
+            out.setdefault(j, set()).update(names)
+            j += 1
+        out.setdefault(j, set()).update(names)
+    return out
+
+
+def is_suppressed(ctx: Context, f: Finding,
+                  cache: Dict[str, Dict[int, set]]) -> bool:
+    if f.path not in cache:
+        cache[f.path] = suppressed_passes(ctx, f.path)
+    names = cache[f.path].get(f.line, set())
+    return f.pass_name in names or "all" in names
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Path) -> set:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    keys = sorted({f.baseline_key for f in findings})
+    path.write_text(json.dumps(
+        {"version": 1, "findings": keys}, indent=2) + "\n")
+
+
+# -- driver -----------------------------------------------------------------
+
+@dataclasses.dataclass
+class Report:
+    """A full run: every finding, partitioned by what silences it."""
+
+    findings: List[Finding]
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    active: List[Finding]
+    passes_run: List[str]
+
+    def to_json(self) -> dict:
+        """Stable shape for tooling: sorted findings with status flags.
+        Key order and sort order are part of the contract
+        (tests/test_stackcheck.py pins them)."""
+
+        def row(f: Finding, status: str) -> dict:
+            return {"pass": f.pass_name, "path": f.path, "line": f.line,
+                    "message": f.message, "status": status}
+
+        status = {}
+        for f in self.suppressed:
+            status[f] = "suppressed"
+        for f in self.baselined:
+            status[f] = "baselined"
+        rows = [row(f, status.get(f, "active")) for f in self.findings]
+        rows.sort(key=lambda r: (r["path"], r["line"], r["pass"],
+                                 r["message"]))
+        return {
+            "version": 1,
+            "passes": sorted(self.passes_run),
+            "findings": rows,
+            "counts": {"active": len(self.active),
+                       "suppressed": len(self.suppressed),
+                       "baselined": len(self.baselined)},
+        }
+
+
+def run_passes(root: Path, only: Optional[str] = None,
+               baseline_path: Optional[Path] = None) -> Report:
+    ctx = Context(root)
+    passes = all_passes()
+    if only is not None:
+        if only not in passes:
+            raise KeyError(
+                f"unknown pass {only!r}; have {sorted(passes)}")
+        passes = {only: passes[only]}
+    findings: List[Finding] = []
+    for name in sorted(passes):
+        findings.extend(passes[name].run(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name, f.message))
+
+    cache: Dict[str, Dict[int, set]] = {}
+    suppressed = [f for f in findings if is_suppressed(ctx, f, cache)]
+    rest = [f for f in findings if f not in set(suppressed)]
+    baseline = load_baseline(baseline_path) if baseline_path else set()
+    baselined = [f for f in rest if f.baseline_key in baseline]
+    active = [f for f in rest if f.baseline_key not in baseline]
+    return Report(findings=findings, suppressed=suppressed,
+                  baselined=baselined, active=active,
+                  passes_run=sorted(passes))

@@ -1,0 +1,12 @@
+"""Pass registry: importing this package registers every pass with
+tools.stackcheck.core. Add a new pass by dropping a module here that calls
+``@register(...)`` and importing it below (docs/static-analysis.md walks
+through it)."""
+
+from tools.stackcheck.passes import (  # noqa: F401
+    async_blocking,
+    config_drift,
+    jit_purity,
+    lock_across_await,
+    metric_hygiene,
+)

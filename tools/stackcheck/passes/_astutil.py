@@ -1,0 +1,68 @@
+"""Shared AST helpers for the stackcheck passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+# the async serving tiers: code here runs on (or next to) an event loop
+ASYNC_TIER_DIRS = (
+    "production_stack_tpu/engine",
+    "production_stack_tpu/router",
+    "production_stack_tpu/operator",
+    "production_stack_tpu/kv_server.py",
+    "production_stack_tpu/flight_recorder.py",
+)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function or
+    class definitions — their bodies run in their own context, not the
+    enclosing one."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def async_functions(tree: ast.AST) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def is_lockish(expr: ast.AST) -> bool:
+    """Heuristic: does this context-manager expression look like a lock?
+    Matches ``self._lock``, ``write_lock``, ``cv``-free mutex names and
+    inline ``threading.Lock()`` constructions."""
+    if isinstance(expr, ast.Call):
+        name = call_name(expr) or ""
+        last = name.rsplit(".", 1)[-1]
+        return last in ("Lock", "RLock", "Semaphore", "BoundedSemaphore",
+                        "Condition")
+    name = dotted(expr)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1].lower()
+    return (last in ("mutex", "lock") or last.endswith("_lock")
+            or last.endswith("lock"))

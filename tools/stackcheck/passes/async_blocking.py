@@ -1,0 +1,181 @@
+"""async-blocking: blocking calls where the event loop (or the engine's
+serving thread) can stall behind them.
+
+Three rules:
+
+1. *In coroutines* — a known-blocking call in an ``async def`` body
+   anywhere under production_stack_tpu/: ``time.sleep``, sync HTTP
+   (``requests.*`` / ``urllib.request.urlopen``), subprocess spawns,
+   ``Thread.join``, blocking ``Queue.get/put`` and ``socket`` dials. These
+   freeze every other request on the loop (flake8-async's ASYNC1xx class).
+   Nested ``def``s are skipped — they execute in their own context.
+2. *Sync HTTP in the async tiers* — any ``requests.…`` usage in
+   engine/router/operator modules, even outside ``async def``: those tiers
+   interleave with an event loop or the serving thread, so network IO
+   must go through aiohttp or a dedicated executor. Suppress (with a
+   rationale) where the call provably runs on its own IO thread.
+3. *Busy-wait polls* — ``time.sleep`` inside a loop in the async tiers:
+   a poll loop that should be an event wait, a backoff, or (if genuinely
+   fine on a sync bootstrap thread) a justified suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.stackcheck.core import Context, Finding, register
+from tools.stackcheck.passes._astutil import (
+    ASYNC_TIER_DIRS,
+    async_functions,
+    call_name,
+    walk_shallow,
+)
+
+PASS = "async-blocking"
+
+# dotted-name prefixes/exacts that block the calling thread
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep() blocks the event loop; use asyncio.sleep",
+    "urllib.request.urlopen":
+        "sync HTTP blocks the event loop; use aiohttp or run_in_executor",
+    "subprocess.run": "subprocess blocks the event loop; use "
+                      "asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess blocks the event loop; use "
+                       "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "subprocess blocks the event loop; use "
+                             "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "subprocess blocks the event loop; use "
+                               "asyncio.create_subprocess_exec",
+    "os.system": "os.system blocks the event loop; use "
+                 "asyncio.create_subprocess_shell",
+    "os.waitpid": "os.waitpid blocks the event loop",
+    "socket.create_connection":
+        "blocking socket dial on the event loop; use asyncio.open_connection",
+}
+_REQUESTS_METHODS = ("get", "post", "put", "delete", "head", "patch",
+                     "request", "Session")
+
+
+def _requests_call(name: str) -> bool:
+    return name == "requests" or (
+        name.startswith("requests.")
+        and name.split(".", 1)[1] in _REQUESTS_METHODS)
+
+
+def _blocking_reason(node: ast.Call) -> str:
+    """Why this call blocks, or '' if it doesn't (for rule 1)."""
+    name = call_name(node) or ""
+    if name in _BLOCKING_EXACT:
+        return _BLOCKING_EXACT[name]
+    if _requests_call(name):
+        return ("sync HTTP (requests) blocks the event loop; use aiohttp "
+                "or run_in_executor")
+    if name == "open":
+        return ("sync file IO blocks the event loop; use run_in_executor "
+                "(or suppress for small startup-time reads)")
+    last = name.rsplit(".", 1)[-1]
+    recv = name.rsplit(".", 1)[0].lower() if "." in name else ""
+    if last == "join" and not node.args and "thread" in recv:
+        return "Thread.join() blocks the event loop; use run_in_executor"
+    recv_last = recv.rsplit(".", 1)[-1].strip("_")
+    if (last in ("get", "put") and not node.args
+            and ("queue" in recv_last or recv_last == "q")):
+        # queue.Queue.get/put without _nowait parks the thread. Zero
+        # positional args discriminates from dict.get(key)-style lookups
+        # on q-named locals; block=False is explicitly non-blocking.
+        if not any(isinstance(kw.value, ast.Constant)
+                   and kw.arg == "block" and kw.value.value is False
+                   for kw in node.keywords):
+            return (f"queue.{last}() blocks the event loop; use "
+                    f"asyncio.Queue or {last}_nowait")
+    return ""
+
+
+def _in_async_tier(rel: str) -> bool:
+    return any(rel == d or rel.startswith(d.rstrip("/") + "/")
+               for d in ASYNC_TIER_DIRS)
+
+
+@register(PASS, "blocking calls in async defs; sync HTTP / busy-waits in "
+                "the async serving tiers")
+def run(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        tier = _in_async_tier(rel)
+
+        # rule 1: blocking calls directly inside coroutine bodies.
+        # An awaited call (``await q.get()``) is by construction an
+        # awaitable, not a blocking sync call — skip those.
+        async_spans = []
+        for fn in async_functions(tree):
+            async_spans.append(fn)
+            awaited = {id(n.value) for n in walk_shallow(fn)
+                       if isinstance(n, ast.Await)}
+            for node in walk_shallow(fn):
+                if isinstance(node, ast.Call) and id(node) not in awaited:
+                    reason = _blocking_reason(node)
+                    if reason:
+                        out.append(Finding(PASS, rel, node.lineno,
+                                           f"in async def {fn.name}: "
+                                           f"{reason}"))
+
+        if not tier:
+            continue
+        in_async = set()
+        for fn in async_spans:
+            for node in walk_shallow(fn):
+                in_async.add(id(node))
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or id(node) in in_async:
+                continue
+            name = call_name(node) or ""
+            # rule 2: requests anywhere in an async-tier module
+            if _requests_call(name):
+                out.append(Finding(
+                    PASS, rel, node.lineno,
+                    f"sync HTTP ({name}) in async-tier module; use aiohttp "
+                    "or run it on a dedicated executor thread"))
+            # rule 3: time.sleep inside a loop = busy-wait poll
+            elif name == "time.sleep":
+                if _inside_loop(tree, node):
+                    out.append(Finding(
+                        PASS, rel, node.lineno,
+                        "busy-wait time.sleep loop in async-tier module; "
+                        "use an event/condition wait or justify with a "
+                        "suppression"))
+    return out
+
+
+def _inside_loop(tree: ast.AST, target: ast.AST) -> bool:
+    """Is ``target`` nested (shallowly — not across function boundaries)
+    inside a While/For?"""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loops = 0
+
+        def generic_visit(self, node):
+            if node is target and self.loops:
+                found[0] = True
+            is_loop = isinstance(node, (ast.While, ast.For))
+            bound = isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda))
+            if is_loop:
+                self.loops += 1
+            if bound:
+                saved, self.loops = self.loops, 0
+            super().generic_visit(node)
+            if is_loop:
+                self.loops -= 1
+            if bound:
+                self.loops = saved
+
+    V().visit(tree)
+    return found[0]

@@ -1,0 +1,189 @@
+"""config-drift: the Helm chart and the process flag surfaces must agree.
+
+Three checks, composing into the full chain
+``values.yaml key → template arg → argparse flag``:
+
+1. *Template flags are real.* Each container in helm/templates/ declares
+   ``command: ["python", "-m", "<module>"]``; every ``- "--flag"`` arg it
+   renders must be declared by an ``add_argument`` in that module's
+   source. A typo'd flag here is a CrashLoopBackOff at pod start.
+2. *values.yaml keys are consumed.* Every key under ``engineConfig``,
+   ``routerSpec.resilience``, ``routerSpec.observability`` and every
+   scalar key of ``routerSpec``/``cacheserverSpec`` must be referenced by
+   some template. An unconsumed key is dead config — the operator sets
+   it, nothing changes, nobody notices.
+3. *Overlay shape.* Every mapping path in values-ci.yaml must exist in
+   values.yaml — an overlay key that drifted from the chart's shape is
+   silently ignored by helm.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from tools.stackcheck.core import Context, Finding, register
+
+PASS = "config-drift"
+
+_CMD = re.compile(r'command:\s*\[.*?"-m",\s*"([\w.]+)"')
+_FLAG = re.compile(r'^\s*-\s*"(--[a-z][a-z0-9-]*)"')
+_IMAGE = re.compile(r"^\s*image:")
+
+# structural values.yaml subtrees that templates consume wholesale
+# (toYaml / probes / scheduling) — their leaf keys are k8s schema, not
+# stack config, so key-consumption does not apply
+_STRUCTURAL = {
+    "resources", "hpa", "pdb", "ingress", "env", "tolerations",
+    "affinity", "podAnnotations", "serviceAnnotations", "startupProbe",
+    "livenessProbe", "readinessProbe", "nodeSelector", "securityContext",
+    "containerSecurityContext", "extraVolumes", "extraVolumeMounts",
+}
+
+
+def _parser_flags(ctx: Context, module_path: Path) -> Set[str]:
+    tree = ctx.parse(module_path)
+    flags: Set[str] = set()
+    if tree is None:
+        return flags
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            for arg in node.args:
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("--")):
+                    flags.add(arg.value)
+    return flags
+
+
+def _module_source(ctx: Context, module: str) -> Optional[Path]:
+    p = ctx.root / (module.replace(".", "/") + ".py")
+    if p.exists():
+        return p
+    p = ctx.root / module.replace(".", "/") / "__main__.py"
+    return p if p.exists() else None
+
+
+def _check_template_flags(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    parser_cache: Dict[str, Set[str]] = {}
+    for tmpl in ctx.glob("helm/templates/*.yaml"):
+        rel = ctx.rel(tmpl)
+        module: Optional[str] = None
+        for lineno, line in enumerate(ctx.read(tmpl).splitlines(), 1):
+            if _IMAGE.match(line):
+                module = None  # a new container block begins
+            m = _CMD.search(line)
+            if m:
+                module = m.group(1)
+                if module not in parser_cache:
+                    src = _module_source(ctx, module)
+                    if src is None:
+                        out.append(Finding(
+                            PASS, rel, lineno,
+                            f"container command module {module!r} has no "
+                            f"source file in this repo"))
+                        parser_cache[module] = set()
+                    else:
+                        parser_cache[module] = _parser_flags(ctx, src)
+                continue
+            fm = _FLAG.match(line)
+            if fm and module is not None:
+                flag = fm.group(1)
+                known = parser_cache.get(module, set())
+                if known and flag not in known:
+                    out.append(Finding(
+                        PASS, rel, lineno,
+                        f"renders {flag!r} for {module}, which declares "
+                        f"no such argparse flag (pod would crash at "
+                        f"start)"))
+    return out
+
+
+def _line_of(text: str, key: str) -> int:
+    for i, line in enumerate(text.splitlines(), 1):
+        if re.match(rf"\s*{re.escape(key)}:", line):
+            return i
+    return 0
+
+
+def _check_values_consumed(ctx: Context) -> List[Finding]:
+    values = ctx.root / "helm" / "values.yaml"
+    if not values.exists():
+        return []
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover - pyyaml is in the image
+        return []
+    data = yaml.safe_load(ctx.read(values)) or {}
+    vtext = ctx.read(values)
+    ttext = "".join(ctx.read(p) for p in ctx.glob("helm/templates/*.yaml"))
+    ttext += "".join(ctx.read(p) for p in ctx.glob("helm/templates/*.tpl"))
+    out: List[Finding] = []
+    rel = ctx.rel(values)
+
+    def check_key(section: str, key: str) -> None:
+        if key not in ttext:
+            out.append(Finding(
+                PASS, rel, _line_of(vtext, key),
+                f"{section}.{key} is dead config: no template references "
+                f"it (fix the chart or delete the key)"))
+
+    def check_map(section: str, mapping: dict) -> None:
+        for key, val in mapping.items():
+            if key in _STRUCTURAL:
+                continue
+            if isinstance(val, dict):
+                continue  # nested maps are checked explicitly below
+            check_key(section, key)
+
+    for spec in (data.get("servingEngineSpec") or {}).get("modelSpec") or []:
+        for key in (spec.get("engineConfig") or {}):
+            check_key("engineConfig", key)
+    router = data.get("routerSpec") or {}
+    check_map("routerSpec", router)
+    for sub in ("resilience", "observability"):
+        for key in (router.get(sub) or {}):
+            check_key(f"routerSpec.{sub}", key)
+    check_map("cacheserverSpec", data.get("cacheserverSpec") or {})
+    return out
+
+
+def _check_overlay(ctx: Context) -> List[Finding]:
+    base_p = ctx.root / "helm" / "values.yaml"
+    ci_p = ctx.root / "helm" / "values-ci.yaml"
+    if not (base_p.exists() and ci_p.exists()):
+        return []
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover
+        return []
+    base = yaml.safe_load(ctx.read(base_p)) or {}
+    ci = yaml.safe_load(ctx.read(ci_p)) or {}
+    out: List[Finding] = []
+    rel = ctx.rel(ci_p)
+    citext = ctx.read(ci_p)
+
+    def walk(over: dict, under: dict, path: str) -> None:
+        for key, val in over.items():
+            if key not in under:
+                out.append(Finding(
+                    PASS, rel, _line_of(citext, key),
+                    f"overlay key {path}{key} does not exist in "
+                    f"values.yaml — helm ignores it silently"))
+            elif isinstance(val, dict) and isinstance(under[key], dict):
+                walk(val, under[key], f"{path}{key}.")
+
+    walk(ci, base, "")
+    return out
+
+
+@register(PASS, "helm values/templates vs. the router/engine argparse "
+                "surface")
+def run(ctx: Context) -> List[Finding]:
+    return (_check_template_flags(ctx) + _check_values_consumed(ctx)
+            + _check_overlay(ctx))

@@ -1,0 +1,186 @@
+"""jit-purity: host syncs and impure traces inside jitted code.
+
+A function is *jitted* when it is decorated with ``@jax.jit`` /
+``@pjit`` / ``@shard_map`` (directly or via ``functools.partial``), or
+passed as the first argument to a ``jax.jit(...)`` / ``shard_map(...)``
+call in the same module (lambdas and local names both resolve).
+
+Inside a jitted body each of these is a silent recompile or a host
+round-trip per dispatch:
+
+* ``print`` / ``logging`` — traces once, then either vanishes or (worse)
+  forces the value to host; ``jax.debug.print`` is the pure alternative
+* ``time.*`` — host clock reads bake a constant into the trace
+* ``np.random.*`` — numpy RNG is host-side and traces to a constant;
+  use ``jax.random``
+* ``.item()`` / ``float(x)`` / ``int(x)`` / ``bool(x)`` on a traced
+  argument — a blocking device→host sync inside the computation
+* a ``static_argnames``/``static_argnums`` parameter with a mutable
+  (unhashable) default — every call with the default raises or retraces
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.stackcheck.core import Context, Finding, register
+from tools.stackcheck.passes._astutil import call_name, dotted
+
+PASS = "jit-purity"
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit", "shard_map",
+              "jax.experimental.shard_map.shard_map"}
+_LOGGERISH = {"logging", "logger", "log", "LOG", "_log", "_logger"}
+
+
+def _jit_wrapper(call: ast.Call) -> Optional[ast.Call]:
+    """If this Call is jax.jit/pjit/shard_map (possibly spelled as
+    functools.partial(jax.jit, ...)), return the Call carrying the jit
+    kwargs, else None."""
+    name = call_name(call) or ""
+    if name in _JIT_NAMES:
+        return call
+    if name in ("functools.partial", "partial") and call.args:
+        inner = call.args[0]
+        if (dotted(inner) or "") in _JIT_NAMES:
+            return call
+    return None
+
+
+def _jitted_functions(tree: ast.AST) -> List[Tuple[ast.AST, str, ast.Call]]:
+    """(function node, display name, jit-call-with-kwargs) for every
+    jitted def/lambda in the module."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    out: List[Tuple[ast.AST, str, ast.Call]] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.AST, name: str, jc: ast.Call) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append((fn, name, jc))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                jc = _jit_wrapper(deco) if isinstance(deco, ast.Call) \
+                    else None
+                if jc is not None or (dotted(deco) or "") in _JIT_NAMES:
+                    add(node, node.name,
+                        jc if jc is not None else ast.Call(
+                            func=deco, args=[], keywords=[]))
+        elif isinstance(node, ast.Call):
+            jc = _jit_wrapper(node)
+            if jc is None or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                add(target, "<lambda>", jc)
+            else:
+                tname = dotted(target)
+                if tname and "." not in tname:
+                    for fn in defs_by_name.get(tname, []):
+                        add(fn, tname, jc)
+    return out
+
+
+def _params(fn: ast.AST) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _static_param_issues(fn: ast.AST, jit_call: ast.Call) -> List[str]:
+    """static_argnames/argnums pointing at a parameter whose default is a
+    mutable literal (list/dict/set) — unhashable at dispatch time."""
+    if isinstance(fn, ast.Lambda):
+        return []
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    defaults: Dict[str, ast.AST] = {}
+    for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        defaults[a.arg] = d
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None:
+            defaults[a.arg] = d
+
+    static: Set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    static.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               int):
+                    if 0 <= el.value < len(pos):
+                        static.add(pos[el.value].arg)
+    issues = []
+    for name in sorted(static):
+        d = defaults.get(name)
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            issues.append(
+                f"static arg {name!r} has an unhashable "
+                f"{type(d).__name__.lower()} default — jit dispatch "
+                f"hashes static args; use a tuple or None")
+    return issues
+
+
+@register(PASS, "print/logging/time/np.random/.item()/float() and "
+                "unhashable static args inside jitted functions")
+def run(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for fn, fname, jit_call in _jitted_functions(tree):
+            where = f"in jitted {fname}"
+            for msg in _static_param_issues(fn, jit_call):
+                out.append(Finding(PASS, rel, fn.lineno,
+                                   f"{where}: {msg}"))
+            params = set(_params(fn))
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = call_name(node) or ""
+                    root = name.split(".", 1)[0]
+                    msg = ""
+                    if name == "print":
+                        msg = ("print() traces to nothing (or a host "
+                               "sync); use jax.debug.print")
+                    elif (root in _LOGGERISH and "." in name):
+                        msg = (f"{name}() inside a traced function runs "
+                               "only at trace time; use jax.debug.print")
+                    elif root == "time" and "." in name:
+                        msg = (f"{name}() bakes a host clock read into "
+                               "the trace")
+                    elif name.startswith(("np.random.", "numpy.random.")):
+                        msg = (f"{name}() is host-side RNG — traces to a "
+                               "constant; use jax.random")
+                    elif name.endswith(".item") and not node.args:
+                        msg = (".item() forces a device→host sync inside "
+                               "the computation")
+                    elif name in ("float", "int", "bool") and node.args:
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Name) and arg.id in params:
+                            msg = (f"{name}() on traced argument "
+                                   f"{arg.id!r} forces a device→host "
+                                   "sync (ConcretizationError under jit)")
+                    if msg:
+                        out.append(Finding(PASS, rel, node.lineno,
+                                           f"{where}: {msg}"))
+    return out

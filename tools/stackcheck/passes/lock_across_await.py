@@ -1,0 +1,77 @@
+"""lock-across-await: a yield point reached while a synchronous lock is
+held.
+
+Inside ``async def``, a plain ``with <lock>:`` block that contains an
+``await`` (or ``async for`` / ``async with``) is the classic asyncio
+deadlock/starvation shape: a ``threading.Lock`` held across the yield
+blocks every other task that touches it (and self-deadlocks if the same
+task re-enters), while an ``asyncio.Lock`` used via sync ``with`` is a
+type error waiting to fire. The fix is ``async with asyncio.Lock`` — or
+restructuring so the critical section contains no awaits.
+
+Detection is name-heuristic (``*lock*``/``mutex`` context managers and
+inline ``threading.Lock()`` calls); the shared ``# stackcheck:
+disable=lock-across-await`` hatch covers false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.stackcheck.core import Context, Finding, register
+from tools.stackcheck.passes._astutil import (
+    async_functions,
+    dotted,
+    is_lockish,
+)
+
+PASS = "lock-across-await"
+
+
+def _yield_points(body: List[ast.stmt]) -> List[ast.AST]:
+    """Await / async-for / async-with nodes reachable in these statements
+    without crossing a function boundary."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            out.append(node)
+            continue  # one per region is enough; keep scanning siblings
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+@register(PASS, "await while a sync (threading) lock is held — asyncio "
+                "deadlock/starvation hazard")
+def run(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for fn in async_functions(tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                lock_items = [it for it in node.items
+                              if is_lockish(it.context_expr)]
+                if not lock_items:
+                    continue
+                ypoints = _yield_points(node.body)
+                if not ypoints:
+                    continue
+                # NB: keep the message line-free — it is the baseline key
+                lock_name = dotted(lock_items[0].context_expr) or "lock"
+                out.append(Finding(
+                    PASS, rel, node.lineno,
+                    f"in async def {fn.name}: sync 'with {lock_name}:' "
+                    f"holds the lock across an await; use 'async with "
+                    f"asyncio.Lock' or move awaits out of the critical "
+                    f"section"))
+    return out

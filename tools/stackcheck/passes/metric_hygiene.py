@@ -1,0 +1,175 @@
+"""metric-hygiene: metric name drift, label cardinality and duplicate
+registration.
+
+Absorbs ``tools/metrics_lint.py`` (now a thin shim over this pass) as the
+*drift* rules, and adds two AST rules over prometheus_client declarations:
+
+* drift — every ``vllm:``/``router:``/``kvserver:`` metric a Grafana
+  dashboard or docs/observability.md references must exist in code, and
+  every ``vllm:*`` metric defined in code must be documented (the docs are
+  the metrics reference). Exposition suffixes (``_total``/``_bucket``/
+  ``_sum``/``_count``/``_created``) normalize away first.
+* label cardinality — a label whose values are per-request identifiers
+  (request/trace/span/session ids) makes Prometheus mint one series per
+  request: unbounded cardinality that melts the TSDB. Ids belong in
+  traces and the flight recorder, never in labels.
+* duplicate registration — two constructors declaring the same metric
+  name against the default registry raise ``Duplicated timeseries`` at
+  import time in whichever process imports both modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.stackcheck.core import Context, Finding, register
+from tools.stackcheck.passes._astutil import call_name
+
+PASS = "metric-hygiene"
+
+# vllm:foo / router:foo / kvserver:foo — the stack's metric namespaces.
+# Guards against non-metric lookalikes: a leading [\w-] lookbehind skips
+# image tags ("tpu-serving-router:0.1.0"), the first-char [a-z] skips
+# ":0.1.0"-style versions, and requiring the name to end on [a-z0-9] with
+# no word char following rejects brace templates in docstrings while
+# still matching PromQL selectors.
+NAME_RE = re.compile(
+    r"(?<![\w-])(?:vllm|router|kvserver):[a-z][a-z0-9_]*[a-z0-9](?!\w)"
+)
+_SUFFIXES = ("_bucket", "_sum", "_count", "_created", "_total")
+
+_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+_ID_LABEL = re.compile(
+    r"(^|_)(request_?id|req_?id|trace_?id|span_?id|session_?id|"
+    r"correlation_?id|uuid|user_?id|id)$"
+)
+
+
+def normalize(name: str) -> str:
+    for suffix in _SUFFIXES:
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def code_metrics(ctx: Context) -> Set[str]:
+    """Metric names declared anywhere under production_stack_tpu/.
+
+    Declaration sites are plain string literals (prometheus_client
+    constructors and MetricFamily yields), so a namespace-pattern scan of
+    the source is the inventory — no import side effects needed."""
+    found: Set[str] = set()
+    for path in ctx.py_files("production_stack_tpu"):
+        found |= {normalize(m) for m in NAME_RE.findall(ctx.read(path))}
+    return found
+
+
+def dashboard_refs(ctx: Context) -> Dict[str, Set[str]]:
+    refs: Dict[str, Set[str]] = {}
+    for pattern in ("helm/dashboards/*.json", "observability/*.json"):
+        for path in ctx.glob(pattern):
+            names = {normalize(m) for m in NAME_RE.findall(ctx.read(path))}
+            refs[ctx.rel(path)] = names
+    return refs
+
+
+def doc_refs(ctx: Context) -> Set[str]:
+    doc = ctx.root / "docs" / "observability.md"
+    if not doc.exists():
+        return set()
+    return {normalize(m) for m in NAME_RE.findall(ctx.read(doc))}
+
+
+def _drift(ctx: Context) -> List[Finding]:
+    code = code_metrics(ctx)
+    out: List[Finding] = []
+    for source, names in dashboard_refs(ctx).items():
+        for name in sorted(names - code):
+            out.append(Finding(PASS, source, 0,
+                               f"references {name!r}, not defined in code"))
+    doc = ctx.root / "docs" / "observability.md"
+    if doc.exists():
+        documented = doc_refs(ctx)
+        rel = ctx.rel(doc)
+        for name in sorted(documented - code):
+            out.append(Finding(PASS, rel, 0,
+                               f"documents {name!r}, not defined in code"))
+        for name in sorted(n for n in code - documented
+                           if n.startswith("vllm:")):
+            out.append(Finding(PASS, rel, 0,
+                               f"missing {name!r} (defined in code)"))
+    return out
+
+
+def _declarations(ctx: Context):
+    """(path, lineno, metric_name, labels, has_registry_kwarg) for every
+    literal prometheus_client constructor under production_stack_tpu/."""
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = (call_name(node) or "").rsplit(".", 1)[-1]
+            is_family = ctor.endswith("MetricFamily")
+            if ctor not in _CONSTRUCTORS and not is_family:
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            labels: List[str] = []
+            label_node = None
+            if len(node.args) >= 3:
+                label_node = node.args[2]
+            for kw in node.keywords:
+                if kw.arg in ("labelnames", "labels"):
+                    label_node = kw.value
+            if label_node is not None:
+                for el in ast.walk(label_node):
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)):
+                        labels.append(el.value)
+            has_registry = any(kw.arg == "registry"
+                               for kw in node.keywords)
+            yield rel, node.lineno, name, labels, has_registry, is_family
+
+
+def _labels_and_duplicates(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    default_reg: Dict[str, Tuple[str, int]] = {}
+    for rel, lineno, name, labels, has_registry, is_family in \
+            _declarations(ctx):
+        for label in labels:
+            if _ID_LABEL.search(label):
+                out.append(Finding(
+                    PASS, rel, lineno,
+                    f"metric {name!r} label {label!r} looks per-request: "
+                    f"unbounded cardinality — put ids in traces/flight "
+                    f"records, not labels"))
+        if has_registry or is_family:
+            # custom registries are process-scoped; MetricFamily yields
+            # are collector output, not registrations
+            continue
+        key = normalize(name)
+        if key in default_reg:
+            # keep the message line-free: it is the baseline key
+            first_rel, _first_line = default_reg[key]
+            out.append(Finding(
+                PASS, rel, lineno,
+                f"metric {name!r} already registered on the default "
+                f"registry in {first_rel} — duplicate registration "
+                f"raises at import"))
+        else:
+            default_reg[key] = (rel, lineno)
+    return out
+
+
+@register(PASS, "metric drift (dashboards/docs/code), per-request labels, "
+                "duplicate registration")
+def run(ctx: Context) -> List[Finding]:
+    return _drift(ctx) + _labels_and_duplicates(ctx)
