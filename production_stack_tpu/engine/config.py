@@ -512,11 +512,27 @@ class SchedulerConfig:
 
 
 @dataclasses.dataclass
+class PerfConfig:
+    """Goodput accounting (engine/perf_accounting.py): live MFU / HBM
+    bandwidth estimates plus jit compile-event tracking."""
+    enabled: bool = True
+    # sliding window the utilization gauges are computed over, seconds
+    window: float = 60.0
+    # 0 = use the v5e rooflines from docs/roofline.md (197 TFLOP/s bf16,
+    # 819 GB/s HBM); set explicitly on other generations
+    peak_tflops: float = 0.0
+    peak_hbm_gbps: float = 0.0
+    # how often device.memory_stats() is sampled for the HBM gauges
+    hbm_poll_interval: float = 5.0
+
+
+@dataclasses.dataclass
 class EngineConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    perf: PerfConfig = dataclasses.field(default_factory=PerfConfig)
     seed: int = 0
     # multi-LoRA bank: slot 0 is the base model, adapters occupy 1..max-1
     max_loras: int = 4

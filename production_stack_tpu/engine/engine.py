@@ -177,6 +177,18 @@ class LLMEngine:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.aborted_seqs = 0  # cancelled/expired, KV freed early
+        # goodput accounting + compile tracking (perf_accounting.py); the
+        # staged PP runner exposes no single param tree or jit programs to
+        # wrap, so it only gets dispatch accounting
+        self.perf = None
+        if config.perf.enabled:
+            from production_stack_tpu.engine.perf_accounting import (
+                PerfAccountant,
+            )
+
+            self.perf = PerfAccountant.from_runner(config, self.runner)
+            if hasattr(self.runner, "install_compile_observer"):
+                self.runner.install_compile_observer(self.perf.on_compile)
 
     # -- request intake ------------------------------------------------------
     def add_request(
@@ -417,6 +429,9 @@ class LLMEngine:
             self._sp_ctx, self._sp_slots.reshape(-1),
             adapter_ids=self._sp_adapters if use_lora else None,
         )
+        if self.perf is not None:
+            self.perf.record_decode(len(decodes), 1,
+                                    int(self._sp_ctx.sum()))
         live, token_lists = [], []
         for seq, drafts in row_drafts:
             if seq.status.is_finished:
@@ -551,6 +566,8 @@ class LLMEngine:
                 if seq.token_ctrl is not None else None
             ),
         )
+        if self.perf is not None:
+            self.perf.record_prefill(n, n, 1)
         seq.num_computed_tokens = n
         seq.status = SequenceStatus.RUNNING
         self._slot_seq[seq.slot] = seq
@@ -646,6 +663,11 @@ class LLMEngine:
             g_ids=g_ids if use_grammar else None,
             fetch=False,
         )
+        if self.perf is not None:
+            self.perf.record_prefill(
+                sum(sp.chunk_len for sp in prefills),
+                int(context_lens.sum()), len(prefills),
+            )
 
         # scheduler-visible state advances NOW (the next step's scheduling
         # depends on it); the sampled tokens are fetched one step LATER so
@@ -787,6 +809,11 @@ class LLMEngine:
             fetch=not can_chain,
             want_logprobs=use_logprobs,
         )
+        if self.perf is not None:
+            self.perf.record_decode(
+                len(decodes), max(self.config.scheduler.multi_step, 1),
+                int(self._context_lens.sum()),
+            )
         if can_chain:
             sampled, next_tok = result
             # defer: speculative num_computed advance (the scheduler's
@@ -992,6 +1019,8 @@ class LLMEngine:
             out["cpu_cache_usage_perc"] = self.host_kv.usage
             out["cpu_prefix_cache_hits_total"] = self.host_kv.hits
             out["cpu_prefix_cache_queries_total"] = self.host_kv.queries
+        if self.perf is not None:
+            out["perf"] = self.perf.stats_fields()
         return out
 
     # -- sleep mode (frees HBM; reference semantics: engines release device
@@ -1081,6 +1110,10 @@ class LLMEngine:
         bound, sched_cfg.max_queue_len = sched_cfg.max_queue_len, 0
         try:
             self._warmup_impl()
+            if self.perf is not None:
+                # every serving variant is compiled now: later compiles are
+                # unexpected recompiles (an alertable bug signal)
+                self.perf.mark_steady()
         finally:
             sched_cfg.max_queue_len = bound
 

@@ -124,6 +124,59 @@ class EngineStatsCollector:
             "Free KV blocks (allocatable right now)",
             s.get("kv_blocks_free", 0),
         )
+        # goodput accounting (engine/perf_accounting.py): live roofline
+        # utilization, phase throughput, HBM occupancy, compile events
+        perf = s.get("perf")
+        if perf:
+            yield gauge(
+                "vllm:model_flops_utilization",
+                "Model FLOPs utilization over the accounting window "
+                "(goodput: live tokens only, padding waste excluded)",
+                perf["mfu"],
+            )
+            yield gauge(
+                "vllm:hbm_bandwidth_utilization",
+                "Estimated HBM bandwidth utilization over the window",
+                perf["hbm_bw_util"],
+            )
+            tps = GaugeMetricFamily(
+                "vllm:tokens_per_second",
+                "Live (unpadded) tokens per second by phase",
+                labels=["model_name", "phase"],
+            )
+            tps.add_metric([self.model_name, "prefill"],
+                           perf["prefill_tps"])
+            tps.add_metric([self.model_name, "decode"], perf["decode_tps"])
+            yield tps
+            yield gauge("vllm:hbm_bytes_used",
+                        "Device HBM bytes in use (memory_stats)",
+                        perf["hbm_bytes_used"])
+            yield gauge("vllm:hbm_bytes_total",
+                        "Device HBM bytes available (memory_stats limit)",
+                        perf["hbm_bytes_total"])
+            yield gauge("vllm:hbm_bytes_peak",
+                        "Peak device HBM bytes observed",
+                        perf["hbm_bytes_peak"])
+            compiles = CounterMetricFamily(
+                "vllm:compile_events",
+                "jit compile events per program kind and shape bucket",
+                labels=["model_name", "kind", "bucket"],
+            )
+            for (kind, bucket), n in sorted(perf["compile_counts"].items()):
+                compiles.add_metric([self.model_name, kind, bucket], n)
+            yield compiles
+            yield counter(
+                "vllm:compile_time_seconds",
+                "Cumulative wall seconds spent in jit compiles "
+                "(first-call time per new program signature)",
+                perf["compile_seconds_total"],
+            )
+            yield counter(
+                "vllm:unexpected_recompiles",
+                "Compiles observed after warmup marked the engine steady "
+                "— a shape leaked past warmup (bug signal)",
+                perf["unexpected_recompiles"],
+            )
 
 
 _BUCKETS_TTFT = (

@@ -181,6 +181,16 @@ class ModelRunner:
         self.grammar_bank = None
         self.grammar_accept = None
 
+    def install_compile_observer(self, observer) -> None:
+        """Proxy every jitted program through a compile tracker so the
+        perf accountant sees one event per (program, argument-signature)
+        — i.e. per XLA compile (engine/perf_accounting.py)."""
+        from production_stack_tpu.engine.perf_accounting import (
+            wrap_runner_programs,
+        )
+
+        wrap_runner_programs(self, observer)
+
     # -- sizing ------------------------------------------------------------
     def _prefill_temp_bytes(self) -> int:
         """Worst-case prefill transient, per attention backend.

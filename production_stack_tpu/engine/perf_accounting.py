@@ -1,0 +1,321 @@
+"""Continuous goodput accounting: live MFU / HBM-bandwidth estimates and
+jit compile-event tracking.
+
+docs/roofline.md derives the v5e ceilings (197 TFLOP/s bf16, 819 GB/s
+HBM) and works out per-dispatch FLOP and byte costs by hand; this module
+runs the same arithmetic on every dispatch so the numbers are permanent
+gauges instead of one-off measurements:
+
+* ``PerfAccountant`` — a sliding window of per-dispatch FLOP/byte/token
+  estimates (prefill and decode recorded separately by the engine's
+  ``_run_*`` paths), reduced to ``vllm:model_flops_utilization``,
+  ``vllm:hbm_bandwidth_utilization`` and
+  ``vllm:tokens_per_second{phase}`` at scrape time, plus periodic HBM
+  occupancy snapshots from ``device.memory_stats()``.
+* ``CompileTracker`` — wraps each jitted program; a never-seen argument
+  signature (shapes/dtypes + static kwargs) is exactly what makes XLA
+  compile a new executable, so the first call per signature is counted
+  as a compile event (its wall time approximates compile seconds). After
+  ``mark_steady()`` (warmup complete) any new signature also ticks the
+  unexpected-recompile counter the alert rules treat as a bug signal.
+
+Token counts are LIVE tokens, not padded — padding waste is supposed to
+show up as lost MFU; that is the goodput story.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+# docs/roofline.md ("Rooflines (v5e: 197 TFLOP/s bf16, 819 GB/s HBM)")
+V5E_PEAK_TFLOPS = 197.0
+V5E_PEAK_HBM_GBPS = 819.0
+
+_EVENT_TAIL = 64  # compile events kept verbatim for /debug/perf
+
+
+def estimate_param_count(model_cfg) -> int:
+    """Llama-geometry parameter count from config — the fallback when the
+    runner's param tree isn't addressable (staged pipeline runner)."""
+    h = model_cfg.hidden_size
+    inter = model_cfg.intermediate_size
+    qkv = (h * model_cfg.num_heads * model_cfg.head_dim
+           + 2 * h * model_cfg.num_kv_heads * model_cfg.head_dim
+           + model_cfg.num_heads * model_cfg.head_dim * h)
+    mlp = 3 * h * inter * max(getattr(model_cfg, "num_experts", 0) or 1, 1)
+    return int(2 * model_cfg.vocab_size * h
+               + model_cfg.num_layers * (qkv + mlp))
+
+
+def _dtype_bytes(dtype: str) -> int:
+    return 4 if "32" in str(dtype) else 2
+
+
+class CompileTracker:
+    """Wrap a jitted callable and surface compile events.
+
+    The signature key mirrors jax's compilation-cache key closely enough
+    for accounting: per-argument (shape, dtype) for arrays, literal
+    values for hashable statics, structural markers for pytrees. A new
+    key means XLA builds a new executable; the wall time of that first
+    call upper-bounds compile+first-run seconds (steady-state calls of a
+    seen signature are dispatch-only and are not timed)."""
+
+    def __init__(self, kind: str, fn: Callable, observer: Callable,
+                 bucket_argidx: int = 2):
+        self.kind = kind
+        self.fn = fn
+        self.observer = observer
+        self.bucket_argidx = bucket_argidx
+        self._seen: set = set()
+
+    def _sig(self, v):
+        shape = getattr(v, "shape", None)
+        if shape is not None:
+            return ("arr", tuple(shape), str(getattr(v, "dtype", "?")))
+        if isinstance(v, (bool, int, float, str, type(None))):
+            return v
+        if isinstance(v, (tuple, list)):
+            return ("seq", tuple(self._sig(x) for x in v))
+        if isinstance(v, dict):
+            return ("map", tuple(sorted(str(k) for k in v)))
+        return type(v).__name__
+
+    def _bucket(self, args) -> str:
+        if len(args) > self.bucket_argidx:
+            shape = getattr(args[self.bucket_argidx], "shape", None)
+            if shape:
+                return "x".join(str(int(d)) for d in shape)
+        return "-"
+
+    def __call__(self, *args, **kwargs):
+        key = (tuple(self._sig(a) for a in args),
+               tuple((k, self._sig(v)) for k, v in sorted(kwargs.items())))
+        if key in self._seen:
+            return self.fn(*args, **kwargs)
+        t0 = time.monotonic()
+        out = self.fn(*args, **kwargs)
+        self._seen.add(key)
+        self.observer(self.kind, self._bucket(args), time.monotonic() - t0)
+        return out
+
+
+def wrap_runner_programs(runner, observer: Callable) -> None:
+    """Install ``CompileTracker`` proxies over a runner's jitted programs
+    (the per-bucket prefill variants and every decode/verify variant)."""
+    for attr in ("_prefill", "_prefill_ring", "_decode", "_decode_multi",
+                 "_verify", "_sample"):
+        fn = getattr(runner, attr, None)
+        if fn is None or isinstance(fn, CompileTracker):
+            continue
+        setattr(runner, attr, CompileTracker(attr.lstrip("_"), fn, observer))
+
+
+class PerfAccountant:
+    """Sliding-window goodput accounting + compile-event bookkeeping.
+
+    Recording happens on the engine (device) thread; snapshots are read
+    from the HTTP handlers — a lock keeps the two honest."""
+
+    def __init__(self, model_cfg, *, param_count: int, param_bytes: int,
+                 window: float = 60.0, peak_tflops: float = 0.0,
+                 peak_hbm_gbps: float = 0.0, hbm_poll_interval: float = 5.0):
+        self.window = max(window, 1.0)
+        self.peak_flops = (peak_tflops or V5E_PEAK_TFLOPS) * 1e12
+        self.peak_hbm = (peak_hbm_gbps or V5E_PEAK_HBM_GBPS) * 1e9
+        self.param_count = max(int(param_count), 1)
+        self.param_bytes = max(int(param_bytes), 1)
+        self.hbm_poll_interval = hbm_poll_interval
+        cfg = model_cfg
+        self._attn_per_tok_ctx = (4 * cfg.num_layers * cfg.num_heads
+                                  * cfg.head_dim)
+        self._kv_bytes_per_tok = (2 * cfg.num_layers * cfg.num_kv_heads
+                                  * cfg.head_dim * _dtype_bytes(cfg.dtype))
+        self._lock = threading.Lock()
+        # (ts, phase, flops, hbm_bytes, live_tokens)
+        self._events: deque = deque()
+        self._totals = {"prefill_tokens": 0, "decode_tokens": 0,
+                        "flops": 0.0, "hbm_bytes": 0.0, "dispatches": 0}
+        # compile tracking
+        self._compile_counts: dict = {}
+        self._compile_events: deque = deque(maxlen=_EVENT_TAIL)
+        self._compile_seconds = 0.0
+        self._unexpected = 0
+        self._steady = False
+        # HBM occupancy (guarded memory_stats poll)
+        self._hbm = {"used": 0, "total": 0, "peak": 0}
+        self._hbm_ts = 0.0
+
+    @classmethod
+    def from_runner(cls, config, runner) -> "PerfAccountant":
+        param_count = param_bytes = 0
+        params = getattr(runner, "params", None)
+        if params is not None:
+            try:
+                import jax
+
+                leaves = jax.tree.leaves(params)
+                param_count = sum(int(x.size) for x in leaves)
+                param_bytes = sum(int(x.size) * x.dtype.itemsize
+                                  for x in leaves)
+            except Exception:
+                param_count = param_bytes = 0
+        if not param_count:
+            param_count = estimate_param_count(config.model)
+            param_bytes = param_count * _dtype_bytes(config.model.dtype)
+        perf = config.perf
+        return cls(config.model, param_count=param_count,
+                   param_bytes=param_bytes, window=perf.window,
+                   peak_tflops=perf.peak_tflops,
+                   peak_hbm_gbps=perf.peak_hbm_gbps,
+                   hbm_poll_interval=perf.hbm_poll_interval)
+
+    # -- compile events ------------------------------------------------------
+    def on_compile(self, kind: str, bucket: str, seconds: float) -> None:
+        with self._lock:
+            key = (kind, bucket)
+            self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
+            self._compile_seconds += seconds
+            unexpected = self._steady
+            if unexpected:
+                self._unexpected += 1
+            self._compile_events.append({
+                "kind": kind, "bucket": bucket,
+                "seconds": round(seconds, 4),
+                "unexpected": unexpected, "ts": time.time(),
+            })
+
+    def mark_steady(self) -> None:
+        """Warmup pre-compiled every serving variant: from here on a fresh
+        compile means a shape leaked past warmup — a bug signal."""
+        with self._lock:
+            self._steady = True
+
+    # -- dispatch accounting -------------------------------------------------
+    def record_prefill(self, live_tokens: int, ctx_tokens: int,
+                       rows: int, ts: Optional[float] = None) -> None:
+        """One prefill dispatch: ``live_tokens`` real prompt tokens over
+        ``rows`` chunks whose post-chunk context lengths sum to
+        ``ctx_tokens`` (docs/roofline.md prefill costing)."""
+        ctx_mean = ctx_tokens / max(rows, 1)
+        flops = (2.0 * self.param_count * live_tokens
+                 + self._attn_per_tok_ctx * live_tokens * ctx_mean)
+        hbm = (self.param_bytes
+               + (live_tokens + ctx_tokens) * self._kv_bytes_per_tok)
+        self._record(ts, "prefill", flops, hbm, live_tokens)
+
+    def record_decode(self, live_seqs: int, steps: int, ctx_tokens: int,
+                      ts: Optional[float] = None) -> None:
+        """One fused decode dispatch: ``steps`` iterations over
+        ``live_seqs`` sequences with ``ctx_tokens`` total context. Decode
+        re-reads the weights every step — the weight-bandwidth-bound
+        regime of docs/roofline.md."""
+        tokens = live_seqs * steps
+        flops = (2.0 * self.param_count * tokens
+                 + self._attn_per_tok_ctx * ctx_tokens * steps)
+        hbm = steps * (self.param_bytes
+                       + (ctx_tokens + live_seqs) * self._kv_bytes_per_tok)
+        self._record(ts, "decode", flops, hbm, tokens)
+
+    def _record(self, ts, phase, flops, hbm_bytes, tokens) -> None:
+        now = ts if ts is not None else time.monotonic()
+        with self._lock:
+            self._events.append((now, phase, flops, hbm_bytes, tokens))
+            self._totals[f"{phase}_tokens"] += tokens
+            self._totals["flops"] += flops
+            self._totals["hbm_bytes"] += hbm_bytes
+            self._totals["dispatches"] += 1
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        while self._events and self._events[0][0] < now - self.window:
+            self._events.popleft()
+
+    # -- HBM occupancy -------------------------------------------------------
+    def poll_hbm(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.monotonic()
+        if now - self._hbm_ts < self.hbm_poll_interval and self._hbm_ts:
+            return
+        self._hbm_ts = now
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            used = int(stats.get("bytes_in_use", 0))
+            total = int(stats.get("bytes_limit", 0))
+        except Exception:
+            # no memory stats (CPU backend / tunneled TPU): gauges stay 0
+            return
+        with self._lock:
+            self._hbm["used"] = used
+            self._hbm["total"] = total
+            self._hbm["peak"] = max(self._hbm["peak"],
+                                    int(stats.get("peak_bytes_in_use", used)))
+
+    # -- reductions ----------------------------------------------------------
+    def _window_rates(self, now: float) -> dict:
+        self._trim(now)
+        if not self._events:
+            return {"mfu": 0.0, "hbm_bw_util": 0.0,
+                    "prefill_tps": 0.0, "decode_tps": 0.0}
+        span = max(now - self._events[0][0], 1e-3)
+        flops = sum(e[2] for e in self._events)
+        hbm = sum(e[3] for e in self._events)
+        ptok = sum(e[4] for e in self._events if e[1] == "prefill")
+        dtok = sum(e[4] for e in self._events if e[1] == "decode")
+        return {
+            "mfu": flops / (span * self.peak_flops),
+            "hbm_bw_util": hbm / (span * self.peak_hbm),
+            "prefill_tps": ptok / span,
+            "decode_tps": dtok / span,
+        }
+
+    def stats_fields(self) -> dict:
+        """Flat fields merged into ``LLMEngine.stats()`` for the metrics
+        collector (engine/metrics.py reads this at scrape time)."""
+        self.poll_hbm()
+        now = time.monotonic()
+        with self._lock:
+            rates = self._window_rates(now)
+            return {
+                **rates,
+                "hbm_bytes_used": self._hbm["used"],
+                "hbm_bytes_total": self._hbm["total"],
+                "hbm_bytes_peak": self._hbm["peak"],
+                "compile_counts": dict(self._compile_counts),
+                "compile_seconds_total": self._compile_seconds,
+                "unexpected_recompiles": self._unexpected,
+            }
+
+    def snapshot(self) -> dict:
+        """JSON document for ``GET /debug/perf``."""
+        self.poll_hbm()
+        now = time.monotonic()
+        with self._lock:
+            rates = self._window_rates(now)
+            return {
+                "enabled": True,
+                "window_seconds": self.window,
+                "peaks": {"flops": self.peak_flops,
+                          "hbm_bytes_per_s": self.peak_hbm},
+                "model": {"param_count": self.param_count,
+                          "param_bytes": self.param_bytes},
+                "model_flops_utilization": rates["mfu"],
+                "hbm_bandwidth_utilization": rates["hbm_bw_util"],
+                "tokens_per_second": {"prefill": rates["prefill_tps"],
+                                      "decode": rates["decode_tps"]},
+                "hbm_bytes": dict(self._hbm),
+                "totals": dict(self._totals),
+                "compile": {
+                    "steady": self._steady,
+                    "total_events": sum(self._compile_counts.values()),
+                    "total_seconds": round(self._compile_seconds, 4),
+                    "unexpected_recompiles": self._unexpected,
+                    "counts": {f"{k}:{b}": n for (k, b), n
+                               in sorted(self._compile_counts.items())},
+                    "recent": list(self._compile_events),
+                },
+            }

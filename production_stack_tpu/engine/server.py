@@ -275,6 +275,7 @@ class EngineServer:
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_post("/debug/profile", self.profile)
         app.router.add_get("/debug/memory", self.memory_profile)
+        app.router.add_get("/debug/perf", self.debug_perf)
         app.router.add_get("/debug/requests", self.debug_requests)
         if self._faults_armed:
             app.router.add_post("/debug/faults", self.debug_faults)
@@ -1160,6 +1161,16 @@ class EngineServer:
                     pass
             self._profiling = False
             shutil.rmtree(tmp, ignore_errors=True)
+
+    async def debug_perf(self, request: web.Request) -> web.Response:
+        """Goodput-accounting snapshot (engine/perf_accounting.py): live
+        MFU / HBM-bandwidth utilization, phase throughput, HBM occupancy,
+        and the compile-event log — the always-on counterpart to the
+        profiler endpoints above."""
+        perf = getattr(self.engine, "perf", None)
+        if perf is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(perf.snapshot())
 
     async def memory_profile(self, request: web.Request) -> web.Response:
         """Device memory profile (pprof proto) — what holds HBM right now."""
@@ -2116,6 +2127,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "ring buffer")
     p.add_argument("--skip-warmup", action="store_true",
                    help="skip startup compilation of all shape variants")
+    p.add_argument("--no-perf-accounting", dest="perf_accounting",
+                   action="store_false", default=True,
+                   help="disable live goodput accounting (MFU / HBM "
+                        "bandwidth gauges, compile-event tracking, "
+                        "GET /debug/perf — engine/perf_accounting.py)")
+    p.add_argument("--perf-window", type=float, default=60.0,
+                   help="sliding window (seconds) the utilization gauges "
+                        "are computed over")
+    p.add_argument("--perf-peak-tflops", type=float, default=0.0,
+                   help="accelerator peak TFLOP/s for MFU; 0 = the v5e "
+                        "bf16 roofline from docs/roofline.md (197)")
+    p.add_argument("--perf-peak-hbm-gbps", type=float, default=0.0,
+                   help="accelerator peak HBM GB/s; 0 = v5e (819)")
     p.add_argument("--platform", default=None,
                    help="force the JAX platform (e.g. 'cpu' for a "
                         "no-TPU dev/CI engine; env PSTPU_PLATFORM). Must be "
@@ -2197,6 +2221,13 @@ def config_from_args(args) -> EngineConfig:
     )
     if args.sequence_parallel_size > 1:
         cfg.scheduler.ring_prefill_threshold = args.ring_prefill_threshold
+    cfg.perf.enabled = getattr(args, "perf_accounting", True)
+    if getattr(args, "perf_window", None):
+        cfg.perf.window = args.perf_window
+    if getattr(args, "perf_peak_tflops", 0.0):
+        cfg.perf.peak_tflops = args.perf_peak_tflops
+    if getattr(args, "perf_peak_hbm_gbps", 0.0):
+        cfg.perf.peak_hbm_gbps = args.perf_peak_hbm_gbps
     cfg.seed = args.seed
     return cfg
 

@@ -142,6 +142,22 @@ def build_parser() -> argparse.ArgumentParser:
     # stats
     p.add_argument("--engine-stats-interval", type=float, default=10.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
+    # SLO engine (router/slo.py): objectives turn on burn-rate tracking
+    p.add_argument("--slo-ttft-p95", type=float, default=0.0,
+                   help="fleet TTFT p95 objective in seconds (0 = off); "
+                        "exported as vllm:slo_burn_rate{slo=\"ttft_p95\"}")
+    p.add_argument("--slo-itl-p95", type=float, default=0.0,
+                   help="fleet inter-token latency p95 objective in "
+                        "seconds (0 = off)")
+    p.add_argument("--slo-availability", type=float, default=0.0,
+                   help="fleet availability objective, e.g. 0.999 "
+                        "(0 = off); an attempt with no first byte is bad")
+    p.add_argument("--slo-tail-budget", type=float, default=0.05,
+                   help="error budget for the latency p95 objectives "
+                        "(fraction of samples allowed over target)")
+    p.add_argument("--slo-config", default=None,
+                   help="JSON object of per-model objective overrides, "
+                        'e.g. {"llama-3-8b": {"ttft_p95": 0.5}}')
     p.add_argument("--log-stats", action="store_true")
     p.add_argument("--log-stats-interval", type=float, default=30.0)
     # misc
@@ -295,6 +311,13 @@ class RouterApp:
 
         initialize_engine_stats_scraper(args.engine_stats_interval)
         initialize_request_stats_monitor(args.request_stats_window)
+
+        from production_stack_tpu.router.slo import (
+            SLOConfig,
+            initialize_slo_tracker,
+        )
+
+        initialize_slo_tracker(SLOConfig.from_args(args))
 
         from production_stack_tpu.router.resilience import (
             ResilienceConfig,
@@ -455,6 +478,7 @@ class RouterApp:
         app.router.add_get("/engines", self.engines)
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_get("/debug/requests", self.debug_requests)
+        app.router.add_get("/debug/slo", self.debug_slo)
         async def _sleep(r):
             return await self.request_service.sleep_wake(r, "sleep")
 
@@ -645,6 +669,16 @@ class RouterApp:
                     out["engines"][ep.url] = {"error": str(e)}
         return web.json_response(out)
 
+    async def debug_slo(self, request: web.Request) -> web.Response:
+        """SLO engine snapshot (router/slo.py): configured objectives and
+        every active burn-rate series with page/warn flags."""
+        from production_stack_tpu.router.slo import current_slo_tracker
+
+        tracker = current_slo_tracker()
+        if tracker is None:
+            return web.json_response({"enabled": False})
+        return web.json_response({"enabled": True, **tracker.snapshot()})
+
     # -- files / batches -------------------------------------------------------
     async def upload_file(self, request: web.Request) -> web.Response:
         from production_stack_tpu.router.services.files_service import get_storage
@@ -740,6 +774,9 @@ class RouterApp:
         m.healthy_pods_total.labels(server="router").set(
             len(get_service_discovery().get_endpoint_info())
         )
+        from production_stack_tpu.router.slo import current_slo_tracker
+
+        m.refresh_slo_gauges(current_slo_tracker())
         m.refresh_self_metrics()
         return web.Response(body=generate_latest(), content_type="text/plain")
 

@@ -87,6 +87,17 @@ retry_budget_remaining = Gauge(
 hedged_requests_total = Counter(
     "vllm:hedged_requests", "Hedged (speculative second) attempts fired"
 )
+# SLO engine (router/slo.py): multi-window burn rates per objective
+slo_burn_rate = Gauge(
+    "vllm:slo_burn_rate",
+    "Error-budget burn rate (bad fraction / budget) over a sliding window",
+    ["model", "slo", "window"],
+)
+slo_error_budget_remaining = Gauge(
+    "vllm:slo_error_budget_remaining",
+    "Fraction of the 6h error budget unspent (negative = blown)",
+    ["model", "slo"],
+)
 # router self-metrics (reference: routers/metrics_router.py:43-57)
 router_cpu_percent = Gauge("router:cpu_usage_perc", "Router CPU usage percent")
 router_mem_percent = Gauge("router:memory_usage_perc", "Router memory usage percent")
@@ -140,6 +151,17 @@ def refresh_label_gauges(engine_stats: dict, request_stats: dict) -> None:
                     g.remove(url)
                 except KeyError:
                     pass
+
+
+def refresh_slo_gauges(tracker) -> None:
+    """Export the SLO tracker's burn-rate series; no-op when no
+    objectives are configured (tracker is None)."""
+    if tracker is None:
+        return
+    for model, slo, rates, remaining in tracker.gauge_rows():
+        for window, rate in rates.items():
+            slo_burn_rate.labels(model=model, slo=slo, window=window).set(rate)
+        slo_error_budget_remaining.labels(model=model, slo=slo).set(remaining)
 
 
 def refresh_self_metrics() -> None:

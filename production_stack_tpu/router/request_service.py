@@ -534,7 +534,7 @@ class RequestService:
             if not so.get("include_usage"):
                 body = {**body, "stream_options": {**so, "include_usage": True}}
                 strip_usage = True
-        monitor.on_new_request(url, request_id, time.time())
+        monitor.on_new_request(url, request_id, time.time(), model=model)
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
         if deadline is not None:
@@ -704,7 +704,7 @@ class RequestService:
         headers["x-request-id"] = request_id
         if deadline is not None:
             headers["x-request-deadline"] = f"{deadline:.3f}"
-        monitor.on_new_request(url, request_id, time.time())
+        monitor.on_new_request(url, request_id, time.time(), model=model)
         try:
             backend = await self.session.post(
                 f"{url}{endpoint_path}", json=body, headers=headers
@@ -809,7 +809,8 @@ class RequestService:
         )
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
-        monitor.on_new_request(prefill_url, request_id, time.time())
+        monitor.on_new_request(prefill_url, request_id, time.time(),
+                               model=body.get("model", ""))
         try:
             async with self.session.post(
                 f"{prefill_url}{endpoint_path}", json=prefill_body, headers=headers

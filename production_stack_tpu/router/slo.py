@@ -1,0 +1,238 @@
+"""Fleet SLO engine: per-model objectives + multi-window burn-rate
+tracking (the SRE-workbook alerting scheme).
+
+An objective turns each observation into good/bad: a TTFT or inter-token
+sample is *bad* when it exceeds the target (the objective is "p95 under
+X", so the error budget is the tail fraction — default 5%); an attempt
+is *bad* for availability when the backend never produced a first byte.
+
+Burn rate over a window = (bad fraction in the window) / (error budget).
+Burn 1.0 spends the budget exactly over the SLO period; the workbook
+thresholds page on fast burn (5m AND 1h above 14.4) and warn on slow
+burn (30m AND 6h above 3). Requiring both windows makes pages fire fast
+on real incidents yet reset quickly once the bleeding stops.
+
+Observations land in 10-second bins bounded to the 6h horizon, so the
+tracker is O(2160) per series and needs no external storage. Exported as
+``vllm:slo_burn_rate{model,slo,window}`` and
+``vllm:slo_error_budget_remaining{model,slo}`` (router/metrics.py), with
+``GET /debug/slo`` serving the full snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+WINDOWS: Dict[str, float] = {
+    "5m": 300.0, "30m": 1800.0, "1h": 3600.0, "6h": 21600.0,
+}
+FAST_PAIR: Tuple[str, str] = ("5m", "1h")
+SLOW_PAIR: Tuple[str, str] = ("30m", "6h")
+# SRE-workbook multi-window thresholds; observability/alert-rules.yaml
+# must use these same numbers (tests evaluate the rule offline)
+PAGE_BURN = 14.4
+WARN_BURN = 3.0
+BIN_SECONDS = 10.0
+_HORIZON = WINDOWS["6h"]
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Fleet-wide objectives, optionally overridden per model.
+
+    A target of 0 disables that objective. ``per_model`` maps model name
+    to a dict of the same keys (from ``--slo-config`` JSON)."""
+
+    ttft_p95: float = 0.0
+    itl_p95: float = 0.0
+    availability: float = 0.0
+    # error budget for latency objectives: "p95 under target" tolerates
+    # this fraction of slow samples
+    tail_budget: float = 0.05
+    per_model: Dict[str, dict] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_args(args) -> Optional["SLOConfig"]:
+        per_model = {}
+        raw = getattr(args, "slo_config", None)
+        if raw:
+            per_model = json.loads(raw)
+        cfg = SLOConfig(
+            ttft_p95=getattr(args, "slo_ttft_p95", 0.0) or 0.0,
+            itl_p95=getattr(args, "slo_itl_p95", 0.0) or 0.0,
+            availability=getattr(args, "slo_availability", 0.0) or 0.0,
+            tail_budget=getattr(args, "slo_tail_budget", 0.05) or 0.05,
+            per_model=per_model,
+        )
+        if (cfg.ttft_p95 or cfg.itl_p95 or cfg.availability
+                or cfg.per_model):
+            return cfg
+        return None
+
+    def objectives(self, model: str) -> Dict[str, Tuple[float, float]]:
+        """{slo name: (threshold, error budget)} active for ``model``."""
+        over = self.per_model.get(model, {})
+        tail = float(over.get("tail_budget", self.tail_budget))
+        out: Dict[str, Tuple[float, float]] = {}
+        ttft = float(over.get("ttft_p95", self.ttft_p95))
+        if ttft > 0:
+            out["ttft_p95"] = (ttft, tail)
+        itl = float(over.get("itl_p95", self.itl_p95))
+        if itl > 0:
+            out["itl_p95"] = (itl, tail)
+        avail = float(over.get("availability", self.availability))
+        if avail > 0:
+            out["availability"] = (avail, max(1.0 - avail, 1e-9))
+        return out
+
+
+class _BinSeries:
+    """Good/bad observation counts in BIN_SECONDS bins over the 6h
+    horizon (deque of [bin_start, good, bad], oldest first)."""
+
+    def __init__(self):
+        self.bins: deque = deque()
+
+    def add(self, ok: bool, ts: float) -> None:
+        start = ts - ts % BIN_SECONDS
+        if not self.bins or self.bins[-1][0] < start:
+            self.bins.append([start, 0, 0])
+            while self.bins and self.bins[0][0] < start - _HORIZON:
+                self.bins.popleft()
+        # out-of-order stamps land in the newest bin — close enough for
+        # 10s-granularity accounting
+        row = self.bins[-1]
+        if ok:
+            row[1] += 1
+        else:
+            row[2] += 1
+
+    def bad_fraction(self, window: float, now: float) -> float:
+        good = bad = 0
+        cutoff = now - window
+        for start, g, b in reversed(self.bins):
+            if start + BIN_SECONDS <= cutoff:
+                break
+            good += g
+            bad += b
+        total = good + bad
+        return bad / total if total else 0.0
+
+
+class SLOTracker:
+    """Per-(model, slo) burn-rate series. Thread-compatible with the
+    router's single event loop — no locking needed."""
+
+    def __init__(self, config: SLOConfig):
+        self.config = config
+        # {(model, slo): _BinSeries}
+        self._series: Dict[Tuple[str, str], _BinSeries] = {}
+
+    # -- ingest --------------------------------------------------------------
+    def _observe(self, model: str, slo: str, ok: bool,
+                 ts: Optional[float]) -> None:
+        if slo not in self.config.objectives(model):
+            return
+        key = (model, slo)
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = _BinSeries()
+        series.add(ok, ts if ts is not None else time.time())
+
+    def record_ttft(self, model: str, seconds: float,
+                    ts: Optional[float] = None) -> None:
+        obj = self.config.objectives(model).get("ttft_p95")
+        if obj:
+            self._observe(model, "ttft_p95", seconds <= obj[0], ts)
+
+    def record_itl(self, model: str, seconds: float,
+                   ts: Optional[float] = None) -> None:
+        obj = self.config.objectives(model).get("itl_p95")
+        if obj:
+            self._observe(model, "itl_p95", seconds <= obj[0], ts)
+
+    def record_attempt(self, model: str, ok: bool,
+                       ts: Optional[float] = None) -> None:
+        self._observe(model, "availability", ok, ts)
+
+    # -- reductions ----------------------------------------------------------
+    def burn_rates(self, model: str, slo: str,
+                   now: Optional[float] = None) -> Dict[str, float]:
+        now = now if now is not None else time.time()
+        series = self._series.get((model, slo))
+        budget = self.config.objectives(model).get(slo, (0.0, 1.0))[1]
+        if series is None:
+            return {w: 0.0 for w in WINDOWS}
+        return {w: series.bad_fraction(span, now) / budget
+                for w, span in WINDOWS.items()}
+
+    def error_budget_remaining(self, model: str, slo: str,
+                               now: Optional[float] = None) -> float:
+        """Fraction of the 6h window's error budget still unspent (can go
+        negative once the budget is blown)."""
+        now = now if now is not None else time.time()
+        series = self._series.get((model, slo))
+        budget = self.config.objectives(model).get(slo, (0.0, 1.0))[1]
+        if series is None:
+            return 1.0
+        return 1.0 - series.bad_fraction(WINDOWS["6h"], now) / budget
+
+    def _flags(self, rates: Dict[str, float]) -> Dict[str, bool]:
+        return {
+            "page": all(rates[w] > PAGE_BURN for w in FAST_PAIR),
+            "warn": all(rates[w] > WARN_BURN for w in SLOW_PAIR),
+        }
+
+    def gauge_rows(self, now: Optional[float] = None):
+        """(model, slo, burn-rate-by-window, budget-remaining) per active
+        series — the shape router/metrics.py exports."""
+        now = now if now is not None else time.time()
+        for model, slo in sorted(self._series):
+            yield (model, slo, self.burn_rates(model, slo, now),
+                   self.error_budget_remaining(model, slo, now))
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """JSON document for ``GET /debug/slo``."""
+        now = now if now is not None else time.time()
+        series = []
+        for model, slo, rates, remaining in self.gauge_rows(now):
+            threshold, budget = self.config.objectives(model)[slo]
+            series.append({
+                "model": model, "slo": slo,
+                "objective": threshold, "error_budget": budget,
+                "burn_rate": {w: round(r, 4) for w, r in rates.items()},
+                "error_budget_remaining": round(remaining, 4),
+                **self._flags(rates),
+            })
+        return {
+            "config": {
+                "ttft_p95": self.config.ttft_p95,
+                "itl_p95": self.config.itl_p95,
+                "availability": self.config.availability,
+                "tail_budget": self.config.tail_budget,
+                "per_model": self.config.per_model,
+            },
+            "thresholds": {"page_burn": PAGE_BURN, "warn_burn": WARN_BURN,
+                           "fast_windows": list(FAST_PAIR),
+                           "slow_windows": list(SLOW_PAIR)},
+            "series": series,
+        }
+
+
+_tracker: Optional[SLOTracker] = None
+
+
+def initialize_slo_tracker(config: Optional[SLOConfig]) -> Optional[SLOTracker]:
+    global _tracker
+    _tracker = SLOTracker(config) if config is not None else None
+    return _tracker
+
+
+def current_slo_tracker() -> Optional[SLOTracker]:
+    """None when no objectives are configured — callers degrade to a
+    no-op (the stats monitor feeds this opportunistically)."""
+    return _tracker

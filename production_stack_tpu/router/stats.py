@@ -11,6 +11,7 @@
 from __future__ import annotations
 
 import asyncio
+import math
 import time
 from collections import deque
 from typing import Optional
@@ -58,7 +59,10 @@ class MovingAverageMonitor:
         if not self.values:
             return -1.0
         data = sorted(self.values)
-        return data[min(len(data) - 1, int(q * len(data)))]
+        # nearest-rank: rank ceil(q*n) is 1-based; int(q*n) overshoots by
+        # one for every q*n that isn't integral (p95 of any window <= 20
+        # samples returned the MAX, inflating the hedge delay)
+        return data[max(0, math.ceil(q * len(data)) - 1)]
 
 
 class EngineStatsScraper:
@@ -71,11 +75,21 @@ class EngineStatsScraper:
         return dict(self.engine_stats)
 
     async def start(self) -> None:
+        if self._task is not None and not self._task.done():
+            return  # idempotent: a second start must not leak a worker
         self._task = asyncio.create_task(self._worker())
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
+        task, self._task = self._task, None
+        if task is None or task.done():
+            return
+        task.cancel()
+        try:
+            # cancel() before the task ever ran only flags it; await lets
+            # the cancellation land so no pending worker outlives stop()
+            await task
+        except asyncio.CancelledError:
+            pass
 
     def get_health(self) -> bool:
         return self._task is not None and not self._task.done()
@@ -130,7 +144,16 @@ class RequestStatsMonitor:
         self.swapped: dict[str, int] = {}
         self.request_start: dict[tuple[str, str], float] = {}
         self.first_token: dict[tuple[str, str], float] = {}
+        # model per in-flight attempt, so the SLO tracker can attribute
+        # TTFT/ITL/availability observations per model objective
+        self.request_model: dict[tuple[str, str], str] = {}
         self.first_query_time: Optional[float] = None
+
+    @staticmethod
+    def _slo_tracker():
+        from production_stack_tpu.router.slo import current_slo_tracker
+
+        return current_slo_tracker()
 
     def _mon(self, table: dict, url: str) -> MovingAverageMonitor:
         if url not in table:
@@ -138,10 +161,13 @@ class RequestStatsMonitor:
         return table[url]
 
     # -- lifecycle hooks (called by the request service) ---------------------
-    def on_new_request(self, url: str, request_id: str, ts: float) -> None:
+    def on_new_request(self, url: str, request_id: str, ts: float,
+                       model: str = "") -> None:
         if self.first_query_time is None:
             self.first_query_time = ts
         self.request_start[(url, request_id)] = ts
+        if model:
+            self.request_model[(url, request_id)] = model
         self.in_prefill[url] = self.in_prefill.get(url, 0) + 1
         self._mon(self.qps, url).update(ts, 1.0)
 
@@ -151,6 +177,10 @@ class RequestStatsMonitor:
             return
         self.first_token[(url, request_id)] = ts
         self._mon(self.ttft, url).update(ts, ts - start)
+        tracker = self._slo_tracker()
+        if tracker is not None:
+            model = self.request_model.get((url, request_id), "")
+            tracker.record_ttft(model, ts - start, ts)
         self.in_prefill[url] = max(self.in_prefill.get(url, 1) - 1, 0)
         self.in_decoding[url] = self.in_decoding.get(url, 0) + 1
 
@@ -159,12 +189,14 @@ class RequestStatsMonitor:
         key = (url, request_id)
         start = self.request_start.pop(key, None)
         first = self.first_token.pop(key, None)
+        model = self.request_model.pop(key, "")
         if start is not None:
             self._mon(self.latency, url).update(ts, ts - start)
         if first is not None and num_output_tokens > 1:
-            self._mon(self.itl, url).update(
-                ts, (ts - first) / (num_output_tokens - 1)
-            )
+            itl = (ts - first) / (num_output_tokens - 1)
+            self._mon(self.itl, url).update(ts, itl)
+        else:
+            itl = None
         if num_output_tokens:
             self._mon(self.decoding_length, url).update(ts, num_output_tokens)
         if first is not None:
@@ -172,6 +204,13 @@ class RequestStatsMonitor:
         else:
             self.in_prefill[url] = max(self.in_prefill.get(url, 1) - 1, 0)
         self.finished[url] = self.finished.get(url, 0) + 1
+        tracker = self._slo_tracker()
+        if tracker is not None and start is not None:
+            if itl is not None:
+                tracker.record_itl(model, itl, ts)
+            # availability: an attempt that never produced a first byte
+            # counts against the budget
+            tracker.record_attempt(model, first is not None, ts)
 
     def on_request_swapped(self, url: str, request_id: str, ts: float) -> None:
         self.swapped[url] = self.swapped.get(url, 0) + 1

@@ -719,3 +719,108 @@ def test_request_lifecycle_dashboard():
     with open(os.path.join(repo_root, "observability",
                            "request-lifecycle-dashboard.json")) as f:
         assert json.load(f) == dash
+
+
+def test_perf_slo_values_render_flags():
+    """routerSpec.slo.* and engineConfig perf* keys map onto the SLO and
+    goodput-accounting CLI flags (docs/observability.md "Goodput & SLO")."""
+    objs = render_objects(HELM, {
+        "routerSpec": {"slo": {
+            "ttftP95": 1.5, "itlP95": 0.2, "availability": 0.995,
+            "tailBudget": 0.02, "config": '{"big": {"ttft_p95": 3}}',
+        }},
+        "servingEngineSpec": {"modelSpec": [{
+            "name": "perf", "modelRef": "llama-3-8b",
+            "engineConfig": {
+                "maxModelLen": 2048, "maxNumSeqs": 8, "dtype": "bfloat16",
+                "tensorParallelSize": 1,
+                "perfAccounting": False, "perfAccountingWindow": 120,
+                "perfPeakTflops": 275, "perfPeakHbmGbps": 1200,
+            },
+        }]},
+    })
+    args = router_args(objs)
+    for flag, value in (("--slo-ttft-p95", "1.5"),
+                        ("--slo-itl-p95", "0.2"),
+                        ("--slo-availability", "0.995"),
+                        ("--slo-tail-budget", "0.02"),
+                        ("--slo-config", '{"big": {"ttft_p95": 3}}')):
+        assert flag in args, f"router missing {flag}"
+        assert args[args.index(flag) + 1] == value
+    eargs = container_args(engine_deployments(objs)[0])
+    assert "--no-perf-accounting" in eargs
+    for flag, value in (("--perf-window", "120"),
+                        ("--perf-peak-tflops", "275"),
+                        ("--perf-peak-hbm-gbps", "1200")):
+        assert eargs[eargs.index(flag) + 1] == value
+
+    # defaults: objectives of 0 render no SLO flags (tracker off) and
+    # accounting stays on with the v5e rooflines (no peak overrides)
+    objs = render_objects(HELM)
+    args = router_args(objs)
+    for flag in ("--slo-ttft-p95", "--slo-itl-p95", "--slo-availability",
+                 "--slo-config"):
+        assert flag not in args
+    eargs = container_args(engine_deployments(objs)[0])
+    assert "--no-perf-accounting" not in eargs
+    assert eargs[eargs.index("--perf-window") + 1] == "60"
+    assert "--perf-peak-tflops" not in eargs
+    assert "--perf-peak-hbm-gbps" not in eargs
+
+
+def test_alert_rules_configmap_renders():
+    """monitoring.alertRules.enabled ships observability/alert-rules.yaml
+    as a ConfigMap for the Prometheus sidecar; off by default."""
+    assert not named(render_objects(HELM), "-alert-rules")
+
+    objs = render_objects(HELM, {"monitoring": {"alertRules":
+                                                {"enabled": True}}})
+    (cm,) = named(by_kind(objs, "ConfigMap"), "-alert-rules")
+    assert cm["metadata"]["labels"]["release"] == "kube-prometheus-stack"
+    rules = yaml.safe_load(cm["data"]["alert-rules.yaml"])
+    groups = {g["name"]: g for g in rules["groups"]}
+    assert {"tpu-stack-recording", "tpu-stack-slo",
+            "tpu-stack-engine", "tpu-stack-router"} <= set(groups)
+    alerts = [r["alert"] for g in rules["groups"]
+              for r in g["rules"] if "alert" in r]
+    for alert in ("SLOFastBurnPage", "SLOSlowBurnWarn", "RecompileStorm",
+                  "HBMPressure", "CircuitBreakerOpen"):
+        assert alert in alerts, f"missing alert rule {alert}"
+    # the chart-local copy the ConfigMap globs stays in sync with the
+    # canonical observability/ file
+    repo_root = os.path.dirname(HELM)
+    with open(os.path.join(repo_root, "observability",
+                           "alert-rules.yaml")) as f:
+        assert yaml.safe_load(f) == rules
+
+
+def test_perf_slo_dashboard():
+    """The performance & SLO dashboard covers the goodput gauges and the
+    burn-rate series with a distinct uid and non-empty panel targets."""
+    with open(os.path.join(HELM, "dashboards",
+                           "perf-slo-dashboard.json")) as f:
+        dash = json.load(f)
+    text = json.dumps(dash)
+    for metric in (
+        # goodput (engine) row
+        "vllm:model_flops_utilization",
+        "vllm:hbm_bandwidth_utilization",
+        "vllm:tokens_per_second",
+        "vllm:hbm_bytes_used",
+        "vllm:hbm_bytes_total",
+        "vllm:compile_events_total",
+        "vllm:compile_time_seconds_total",
+        "vllm:unexpected_recompiles_total",
+        # SLO (router) row
+        "vllm:slo_burn_rate",
+        "vllm:slo_error_budget_remaining",
+        "vllm:time_to_first_token_seconds_bucket",
+        "vllm:inter_token_latency_seconds_bucket",
+    ):
+        assert metric in text, f"perf-slo dashboard missing {metric}"
+    assert dash["uid"] == "tpu-perf-slo"
+    assert all(p["targets"] for p in dash["panels"])
+    repo_root = os.path.dirname(HELM)
+    with open(os.path.join(repo_root, "observability",
+                           "perf-slo-dashboard.json")) as f:
+        assert json.load(f) == dash

@@ -120,8 +120,11 @@ def test_config_drift():
 def test_metric_hygiene():
     r = fixture_report(only="metric-hygiene")
     msgs = "\n".join(f"{f.path}: {f.message}" for f in r.findings)
-    assert len(r.findings) == 5, msgs
+    assert len(r.findings) == 6, msgs
     assert "references 'vllm:fixture_dashboard_ghost', not defined" in msgs
+    # rule files: recorded names count as defined, ghost exprs do not
+    assert "references 'vllm:fixture_rule_ghost', not defined" in msgs
+    assert "fixture_recorded" not in msgs
     assert "documents 'vllm:fixture_ghost', not defined" in msgs
     assert "missing 'vllm:fixture_undocumented'" in msgs
     assert "label 'request_id' looks per-request" in msgs

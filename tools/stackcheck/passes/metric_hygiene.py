@@ -5,10 +5,13 @@ Absorbs ``tools/metrics_lint.py`` (now a thin shim over this pass) as the
 *drift* rules, and adds two AST rules over prometheus_client declarations:
 
 * drift — every ``vllm:``/``router:``/``kvserver:`` metric a Grafana
-  dashboard or docs/observability.md references must exist in code, and
-  every ``vllm:*`` metric defined in code must be documented (the docs are
-  the metrics reference). Exposition suffixes (``_total``/``_bucket``/
-  ``_sum``/``_count``/``_created``) normalize away first.
+  dashboard, docs/observability.md, or a Prometheus alert/recording rule
+  file (``observability/*rules*.yaml``, ``helm/rules/*.yaml``) references
+  must exist in code, and every ``vllm:*`` metric defined in code must be
+  documented (the docs are the metrics reference). Recording rules
+  (``record:`` lines) define names that expressions may then reference.
+  Exposition suffixes (``_total``/``_bucket``/``_sum``/``_count``/
+  ``_created``) normalize away first.
 * label cardinality — a label whose values are per-request identifiers
   (request/trace/span/session ids) makes Prometheus mint one series per
   request: unbounded cardinality that melts the TSDB. Ids belong in
@@ -39,6 +42,9 @@ NAME_RE = re.compile(
     r"(?<![\w-])(?:vllm|router|kvserver):[a-z][a-z0-9_]*[a-z0-9](?!\w)"
 )
 _SUFFIXES = ("_bucket", "_sum", "_count", "_created", "_total")
+
+# a recording rule's name line, plain ("record:") or list form ("- record:")
+_RECORD_LINE = re.compile(r"^\s*(?:-\s+)?record:")
 
 _CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
 _ID_LABEL = re.compile(
@@ -75,6 +81,29 @@ def dashboard_refs(ctx: Context) -> Dict[str, Set[str]]:
     return refs
 
 
+def rule_refs(ctx: Context) -> Dict[str, Tuple[Set[str], Set[str]]]:
+    """Per rule file: (names referenced by expressions, names defined by
+    ``record:`` lines). Line-based on purpose — no yaml dependency, and
+    PromQL selectors inside exprs are exactly what NAME_RE matches.
+    Recording-rule names like ``vllm:hbm_utilization:ratio`` normalize to
+    their metric prefix on both the record and the reference side, so
+    they cancel consistently."""
+    refs: Dict[str, Tuple[Set[str], Set[str]]] = {}
+    for pattern in ("observability/*rules*.yaml", "observability/*rules*.yml",
+                    "helm/rules/*.yaml"):
+        for path in ctx.glob(pattern):
+            referenced: Set[str] = set()
+            defined: Set[str] = set()
+            for line in ctx.read(path).splitlines():
+                names = {normalize(m) for m in NAME_RE.findall(line)}
+                if _RECORD_LINE.match(line):
+                    defined |= names
+                else:
+                    referenced |= names
+            refs[ctx.rel(path)] = (referenced, defined)
+    return refs
+
+
 def doc_refs(ctx: Context) -> Set[str]:
     doc = ctx.root / "docs" / "observability.md"
     if not doc.exists():
@@ -89,11 +118,18 @@ def _drift(ctx: Context) -> List[Finding]:
         for name in sorted(names - code):
             out.append(Finding(PASS, source, 0,
                                f"references {name!r}, not defined in code"))
+    recorded: Set[str] = set()
+    for source, (referenced, defined) in sorted(rule_refs(ctx).items()):
+        recorded |= defined
+        for name in sorted(referenced - code - defined):
+            out.append(Finding(PASS, source, 0,
+                               f"references {name!r}, not defined in code"))
     doc = ctx.root / "docs" / "observability.md"
     if doc.exists():
         documented = doc_refs(ctx)
         rel = ctx.rel(doc)
-        for name in sorted(documented - code):
+        # recording-rule names are legitimately documentable
+        for name in sorted(documented - code - recorded):
             out.append(Finding(PASS, rel, 0,
                                f"documents {name!r}, not defined in code"))
         for name in sorted(n for n in code - documented
