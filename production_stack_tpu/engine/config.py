@@ -533,6 +533,14 @@ class EngineConfig:
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     perf: PerfConfig = dataclasses.field(default_factory=PerfConfig)
+    # attention dispatch shape: "ragged" packs prefill chunks and decode
+    # rows into ONE token stream per step (token-budget scheduling, a
+    # single steady-state compile signature — ops/
+    # ragged_paged_attention_pallas.py); "bucketed" is the legacy
+    # prefill-bucket + padded-decode path kept for rollback; "auto"
+    # picks ragged when the Pallas kernels are usable (TPU) and bucketed
+    # otherwise (CPU / head-geometry fallback)
+    attention_impl: str = "auto"  # "auto" | "ragged" | "bucketed"
     seed: int = 0
     # multi-LoRA bank: slot 0 is the base model, adapters occupy 1..max-1
     max_loras: int = 4
@@ -548,7 +556,7 @@ class EngineConfig:
     def for_model(name: str, **kw) -> "EngineConfig":
         model_kw = {k: v for k, v in kw.items() if hasattr(ModelConfig, k) and k != "mesh"}
         cfg = EngineConfig(model=ModelConfig.from_pretrained(name, **model_kw))
-        for field in ("cache", "scheduler", "mesh", "seed"):
+        for field in ("cache", "scheduler", "mesh", "seed", "attention_impl"):
             if field in kw:
                 setattr(cfg, field, kw[field])
         return cfg

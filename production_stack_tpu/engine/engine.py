@@ -85,6 +85,30 @@ class LLMEngine:
             config.scheduler, config.cache, self.runner.num_blocks,
             max_model_len=config.model.max_model_len,
         )
+        # ragged unified step (ops/ragged_paged_attention_pallas.py): the
+        # scheduler mixes decode rows and prefill chunks into one
+        # token-budget batch, packed here into a single (1, T) stream
+        self.attention_impl = getattr(self.runner, "attention_impl",
+                                      "bucketed")
+        self._pending_ragged = None
+        if self.attention_impl == "ragged":
+            sched = config.scheduler
+            if sched.max_num_batched_tokens < sched.max_num_seqs:
+                raise ValueError(
+                    "ragged attention needs max_num_batched_tokens "
+                    f"({sched.max_num_batched_tokens}) >= max_num_seqs "
+                    f"({sched.max_num_seqs}): every decode row claims one "
+                    "stream token per step"
+                )
+            self.scheduler.unified = True
+            T = sched.max_num_batched_tokens
+            self._r_tokens = np.zeros((1, T), np.int32)
+            self._r_positions = np.full((1, T), -1, np.int32)
+            self._r_slot_mapping = np.full(T, -1, np.int32)
+            self._r_adapter_ids = np.zeros(T, np.int32)
+            self._r_cu = np.zeros(sched.max_num_seqs + 1, np.int32)
+            self._r_last_idx = np.zeros(sched.max_num_seqs, np.int32)
+            self._r_sample_mask = np.zeros(sched.max_num_seqs, np.float32)
         from production_stack_tpu.engine.kv_offload import (
             maybe_make_remote,
             maybe_make_store,
@@ -177,6 +201,11 @@ class LLMEngine:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.aborted_seqs = 0  # cancelled/expired, KV freed early
+        # unified ragged dispatch accounting (attention_impl == "ragged"):
+        # live packed tokens vs the always-budget-wide stream is the
+        # padding-waste signal the bucketed path hid in bucket geometry
+        self.ragged_dispatches = 0
+        self.ragged_live_tokens = 0
         # goodput accounting + compile tracking (perf_accounting.py); the
         # staged PP runner exposes no single param tree or jit programs to
         # wrap, so it only gets dispatch accounting
@@ -328,20 +357,27 @@ class LLMEngine:
     def step(self) -> list[RequestOutput]:
         out = self.scheduler.schedule()
         if out.is_empty:
-            outputs = self._resolve_pending_prefill()
+            outputs = self._resolve_pending_ragged()
+            outputs.extend(self._resolve_pending_prefill())
             outputs.extend(self._resolve_pending_decode())
             return outputs
         if out.prefills:
+            if self.attention_impl == "ragged" and not out.prefills[0].ring:
+                # unified path: prefill chunks and decode rows share ONE
+                # packed dispatch (a single steady-state compile signature)
+                return self._run_ragged(out)
             # stream out any decode tokens still in flight before the
             # prefill phase takes over the device
-            outputs = self._resolve_pending_decode()
+            outputs = self._resolve_pending_ragged()
+            outputs.extend(self._resolve_pending_decode())
             outputs.extend(self._run_prefill(out.prefills))
             return outputs
         # decode consumes the first sampled token: the deferred prefill
         # must land before decode inputs are built — and resolving may
         # FINISH sequences (max_tokens=1) the scheduler already put in
         # this step's decode batch
-        outputs = self._resolve_pending_prefill()
+        outputs = self._resolve_pending_ragged()
+        outputs.extend(self._resolve_pending_prefill())
         decodes = [s for s in out.decodes
                    if s.status is SequenceStatus.RUNNING]
         if decodes:
@@ -717,6 +753,237 @@ class LLMEngine:
             )
         return self._postprocess(finished_prompts, first_tokens, lp_lists)
 
+    # -- unified ragged step (attention_impl == "ragged") --------------------
+    def _run_ragged(self, out) -> list[RequestOutput]:
+        """ONE dispatch for a mixed step: every decode row contributes one
+        token and FCFS prefill chunks fill the rest of the token budget,
+        packed in slot order into a single (1, T) stream (T is always
+        max_num_batched_tokens — one steady-state compile signature).
+        Decode-only steps still take _run_decode (multi-step fusion,
+        chaining, speculation)."""
+        bs = self.config.cache.block_size
+        outputs = self._resolve_pending_ragged()
+        outputs.extend(self._resolve_pending_decode())
+        outputs.extend(self._resolve_pending_prefill())
+        decodes = [s for s in out.decodes
+                   if s.status is SequenceStatus.RUNNING]
+        prefills = [sp for sp in out.prefills
+                    if not sp.seq.status.is_finished]
+        if not decodes and not prefills:
+            return outputs
+        B = self.config.scheduler.max_num_seqs
+        T = self.config.scheduler.max_num_batched_tokens
+        rows: dict[int, tuple] = {s.slot: ("d", s) for s in decodes}
+        for sp in prefills:
+            rows[sp.seq.slot] = ("p", sp)
+
+        self._r_tokens[:] = 0
+        self._r_positions[:] = -1
+        self._r_slot_mapping[:] = -1
+        self._r_adapter_ids[:] = 0
+        self._r_last_idx[:] = 0
+        self._r_sample_mask[:] = 0.0
+        self._context_lens[:] = 0
+        self._presence[:] = 0.0
+        self._frequency[:] = 0.0
+        self._g_ids[:] = -1
+        self._g_states[:] = 0
+        self._ctrl_ids[:] = -1
+        self._ctrl_vals[:] = 0.0
+        self._ctrl_mode[:] = 0
+
+        cu = 0
+        seqs_in_step: list[Sequence] = []
+        p_tokens = p_ctx = p_rows = d_ctx = 0
+        for slot in range(B):
+            ent = rows.get(slot)
+            if ent is None:
+                self._r_cu[slot + 1] = cu
+                continue
+            kind, obj = ent
+            if kind == "d":
+                seq = obj
+                pos = seq.num_computed_tokens  # index of the incoming token
+                self._r_tokens[0, cu] = seq.token_ids[pos]
+                self._r_positions[0, cu] = pos
+                self._r_slot_mapping[cu] = (
+                    seq.block_ids[pos // bs] * bs + pos % bs
+                )
+                self._r_adapter_ids[cu] = seq.adapter_slot
+                self._context_lens[slot] = pos + 1
+                self._steps[slot] = pos - seq.num_prompt_tokens + 1
+                self._r_sample_mask[slot] = 1.0
+                s = seq.sampling
+                self._presence[slot] = s.presence_penalty
+                self._frequency[slot] = s.frequency_penalty
+                self._g_ids[slot] = seq.grammar_slot
+                self._g_states[slot] = max(seq.fsm_state, 0)
+                cu += 1
+                d_ctx += pos + 1
+            else:
+                sp = obj
+                seq = sp.seq
+                n = sp.chunk_len
+                self._r_tokens[0, cu : cu + n] = seq.token_ids[
+                    sp.chunk_start : sp.chunk_start + n
+                ]
+                self._r_positions[0, cu : cu + n] = np.arange(
+                    sp.chunk_start, sp.chunk_start + n
+                )
+                self._r_slot_mapping[cu : cu + n] = slot_mapping_for(
+                    seq.block_ids, sp.chunk_start, n, bs
+                )
+                self._r_adapter_ids[cu : cu + n] = seq.adapter_slot
+                self._context_lens[slot] = sp.chunk_start + n
+                self._steps[slot] = 0
+                completing = sp.chunk_start + n >= seq.prefill_target
+                if completing and not seq.output_token_ids:
+                    self._r_sample_mask[slot] = 1.0
+                # the grammar constrains the FIRST sampled token only when
+                # this chunk completes the prompt (state 0)
+                if completing and seq.grammar_slot >= 0:
+                    self._g_ids[slot] = seq.grammar_slot
+                    self._g_states[slot] = 0
+                s = seq.sampling
+                cu += n
+                p_tokens += n
+                p_ctx += sp.chunk_start + n
+                p_rows += 1
+            nb = len(seq.block_ids)
+            self._block_tables[slot, :nb] = seq.block_ids
+            self._r_last_idx[slot] = cu - 1
+            self._temps[slot] = s.temperature
+            self._top_ps[slot] = s.top_p
+            self._top_ks[slot] = s.top_k
+            self._seeds[slot] = s.seed or 0
+            if seq.token_ctrl is not None:
+                (self._ctrl_ids[slot], self._ctrl_vals[slot],
+                 self._ctrl_mode[slot]) = seq.token_ctrl
+            self._r_cu[slot + 1] = cu
+            seqs_in_step.append(seq)
+        assert cu <= T, f"packed {cu} tokens over budget {T}"
+
+        greedy_only = all(
+            s.sampling.temperature <= 0.0 for s in seqs_in_step
+        )
+        use_lora = any(s.adapter_slot for s in seqs_in_step)
+        # prefill rows never penalize their first sample (matches the
+        # bucketed path); penalties gate on the decode rows only
+        use_penalties = any(
+            s.sampling.presence_penalty or s.sampling.frequency_penalty
+            for s in decodes
+        )
+        if use_penalties and self._count_reset_slots:
+            for seq in self._count_reset_slots:
+                if seq.slot >= 0:
+                    self.runner.set_count_row(seq.slot, seq.output_token_ids)
+            self._count_reset_slots.clear()
+        use_controls = any(s.token_ctrl is not None for s in seqs_in_step)
+        use_grammar = bool((self._g_ids >= 0).any())
+        result_dev = self.runner.ragged_step(
+            self._r_tokens, self._r_positions, self._block_tables,
+            self._context_lens, self._r_cu, self._r_slot_mapping,
+            self._r_last_idx, self._r_sample_mask,
+            self._temps, self._top_ps, self._top_ks, self._seeds,
+            self._steps,
+            greedy_only=greedy_only,
+            presence=self._presence if use_penalties else None,
+            frequency=self._frequency if use_penalties else None,
+            adapter_ids=self._r_adapter_ids if use_lora else None,
+            ctrl=((self._ctrl_ids, self._ctrl_vals, self._ctrl_mode)
+                  if use_controls else None),
+            g_ids=self._g_ids if use_grammar else None,
+            g_states=self._g_states if use_grammar else None,
+            fetch=False,
+        )
+        if self.perf is not None:
+            self.perf.record_ragged(p_tokens, p_ctx, p_rows,
+                                    len(decodes), d_ctx)
+        self.ragged_dispatches += 1
+        self.ragged_live_tokens += cu
+
+        # scheduler-visible state advances NOW; results land next step
+        # (same deferral contract as _run_prefill / chained decode)
+        decode_rows = []
+        for seq in decodes:
+            seq.num_computed_tokens += 1
+            decode_rows.append((seq.slot, seq))
+        prefill_rows = []
+        for sp in prefills:
+            seq = sp.seq
+            seq.num_computed_tokens = sp.chunk_start + sp.chunk_len
+            if not seq.prefill_done:
+                continue  # more chunks to go
+            seq.status = SequenceStatus.RUNNING
+            self._slot_seq[seq.slot] = seq
+            s = seq.sampling
+            if s.presence_penalty or s.frequency_penalty:
+                self._count_reset_slots.append(seq)
+            if seq.output_token_ids:
+                # preemption-recompute: context rebuilt, newest token still
+                # the pending decode input — nothing sampled this step
+                continue
+            prefill_rows.append((seq.slot, seq))
+        self._pending_ragged = {
+            "prefill_rows": prefill_rows,
+            "decode_rows": decode_rows,
+            "result": result_dev,
+        }
+        return outputs
+
+    def _resolve_pending_ragged(self) -> list[RequestOutput]:
+        if self._pending_ragged is None:
+            return []
+        pending = self._pending_ragged
+        self._pending_ragged = None
+        fetched = tuple(
+            np.asarray(x) for x in jax.device_get(pending["result"])
+        )
+        return self._finish_ragged(pending, fetched)
+
+    def _finish_ragged(self, pending, fetched) -> list[RequestOutput]:
+        """Append one sampled token per resolved row: first tokens for the
+        prompts that completed in that dispatch, next tokens for its decode
+        rows (num_computed already advanced at dispatch)."""
+        sampled = fetched[0]
+        lp = fetched[1:] if len(fetched) > 1 else None
+        live, token_lists, lp_lists = [], [], []
+        for slot, seq in pending["prefill_rows"]:
+            if seq.status.is_finished:
+                continue  # aborted while the dispatch was in flight
+            token = int(sampled[slot])
+            seq.first_token_time = time.monotonic()
+            seq.output_token_ids.append(token)
+            if seq.grammar_slot >= 0 and seq.fsm is not None:
+                seq.fsm_state = int(seq.fsm.trans[0, token])
+            self.total_output_tokens += 1
+            live.append(seq)
+            token_lists.append([token])
+            lp_lists.append(
+                [_lp_row(lp, slot)]
+                if lp is not None and seq.sampling.logprobs is not None
+                else None
+            )
+        for slot, seq in pending["decode_rows"]:
+            if seq.status.is_finished:
+                continue
+            t = int(sampled[slot])
+            seq.output_token_ids.append(t)
+            if seq.grammar_slot >= 0 and seq.fsm is not None:
+                if 0 <= t < seq.fsm.trans.shape[1]:
+                    seq.fsm_state = int(
+                        seq.fsm.trans[max(seq.fsm_state, 0), t]
+                    )
+            self.total_output_tokens += 1
+            live.append(seq)
+            token_lists.append([t])
+            lp_lists.append(
+                [_lp_row(lp, slot)]
+                if lp is not None and seq.sampling.logprobs is not None
+                else None
+            )
+        return self._postprocess(live, token_lists, lp_lists)
+
     def _run_decode(self, decodes: list[Sequence]) -> list[RequestOutput]:
         bs = self.config.cache.block_size
         outputs: list[RequestOutput] = []
@@ -1014,6 +1281,16 @@ class LLMEngine:
                                 / max(1, self.config.scheduler.max_num_seqs)),
             "kv_blocks_total": self.runner.num_blocks,
             "kv_blocks_free": self.scheduler.num_free_blocks,
+            # unified ragged path: dispatch count + live-token fill of the
+            # budget-wide stream (engine/metrics.py turns these into
+            # vllm:ragged_* series)
+            "ragged_dispatches_total": self.ragged_dispatches,
+            "ragged_live_tokens_total": self.ragged_live_tokens,
+            "ragged_stream_utilization": (
+                self.ragged_live_tokens
+                / max(1, self.ragged_dispatches
+                      * self.config.scheduler.max_num_batched_tokens)
+            ),
         }
         if self.host_kv is not None:
             out["cpu_cache_usage_perc"] = self.host_kv.usage
@@ -1140,35 +1417,54 @@ class LLMEngine:
             while self.has_unfinished():
                 self.step()
 
-        for b in buckets:
-            n = max(min(b, sched.max_num_batched_tokens,
-                        self.config.model.max_model_len - sched.multi_step - 2),
-                    1)
-            if self._bucket(n) != b:
-                continue  # budget caps chunks below this bucket: never used
+        if self.attention_impl == "ragged":
+            # the ragged program's signature is shape-independent of the
+            # traffic (the stream is always budget-wide, slots always
+            # max_num_seqs): ONE greedy + ONE sampled run covers the whole
+            # bucket x row-class matrix the bucketed path has to walk. The
+            # feature-variant runs below (logprobs / grammar / penalties /
+            # controls) flow through the same unified step and compile
+            # their static-flag variants.
+            n = max(min(sched.max_num_batched_tokens,
+                        self.config.model.max_model_len
+                        - sched.multi_step - 2), 1)
             run([rng.integers(1, vocab, n).tolist()], 0.0)
-        # every reachable (pow-2 rows, bucket) prefill variant, greedy and
-        # sampled: rows pad to the next power of two of the live chunk
-        # count (capped at prefill_batch — the cap itself is a class when
-        # prefill_batch isn't a power of two), and a bucket-b step can
-        # carry at most budget//(b/2+1)+1 chunks
-        budget = sched.max_num_batched_tokens
-        row_classes = sorted({
-            min(1 << i, sched.prefill_batch)
-            for i in range(1, max((sched.prefill_batch - 1).bit_length(), 0)
-                           + 1)
-        })
-        for b in buckets:
-            lo = b // 2 + 1 if b > buckets[0] else 1
-            max_rows = min(sched.prefill_batch, budget // lo + 1)
-            for p in row_classes:
-                if p > max_rows:
-                    break
-                n = min(lo + 1, b)
-                batch = [rng.integers(1, vocab, n).tolist()
-                         for _ in range(p)]
-                run(batch, 0.0)
-                run(batch, 0.7)
+            # a mixed multi-prompt batch: same signature, but exercises the
+            # packed multi-span path once before traffic does
+            m = max(n // 4, 1)
+            run([rng.integers(1, vocab, m).tolist()
+                 for _ in range(min(4, sched.max_num_seqs))], 0.7)
+        else:
+            for b in buckets:
+                n = max(min(b, sched.max_num_batched_tokens,
+                            self.config.model.max_model_len
+                            - sched.multi_step - 2),
+                        1)
+                if self._bucket(n) != b:
+                    continue  # budget caps chunks below this bucket: unused
+                run([rng.integers(1, vocab, n).tolist()], 0.0)
+            # every reachable (pow-2 rows, bucket) prefill variant, greedy
+            # and sampled: rows pad to the next power of two of the live
+            # chunk count (capped at prefill_batch — the cap itself is a
+            # class when prefill_batch isn't a power of two), and a
+            # bucket-b step can carry at most budget//(b/2+1)+1 chunks
+            budget = sched.max_num_batched_tokens
+            row_classes = sorted({
+                min(1 << i, sched.prefill_batch)
+                for i in range(
+                    1, max((sched.prefill_batch - 1).bit_length(), 0) + 1)
+            })
+            for b in buckets:
+                lo = b // 2 + 1 if b > buckets[0] else 1
+                max_rows = min(sched.prefill_batch, budget // lo + 1)
+                for p in row_classes:
+                    if p > max_rows:
+                        break
+                    n = min(lo + 1, b)
+                    batch = [rng.integers(1, vocab, n).tolist()
+                             for _ in range(p)]
+                    run(batch, 0.0)
+                    run(batch, 0.7)
         # speculative verify program: compile the one static (B, S) shape
         # directly with an all-inactive batch (ctx 0, slots -1 — no KV is
         # touched); whether live traffic's drafts ever match is dynamic, so

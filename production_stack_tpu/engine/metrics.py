@@ -124,6 +124,26 @@ class EngineStatsCollector:
             "Free KV blocks (allocatable right now)",
             s.get("kv_blocks_free", 0),
         )
+        # unified ragged attention path: mixed prefill+decode dispatches
+        # and how much of the budget-wide token stream carried live tokens
+        # (the ragged path's goodput/padding-waste signal)
+        yield counter(
+            "vllm:ragged_dispatches",
+            "Unified mixed prefill+decode dispatches issued "
+            "(attention_impl=ragged)",
+            s.get("ragged_dispatches_total", 0),
+        )
+        yield counter(
+            "vllm:ragged_live_tokens",
+            "Live (unpadded) tokens packed into ragged dispatches",
+            s.get("ragged_live_tokens_total", 0),
+        )
+        yield gauge(
+            "vllm:ragged_stream_utilization",
+            "Cumulative live-token fill of the budget-wide ragged stream "
+            "(live tokens / dispatches x max_num_batched_tokens)",
+            s.get("ragged_stream_utilization", 0.0),
+        )
         # goodput accounting (engine/perf_accounting.py): live roofline
         # utilization, phase throughput, HBM occupancy, compile events
         perf = s.get("perf")

@@ -1,18 +1,33 @@
-"""Jitted device steps: chunked prefill + batched decode over the paged cache.
+"""Jitted device steps over the paged cache: the ragged unified step, plus
+the bucketed prefill/decode fallback.
 
 Static-shape discipline (XLA traces once per shape):
 
-- decode is ONE compiled program: fixed (max_num_seqs, 1) batch; empty slots
-  carry context_len 0 and padding slot -1, costing only masked lanes.
-- prefill compiles once per token-length *bucket* (powers of two); chunks are
-  padded up. Block tables are always (B, max_blocks_per_seq).
+- **ragged** (``attention_impl="ragged"``, the default on TPU): ONE program
+  consumes a packed token stream ``tokens (1, T)`` covering prefill chunks
+  AND decode rows in the same dispatch — per-slot spans described by
+  ``cu_q_lens (S+1,)`` with ``S = max_num_seqs`` slots in slot order
+  (decode rows span 1 token, prefilling slots span their chunk, inactive
+  slots span 0). ``T`` is always the token budget
+  (``max_num_batched_tokens``), so the steady-state compile-signature
+  space collapses to ONE signature per program kind: no shape buckets, no
+  padded batch dim, no prefill/decode phase barrier. Sampling happens per
+  slot at each span's last token; rows whose sample is not consumed
+  (mid-prompt chunks, inactive slots) produce masked garbage the host
+  discards.
+- **bucketed** (fallback / rollback): decode is one compiled program over a
+  fixed (max_num_seqs, 1) batch; prefill compiles once per token-length
+  bucket (powers of two) with chunks padded up. Block tables are always
+  (B, max_blocks_per_seq).
 - KV cache buffers are donated through every step, so XLA updates them in
   place in HBM — the pool is allocated once at startup and never copied.
 
-Attention backend selection: Pallas decode kernel on TPU (wrapped in
-shard_map over the tensor axis when tp > 1 — heads are independent, so the
-kernel needs no cross-chip traffic); XLA gather path on CPU/tests and as
-fallback when head counts don't divide the mesh.
+Attention backend selection: Pallas kernels on TPU (wrapped in shard_map
+over the tensor axis when tp > 1 — heads are independent, so the kernels
+need no cross-chip traffic); XLA gather path on CPU/tests and as fallback
+when head counts don't divide the mesh. ``attention_impl="auto"`` resolves
+to ragged exactly when the Pallas kernels are usable, bucketed otherwise;
+either impl can be forced (the ragged XLA path is the CPU parity oracle).
 """
 
 from __future__ import annotations
@@ -89,6 +104,17 @@ class ModelRunner:
                 else init_or_load(self.cfg, mesh, self.rules, config.seed),
             )
         self.use_pallas = _pallas_ok(self.cfg, mesh, config.cache.block_size)
+        impl = getattr(config, "attention_impl", "auto") or "auto"
+        if impl not in ("auto", "ragged", "bucketed"):
+            raise ValueError(
+                f"attention_impl must be auto|ragged|bucketed, got {impl!r}"
+            )
+        # auto: the ragged step exists to feed the Pallas kernel; the XLA
+        # ragged path stays reachable by forcing "ragged" (parity tests)
+        self.attention_impl = (
+            impl if impl != "auto"
+            else ("ragged" if self.use_pallas else "bucketed")
+        )
         self.num_blocks = self._resolve_num_blocks(num_blocks)
         self.kv = kvmod.init_kv_cache(
             self.cfg, config.cache, mesh, self.rules, self.num_blocks
@@ -143,6 +169,15 @@ class ModelRunner:
                              "use_grammar"),
             **self._mh_gate,
         )
+        if self.attention_impl == "ragged":
+            self._ragged = jax.jit(
+                functools.partial(_ragged_step, self.cfg,
+                                  self._attend_ragged, self._eos_id),
+                donate_argnums=(1,),
+                static_argnames=("greedy_only", "use_penalties",
+                                 "use_controls", "use_grammar"),
+                **self._mh_gate,
+            )
         self._sample = jax.jit(sample_tokens)
         if config.scheduler.spec_ngram_k > 0:
             self._verify = jax.jit(
@@ -193,15 +228,31 @@ class ModelRunner:
 
     # -- sizing ------------------------------------------------------------
     def _prefill_temp_bytes(self) -> int:
-        """Worst-case prefill transient, per attention backend.
+        """Worst-case prefill transient, per attention impl + backend.
 
-        XLA gather path: per batched sequence, (KH, G, S, ctx) f32
-        score/softmax buffers plus the gathered context — times the
-        prefill_batch dimension. Pallas path: windows live in VMEM scratch;
-        only hidden/logits-scale HBM transients remain."""
+        Ragged: the token budget is the single source of shape truth — the
+        stream is always ``max_num_batched_tokens`` wide, no bucket or
+        prefill_batch dimension exists. Pallas keeps KV windows in VMEM
+        scratch, so only hidden/logits-scale HBM transients remain; the
+        XLA ragged reference gathers each token's full context.
+
+        Bucketed: per batched sequence, (KH, G, S, ctx) f32 score/softmax
+        buffers plus the gathered context — times the prefill_batch
+        dimension (this path keeps its own bucket clamp)."""
         sched = self.config.scheduler
+        if self.attention_impl == "ragged":
+            T = min(sched.max_num_batched_tokens, self.cfg.max_model_len)
+            hidden = T * self.cfg.hidden_size * 4
+            logits = sched.max_num_seqs * self.cfg.vocab_size * 4
+            if self.use_pallas:
+                return int(8 * hidden + 4 * logits)
+            ctx = self.cfg.max_model_len
+            scores = (T * ctx * self.cfg.num_kv_heads
+                      * self.cfg.q_per_kv * 4)
+            gather = 2 * T * ctx * self.cfg.num_kv_heads * self.cfg.head_dim * 2
+            return int(3.5 * scores + 2 * gather + 8 * hidden + 4 * logits)
         Pb = max(sched.prefill_batch, 1)
-        # the scheduler never issues a chunk past the largest bucket
+        # the bucketed scheduler never issues a chunk past the largest bucket
         chunk = min(sched.max_num_batched_tokens, self.cfg.max_model_len,
                     max(sched.prefill_buckets))
         s_max = sched.bucket_for(chunk)
@@ -356,6 +407,65 @@ class ModelRunner:
             layer_idx, jnp.zeros((1,), jnp.int32),
         )
         return out[:, None], caches
+
+    def _attend_ragged(self, q, k, v, caches, layer_idx, block_tables,
+                       context_lens, q_positions, slot_mapping, cu_q_lens):
+        """Unified ragged step: q (1, T, H, D) over the packed mixed
+        prefill+decode stream; per-slot spans via cu_q_lens (S+1,).
+        q_positions (1, T) carries each token's absolute position (-1 pad)
+        for the XLA reference path; the Pallas kernel derives positions
+        from cu_q_lens/context_lens on its own."""
+        T = q.shape[1]
+        k_flat = k.reshape(T, -1, self.cfg.head_dim)
+        v_flat = v.reshape(T, -1, self.cfg.head_dim)
+        if not self.use_pallas:
+            from production_stack_tpu.ops.paged_attention import (
+                ragged_paged_attention,
+            )
+
+            caches = write_kv(caches, layer_idx, k_flat, v_flat,
+                              slot_mapping, self.tp)
+            layer = jax.lax.dynamic_index_in_dim(
+                caches, layer_idx, 0, keepdims=False
+            )
+            S = block_tables.shape[0]
+            # owning slot per token, recovered from the span offsets
+            seq_ids = (
+                jnp.searchsorted(
+                    cu_q_lens, jnp.arange(T, dtype=jnp.int32), side="right"
+                ).astype(jnp.int32) - 1
+            )
+            seq_ids = jnp.clip(seq_ids, 0, S - 1)
+            out = ragged_paged_attention(
+                q[0], layer, block_tables, context_lens, seq_ids,
+                q_positions[0], tp=self.tp,
+                soft_cap=self.cfg.attn_logit_softcap,
+            )
+            return out[None], caches
+
+        from production_stack_tpu.ops.paged_attention_pallas import (
+            kv_cache_write_pallas,
+        )
+        from production_stack_tpu.ops.ragged_paged_attention_pallas import (
+            ragged_paged_attention_pallas,
+        )
+
+        newkv = combine_kv(k_flat.astype(caches.dtype),
+                           v_flat.astype(caches.dtype), self.tp)
+
+        def inner(q3, nk, fused, bt, cl, sm, li, cu):
+            fused = kv_cache_write_pallas(fused, nk, sm, li)
+            out = ragged_paged_attention_pallas(
+                q3, fused, bt, cu, cl, li,
+                soft_cap=self.cfg.attn_logit_softcap,
+            )
+            return out, fused
+
+        out, caches = self._sharded(inner, q_rank=3)(
+            q[0], newkv, caches, block_tables, context_lens, slot_mapping,
+            layer_idx, cu_q_lens,
+        )
+        return out[None], caches
 
     # -- public step API (host numpy in, device out) -------------------------
     def prefill(self, tokens: np.ndarray, positions: np.ndarray,
@@ -589,6 +699,96 @@ class ModelRunner:
             # (sampled (K, B), tok_lp (K, B), ids (K, B, N), lps (K, B, N))
             return tuple(np.asarray(x) for x in jax.device_get((sampled, *lp)))
         return np.asarray(jax.device_get(sampled))
+
+    def ragged_step(self, tokens, positions, block_tables, context_lens,
+                    cu_q_lens, slot_mapping, last_idx, sample_mask,
+                    temps, top_ps, top_ks, seeds, steps,
+                    greedy_only: bool = False,
+                    presence=None, frequency=None,
+                    adapter_ids=None, ctrl=None,
+                    g_ids=None, g_states=None,
+                    fetch: bool = True):
+        """ONE unified dispatch over the packed mixed prefill+decode stream.
+
+        tokens/positions: (1, T) with T the token budget (-1 position = tail
+        padding); block_tables (S, M), context_lens (S,), cu_q_lens (S+1,)
+        per-slot span offsets in slot order; slot_mapping (T,) flat KV
+        slots (-1 = skip); last_idx (S,) stream index of each slot's final
+        token (sampling point); sample_mask (S,) 1.0 where the sample is
+        actually consumed this step (decode rows + prompt-completing
+        chunks) — it gates the on-device penalty-count update only.
+        adapter_ids is PER-TOKEN (T,) — spans of different slots can carry
+        different adapters in the same stream.
+
+        Returns (sampled (S,), tok_lp (S,), top_ids (S, N), top_lps (S, N))
+        on host — or the un-fetched device tuple with ``fetch=False`` so
+        the dispatch overlaps the host's next-step work. T and S never
+        change between dispatches: ONE steady-state compile signature per
+        static-flag variant (CompileTracker treats any post-warmup fresh
+        signature here as a bug signal)."""
+        use_penalties = presence is not None
+        if not fetch:
+            # the engine rewrites these host buffers in place each step;
+            # snapshot every mutable input (see decode_multi)
+            (tokens, positions, block_tables, context_lens, cu_q_lens,
+             slot_mapping, last_idx, sample_mask, temps, top_ps, top_ks,
+             seeds, steps) = (
+                np.array(x) for x in (
+                    tokens, positions, block_tables, context_lens,
+                    cu_q_lens, slot_mapping, last_idx, sample_mask,
+                    temps, top_ps, top_ks, seeds, steps)
+            )
+            presence = None if presence is None else np.array(presence)
+            frequency = None if frequency is None else np.array(frequency)
+            adapter_ids = (None if adapter_ids is None
+                           else np.array(adapter_ids))
+            ctrl = (None if ctrl is None
+                    else tuple(np.array(c) for c in ctrl))
+            g_ids = None if g_ids is None else np.array(g_ids)
+            g_states = None if g_states is None else np.array(g_states)
+        S = context_lens.shape[0]
+        if use_penalties:
+            self._ensure_counts()
+            counts = self.token_counts
+            pres = jnp.asarray(presence)
+            freq = jnp.asarray(frequency)
+        else:
+            counts = jnp.zeros((S, 1), jnp.int32)  # placeholder
+            pres = jnp.zeros(S, jnp.float32)
+            freq = pres
+        use_lora = adapter_ids is not None and self.lora_bank is not None
+        use_grammar = g_ids is not None and self.grammar_bank is not None
+        with set_mesh(self.mesh):
+            (self.kv, new_counts), result = self._ragged(
+                self.params, self.kv,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(block_tables), jnp.asarray(context_lens),
+                jnp.asarray(cu_q_lens), jnp.asarray(slot_mapping),
+                jnp.asarray(last_idx), jnp.asarray(sample_mask),
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), jnp.asarray(seeds),
+                jnp.asarray(steps), counts, pres, freq,
+                lora_bank=self.lora_bank if use_lora else None,
+                adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
+                             if use_lora else None),
+                ctrl=(tuple(jnp.asarray(c) for c in ctrl)
+                      if ctrl is not None else None),
+                grammar=(
+                    (self.grammar_bank, self.grammar_accept,
+                     jnp.asarray(g_ids, jnp.int32),
+                     jnp.asarray(g_states, jnp.int32))
+                    if use_grammar else None
+                ),
+                greedy_only=greedy_only,
+                use_penalties=use_penalties,
+                use_controls=ctrl is not None,
+                use_grammar=use_grammar,
+            )
+        if use_penalties:
+            self.token_counts = new_counts
+        if not fetch:
+            return result
+        return tuple(np.asarray(x) for x in jax.device_get(result))
 
     # -- sleep mode hooks ----------------------------------------------------
     def drop_kv(self) -> None:
@@ -1217,3 +1417,78 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, eos_id,
     # sampled: (num_steps, B); lp (when requested): tok_lp (K, B),
     # top_ids (K, B, N), top_lps (K, B, N)
     return (kv, counts), (sampled, next_tok, *lp)
+
+
+def _ragged_step(cfg: ModelConfig, attend_impl, eos_id, params, kv,
+                 tokens, positions, block_tables, context_lens, cu_q_lens,
+                 slot_mapping, last_idx, sample_mask,
+                 temps, top_ps, top_ks, seeds, steps,
+                 token_counts, presence, frequency,
+                 lora_bank=None, adapter_ids=None, ctrl=None, grammar=None,
+                 *, greedy_only: bool = False,
+                 use_penalties: bool = False,
+                 use_controls: bool = False,
+                 use_grammar: bool = False):
+    """The unified mixed prefill+decode step: ONE forward over the packed
+    token stream, then one sample per slot at its span's last token.
+
+    tokens/positions: (1, T); cu_q_lens (S+1,) span offsets in slot order
+    (decode rows span 1 token, prefilling slots their chunk, inactive 0);
+    last_idx (S,) stream index of each slot's final token; sample_mask
+    (S,) gates the on-device penalty-count update to rows whose sample is
+    actually consumed. Logprobs ride every dispatch (like _prefill_step):
+    one (S, V) top-k next to the stream forward is noise, and it keeps the
+    want_logprobs compile variant from existing on the unified path.
+    Returns ((new_kv, new_counts), (sampled (S,), tok_lp, ids, lps))."""
+    from production_stack_tpu.engine.sampling import (
+        compute_logprobs,
+        sample_tokens,
+    )
+    from production_stack_tpu.models.registry import get_model
+
+    model = get_model(cfg)
+
+    def attend(q, k, v, caches, layer_idx):
+        return attend_impl(
+            q, k, v, caches, layer_idx, block_tables, context_lens,
+            positions, slot_mapping, cu_q_lens,
+        )
+
+    lora = None
+    if lora_bank is not None and adapter_ids is not None:
+        # PER-TOKEN adapters: spans of different slots share the stream
+        N = next(iter(lora_bank.values()))[0].shape[1]
+        onehot = jax.nn.one_hot(adapter_ids, N, dtype=jnp.float32)[None]
+        lora = {"onehot": onehot, "bank": lora_bank}
+    hidden, new_kv = model.forward_tokens(
+        cfg, params, tokens, positions, attend, kv, lora=lora,
+    )
+    last_hidden = jnp.take(hidden[0], last_idx, axis=0)  # (S, E)
+    logits = model.logits_from_hidden(cfg, params, last_hidden[:, None])[:, 0]
+    raw_logits = logits  # logprobs report the raw model distribution
+    if use_penalties:
+        from production_stack_tpu.engine.sampling import penalize_logits
+
+        logits = penalize_logits(logits, token_counts, presence, frequency)
+    if use_controls:
+        from production_stack_tpu.engine.sampling import apply_token_controls
+
+        logits = apply_token_controls(logits, *ctrl)
+    if use_grammar:
+        # decode rows constrain at their mirrored FSM state; a slot whose
+        # prompt completes this step starts at state 0 (host sets g_states)
+        g_bank, g_accept, g_ids, g_states = grammar
+        logits, _ = _grammar_mask(
+            logits, g_bank, g_accept, g_ids, g_states, eos_id
+        )
+    if greedy_only:
+        sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        sampled = sample_tokens(logits, temps, top_ps, top_ks, seeds, steps)
+    if use_penalties:
+        S = sampled.shape[0]
+        token_counts = token_counts.at[jnp.arange(S), sampled].add(
+            sample_mask.astype(token_counts.dtype)
+        )
+    lp = compute_logprobs(raw_logits, sampled)
+    return (new_kv, token_counts), (sampled, *lp)

@@ -107,7 +107,7 @@ def wrap_runner_programs(runner, observer: Callable) -> None:
     """Install ``CompileTracker`` proxies over a runner's jitted programs
     (the per-bucket prefill variants and every decode/verify variant)."""
     for attr in ("_prefill", "_prefill_ring", "_decode", "_decode_multi",
-                 "_verify", "_sample"):
+                 "_verify", "_sample", "_ragged"):
         fn = getattr(runner, attr, None)
         if fn is None or isinstance(fn, CompileTracker):
             continue
@@ -219,6 +219,39 @@ class PerfAccountant:
         hbm = steps * (self.param_bytes
                        + (ctx_tokens + live_seqs) * self._kv_bytes_per_tok)
         self._record(ts, "decode", flops, hbm, tokens)
+
+    def record_ragged(self, prefill_tokens: int, prefill_ctx: int,
+                      prefill_rows: int, decode_seqs: int, decode_ctx: int,
+                      ts: Optional[float] = None) -> None:
+        """One unified ragged dispatch: ``prefill_tokens`` prompt tokens
+        over ``prefill_rows`` chunks (post-chunk contexts summing to
+        ``prefill_ctx``) packed together with ``decode_seqs`` single-token
+        decode rows (contexts summing to ``decode_ctx``).
+
+        The cost splits by the actual unpadded per-phase token counts and
+        lands as TWO window events so the phase gauges
+        (``vllm:tokens_per_second{phase}``) stay meaningful: the prefill
+        share carries the weight pass (param_bytes read once per
+        dispatch, attributed to whichever phase is present), the decode
+        share adds its attention context FLOPs and KV traffic on top —
+        one fused dispatch never double-counts the weight read the way
+        separate record_prefill + record_decode calls would."""
+        if prefill_tokens <= 0 and decode_seqs <= 0:
+            return
+        if prefill_tokens > 0:
+            ctx_mean = prefill_ctx / max(prefill_rows, 1)
+            flops = (2.0 * self.param_count * prefill_tokens
+                     + self._attn_per_tok_ctx * prefill_tokens * ctx_mean)
+            hbm = (self.param_bytes
+                   + (prefill_tokens + prefill_ctx) * self._kv_bytes_per_tok)
+            self._record(ts, "prefill", flops, hbm, prefill_tokens)
+        if decode_seqs > 0:
+            flops = (2.0 * self.param_count * decode_seqs
+                     + self._attn_per_tok_ctx * decode_ctx)
+            hbm = (decode_ctx + decode_seqs) * self._kv_bytes_per_tok
+            if prefill_tokens <= 0:  # decode-only dispatch pays the weights
+                hbm += self.param_bytes
+            self._record(ts, "decode", flops, hbm, decode_seqs)
 
     def _record(self, ts, phase, flops, hbm_bytes, tokens) -> None:
         now = ts if ts is not None else time.monotonic()

@@ -1,6 +1,15 @@
 """Continuous-batching scheduler (vLLM-semantics, TPU-shaped).
 
-Policy per step, in order:
+Two policies share admission/preemption/blocks:
+
+**Unified (token-budget)** — ``unified = True``, set by the engine on the
+ragged attention impl: every step collects ALL decodable sequences (one
+stream token each), then FCFS prefill chunks fill whatever budget decode
+left (``max_num_batched_tokens`` is the only shape knob — no buckets, no
+prefill/decode phase barrier). One mixed batch per step; the runner packs
+it into a single ragged dispatch.
+
+**Bucketed (prefill-priority)** — the fallback, per step, in order:
 
 1. **Admit**: move waiting sequences into decode slots while slots and KV
    blocks last, reusing prefix-cached blocks on admission.
@@ -73,6 +82,11 @@ class Scheduler:
         # set by the engine when the mesh has a seq axis > 1: long fresh
         # prompts prefill whole via ring attention instead of chunking
         self.ring_enabled = False
+        # set by the engine on the ragged attention impl: one token-budget
+        # batch per step mixing decode rows and FCFS prefill chunks —
+        # max_num_batched_tokens is the only shape knob (no prefill
+        # buckets, no prefill/decode phase barrier)
+        self.unified = False
 
     # -- queue management ---------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -210,6 +224,9 @@ class Scheduler:
                     )
                     return out
 
+        if self.unified:
+            return self._schedule_unified(out)
+
         # prefill priority: batch up to prefill_batch chunks per dispatch;
         # the first (FCFS) chunk picks the shape bucket, later chunks are
         # truncated to it (they continue next step — chunked prefill)
@@ -237,12 +254,46 @@ class Scheduler:
         if out.prefills:
             return out
 
-        # decode all running sequences; grow block tables first so every
-        # sequence has capacity for the next multi_step tokens (positions
-        # num_computed .. num_computed + multi_step - 1). A sequence whose
-        # already-dispatched tokens cover its completion bound is excluded:
-        # under deferred resolution its finish is still in flight, and a
-        # further dispatch would run past max_model_len's block table.
+        out.decodes = self._grow_decodes(out)
+        return out
+
+    def _schedule_unified(self, out: SchedulerOutput) -> SchedulerOutput:
+        """Token-budget continuous batching (RTP-LLM-style): decode rows
+        claim one stream token each, then FCFS prefill chunks fill
+        whatever budget is left — one mixed batch per step, no
+        prefill/decode phase barrier, and ``max_num_batched_tokens`` as
+        the ONLY shape knob (no bucket truncation: the ragged dispatch
+        has no padded chunk dimension to round up to)."""
+        out.decodes = self._grow_decodes(out)
+        budget = self.config.max_num_batched_tokens - len(out.decodes)
+        for seq in sorted(self.seqs.values(), key=lambda s: s.arrival_time):
+            if seq.status is not SequenceStatus.PREFILLING:
+                continue
+            if seq.prefill_done:
+                # preemption-recompute whose context fully prefix-matched
+                # on re-admission: nothing to compute, decodes next step
+                seq.status = SequenceStatus.RUNNING
+                continue
+            if budget <= 0:
+                break
+            remaining = seq.prefill_target - seq.num_computed_tokens
+            chunk = min(remaining, budget)
+            out.prefills.append(
+                ScheduledPrefill(seq, seq.num_computed_tokens, chunk)
+            )
+            budget -= chunk
+        return out
+
+    def _grow_decodes(self, out: SchedulerOutput) -> list[Sequence]:
+        """Collect every decodable sequence, growing block tables first so
+        each has capacity for the next ``decode_horizon`` tokens
+        (positions num_computed .. num_computed + horizon - 1); if the
+        pool is exhausted, preempt the youngest sequence (free blocks,
+        recompute later) — vLLM-style recompute preemption. A sequence
+        whose already-dispatched tokens cover its completion bound is
+        excluded: under deferred resolution its finish is still in
+        flight, and a further dispatch would run past max_model_len's
+        block table."""
         decodes = sorted(
             (s for s in self.seqs.values()
              if s.status is SequenceStatus.RUNNING
@@ -281,8 +332,7 @@ class Scheduler:
                 seq.block_ids.append(bid)
             if not preempted_self:
                 survivors.append(seq)
-        out.decodes = survivors
-        return out
+        return survivors
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         candidates = [

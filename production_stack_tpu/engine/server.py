@@ -2083,6 +2083,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-num-batched-tokens", type=int, default=None)
     p.add_argument("--prefill-buckets", default=None,
                    help="comma-separated token buckets, e.g. 128,512,2048")
+    p.add_argument("--attention-impl", default=None,
+                   choices=["auto", "ragged", "bucketed"],
+                   help="attention dispatch shape: 'ragged' packs prefill "
+                        "chunks and decode rows into ONE token-budget "
+                        "stream per step (single steady-state compile "
+                        "signature; --max-num-batched-tokens is the only "
+                        "shape knob), 'bucketed' keeps the legacy "
+                        "prefill-bucket path, 'auto' picks ragged when "
+                        "the Pallas kernels are usable")
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="pipeline stages (stage mesh axis; per-stage "
                         "submeshes + KV pools). Parity with the reference's "
@@ -2206,6 +2215,8 @@ def config_from_args(args) -> EngineConfig:
         cfg.scheduler.prefill_buckets = tuple(
             int(x) for x in args.prefill_buckets.split(",")
         )
+    if args.attention_impl:
+        cfg.attention_impl = args.attention_impl
     if args.speculative_ngram:
         cfg.scheduler.spec_ngram_k = args.speculative_ngram
         cfg.scheduler.spec_ngram_max = args.speculative_ngram_max

@@ -111,3 +111,55 @@ def paged_attention(
     probs = probs / jnp.maximum(denom, 1e-30)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
     return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # (T, H, D) packed query stream
+    kv_layer: jnp.ndarray,  # (N, bs, 2*KH, D) — one layer of the pool
+    block_tables: jnp.ndarray,  # (S, M) per-slot block rows
+    context_lens: jnp.ndarray,  # (S,) total context per slot
+    seq_ids: jnp.ndarray,  # (T,) owning slot per token (any value when padded)
+    q_positions: jnp.ndarray,  # (T,) absolute position per token, -1 = pad
+    tp: int = 1,
+    scale: float | None = None,
+    soft_cap: float = 0.0,
+) -> jnp.ndarray:
+    """XLA reference for the ragged kernel: the packed mixed
+    prefill+decode stream attended per token against its owning slot's
+    paged context (ops/ragged_paged_attention_pallas.py is the TPU hot
+    path; this is the CPU/fallback path and the parity oracle).
+
+    Padding tokens (q_positions < 0) produce finite garbage, exactly like
+    ``paged_attention``'s inactive rows — their logits are discarded
+    downstream."""
+    T, H, D = q.shape
+    n, block_size, KH2, _ = kv_layer.shape
+    KH = KH2 // 2
+    M = block_tables.shape[1]
+    G = H // KH
+    scale = scale if scale is not None else D**-0.5
+
+    sid = jnp.clip(seq_ids, 0, block_tables.shape[0] - 1)
+    # per-token context gather: (T, M, bs, 2KH, D) -> (T, Tc, KH, D)
+    gathered = kv_layer[block_tables[sid]].reshape(
+        T, M * block_size, KH2, D
+    )
+    k, v = split_kv(gathered, tp)
+
+    kv_pos = jnp.arange(M * block_size, dtype=jnp.int32)[None, :]  # (1, Tc)
+    valid_kv = kv_pos < context_lens[sid][:, None]  # (T, Tc)
+    causal = kv_pos <= q_positions[:, None]  # (T, Tc)
+    mask = valid_kv & causal & (q_positions >= 0)[:, None]
+
+    qg = q.reshape(T, KH, G, D)
+    scores = jnp.einsum(
+        "tkgd,tckd->tkgc", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if soft_cap:  # Gemma-2 score capping, before masking (HF order)
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    denom = probs.sum(axis=-1, keepdims=True)
+    probs = probs / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum("tkgc,tckd->tkgd", probs, v.astype(jnp.float32))
+    return out.reshape(T, H, D).astype(q.dtype)

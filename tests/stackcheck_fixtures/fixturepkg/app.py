@@ -7,4 +7,5 @@ def build_parser():
     p.add_argument("--host")
     p.add_argument("--port", type=int)
     p.add_argument("--max-model-len", type=int)
+    p.add_argument("--attention-impl", choices=["auto", "ragged", "bucketed"])
     return p

@@ -111,7 +111,8 @@ def test_config_drift():
     assert "overlay key routerSpec.typoScalar does not exist" in msgs
     # negatives: consumed keys and real flags stay silent
     for ok in ("maxModelLen", "replicaCount", "circuitBreaker",
-               "--host", "--max-model-len"):
+               "attentionImpl", "--host", "--max-model-len",
+               "--attention-impl"):
         assert ok not in msgs
 
 
