@@ -82,10 +82,7 @@ class AsyncEngine:
                         self.loop.call_soon_threadsafe(
                             self._deliver_error, rid, err
                         )
-                sched = self.engine.scheduler
-                rids = [s.request_id for s in list(sched.waiting)]
-                rids += list(sched.seqs)
-                for rid in rids:
+                for rid in self.engine.live_request_ids():
                     self.engine.abort_request(rid)
                 continue
             self.step_count += 1

@@ -353,6 +353,11 @@ class LLMEngine:
     def has_unfinished(self) -> bool:
         return self.scheduler.has_work()
 
+    def live_request_ids(self) -> list[str]:
+        """Request ids with scheduler state (waiting or running); aborting
+        each one releases its KV blocks."""
+        return self.scheduler.live_request_ids()
+
     # -- the step ------------------------------------------------------------
     def step(self) -> list[RequestOutput]:
         out = self.scheduler.schedule()

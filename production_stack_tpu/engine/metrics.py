@@ -199,6 +199,63 @@ class EngineStatsCollector:
             )
 
 
+class LifecycleCollector:
+    """Drain / watchdog lifecycle families, read at scrape time from a
+    server-provided snapshot callable — the drain state machine and the
+    stuck-step watchdog live on ``EngineServer``, not ``LLMEngine``, so
+    they can't ride ``EngineStatsCollector``."""
+
+    def __init__(self, source, model_name: str):
+        self.source = source
+        self.model_name = model_name
+
+    def collect(self):
+        s = self.source()
+        labels = ["model_name"]
+        lv = [self.model_name]
+
+        def gauge(name, doc, value):
+            g = GaugeMetricFamily(name, doc, labels=labels)
+            g.add_metric(lv, value)
+            return g
+
+        def counter(name, doc, value):
+            c = CounterMetricFamily(name, doc, labels=labels)
+            c.add_metric(lv, value)
+            return c
+
+        yield gauge(
+            "vllm:drain_state",
+            "1 while the engine is DRAINING (readiness 503, new requests "
+            "refused, in-flight sequences finishing under the drain "
+            "deadline)",
+            1.0 if s["draining"] else 0.0,
+        )
+        yield counter(
+            "vllm:drain_rejected_requests",
+            "Generation requests refused with 503 + Retry-After because "
+            "the engine was draining",
+            s["drain_rejected_total"],
+        )
+        yield counter(
+            "vllm:drain_aborted_seqs",
+            "Straggler sequences aborted when the drain deadline expired "
+            "(KV blocks freed; also counted in vllm:aborted_seqs_total)",
+            s["drain_aborted_total"],
+        )
+        yield gauge(
+            "vllm:watchdog_stalled",
+            "1 while the stuck-step watchdog sees no scheduler-step "
+            "progress with work queued (readiness answers 503)",
+            1.0 if s["watchdog_stalled"] else 0.0,
+        )
+        yield counter(
+            "vllm:watchdog_stalls",
+            "Stall episodes the stuck-step watchdog has detected",
+            s["watchdog_stalls_total"],
+        )
+
+
 _BUCKETS_TTFT = (
     0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5, 0.75,
     1.0, 2.5, 5.0, 7.5, 10.0,
@@ -264,6 +321,11 @@ class ServerMetrics:
             (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5, 5.0),
         )
+
+    def register_lifecycle(self, source) -> None:
+        """Attach the drain/watchdog snapshot source (EngineServer
+        provides it after it builds its lifecycle state)."""
+        self.registry.register(LifecycleCollector(source, self.model_name))
 
     def generate(self) -> bytes:
         from prometheus_client import generate_latest

@@ -129,6 +129,12 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.seqs)
 
+    def live_request_ids(self) -> list[str]:
+        """Every request id the scheduler still holds state for (waiting
+        or running). The drain straggler-abort and step-failure recovery
+        paths iterate this to free KV for all of them."""
+        return [s.request_id for s in list(self.waiting)] + list(self.seqs)
+
     def _decode_exhausted(self, seq: Sequence) -> bool:
         bound = min(
             seq.num_prompt_tokens + seq.sampling.max_tokens,

@@ -29,6 +29,7 @@ from production_stack_tpu import __version__
 from production_stack_tpu.engine.async_engine import AsyncEngine
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.lifecycle import StepWatchdog
 from production_stack_tpu.engine.metrics import ServerMetrics
 from production_stack_tpu.engine import tracing as etracing
 from production_stack_tpu.flight_recorder import FlightRecorder
@@ -206,7 +207,9 @@ class EngineServer:
                  otel_endpoint: Optional[str] = None,
                  otel_service_name: str = "tpu-engine",
                  otel_secure: bool = False,
-                 flight_recorder_size: int = 256):
+                 flight_recorder_size: int = 256,
+                 drain_deadline: float = 30.0,
+                 watchdog_stall_seconds: float = 0.0):
         self.config = config
         self.warmup_on_start = warmup_on_start
         self.model_name = config.model.name
@@ -225,6 +228,25 @@ class EngineServer:
 
         self.lora = LoraManager(self.engine)
         self.start_time = time.time()
+        # -- fleet lifecycle: drain state machine + stuck-step watchdog.
+        # SERVING → DRAINING (SIGTERM / POST /drain): readiness (GET
+        # /ready) answers 503 while /health stays truthful, new generation
+        # requests get 503 + Retry-After, in-flight sequences finish under
+        # drain_deadline, stragglers are then aborted (KV blocks freed).
+        self.drain_deadline = drain_deadline
+        self.draining = False
+        self.drain_reason: Optional[str] = None
+        # main() flips this on before run_app so SIGTERM drains instead of
+        # killing the loop; in-process test servers leave it off.
+        self.drain_on_sigterm = False
+        self._drain_t0: Optional[float] = None
+        self._drain_task: Optional[asyncio.Task] = None
+        self._exit_task: Optional[asyncio.Task] = None
+        self._drain_rejected = 0
+        self._drain_aborted = 0
+        self.watchdog = StepWatchdog(self.async_engine,
+                                     watchdog_stall_seconds)
+        self.metrics.register_lifecycle(self._lifecycle_snapshot)
 
     # -- app assembly --------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -252,12 +274,17 @@ class EngineServer:
         middlewares = (
             [fault_middleware(self.faults)] if self._faults_armed else []
         )
+        # drain gate AFTER fault injection: chaos drills must be able to
+        # exercise faults on the drain surface itself
+        middlewares.append(self._drain_middleware)
         app = web.Application(client_max_size=64 * 1024 * 1024,
                               middlewares=middlewares)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/ready", self.ready)
+        app.router.add_post("/drain", self.drain)
         app.router.add_get("/version", self.version)
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/detokenize", self.detokenize)
@@ -289,6 +316,9 @@ class EngineServer:
     async def _on_start(self, app) -> None:
         self.metrics.ensure_registered()
         await self.async_engine.start()
+        self.watchdog.start()
+        if self.drain_on_sigterm:
+            self._install_signal_drain()
         if self.warmup_on_start:
             t0 = time.monotonic()
             await self.async_engine.run_on_engine(lambda eng: eng.warmup())
@@ -296,13 +326,171 @@ class EngineServer:
                   f"{time.monotonic() - t0:.1f}s", flush=True)
 
     async def _on_stop(self, app) -> None:
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+        self.watchdog.stop()
         self.async_engine.stop()
         self.metrics.unregister()
         _release_jax_backend()
 
+    # -- drain state machine / readiness -------------------------------------
+    @web.middleware
+    async def _drain_middleware(self, request: web.Request, handler):
+        """While DRAINING, refuse NEW generation work with an honest 503 +
+        Retry-After (the router fails the attempt over to a live backend).
+        Requests already past this gate — live streams — keep running;
+        infra endpoints (/health, /ready, /metrics, /v1/models, tokenize)
+        stay up so probes and discovery keep seeing the truth."""
+        if (self.draining and request.method == "POST"
+                and (request.path.startswith("/v1/")
+                     or request.path in ("/pooling", "/rerank"))):
+            self._drain_rejected += 1
+            return web.json_response(
+                {"error": {"message": "engine is draining; no new "
+                           "requests are admitted",
+                           "type": "service_unavailable_error"}},
+                status=503,
+                headers={"Retry-After": f"{self.overload_retry_after:g}"},
+            )
+        return await handler(request)
+
+    def _lifecycle_snapshot(self) -> dict:
+        """Scrape-time source for the vllm:drain_* / vllm:watchdog_*
+        families (engine/metrics.py LifecycleCollector)."""
+        return {
+            "draining": self.draining,
+            "drain_rejected_total": self._drain_rejected,
+            "drain_aborted_total": self._drain_aborted,
+            "watchdog_stalled": self.watchdog.stalled,
+            "watchdog_stalls_total": self.watchdog.stalls_total,
+        }
+
+    def begin_drain(self, reason: str) -> bool:
+        """Flip SERVING → DRAINING (idempotent; returns False when already
+        draining) and start the drain watcher."""
+        if self.draining:
+            return False
+        self.draining = True
+        self.drain_reason = reason
+        self._drain_t0 = time.monotonic()
+        _log.warning(
+            "drain started (%s): %d in-flight request(s), deadline %.1fs",
+            reason, len(self._inflight), self.drain_deadline,
+        )
+        self._drain_task = asyncio.ensure_future(self._drain_watch())
+        return True
+
+    async def _drain_watch(self) -> None:
+        """Let in-flight work run to completion under the drain deadline;
+        abort stragglers through the same path as deadline expiry so KV
+        blocks are always freed and the process can exit bounded."""
+        assert self._drain_t0 is not None
+        deadline = self._drain_t0 + self.drain_deadline
+        while time.monotonic() < deadline:
+            if not self._inflight and not self.engine.has_unfinished():
+                _log.warning("drain complete in %.2fs: no in-flight work",
+                             time.monotonic() - self._drain_t0)
+                return
+            await asyncio.sleep(0.05)
+        # deadline expired — abort every sequence the scheduler still
+        # holds. Direct read + intake-queue abort (not run_on_engine): a
+        # wedged engine thread must not be able to hang the drain path.
+        rids = self.engine.live_request_ids()
+        for rid in rids:
+            self.async_engine.abort(rid)
+        self._drain_aborted += len(rids)
+        if rids:
+            _log.warning(
+                "drain deadline (%.1fs) expired: aborted %d straggler "
+                "sequence(s); their KV blocks are freed",
+                self.drain_deadline, len(rids),
+            )
+
+    def _install_signal_drain(self) -> None:
+        """Replace run_app's immediate-GracefulExit SIGTERM handler with
+        the drain path: K8s scale-down delivers SIGTERM and grants
+        terminationGracePeriodSeconds — exit only after the drain watcher
+        finished (or aborted) the in-flight work. SIGINT keeps the
+        immediate path (operator ctrl-C); signals arriving before the loop
+        runs are covered by main()'s pre-loop handler."""
+        import signal as _signal
+
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(_signal.SIGTERM, self._on_sigterm)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-unix: keep run_app's default handler
+
+    def _on_sigterm(self) -> None:
+        # The drain may already be running — the preStop hook POSTs
+        # /drain before kubelet delivers SIGTERM — but only SIGTERM owns
+        # process exit: an API drain must still terminate the pod once
+        # the signal lands, or it lingers until SIGKILL.
+        self.begin_drain("sigterm")
+        if self._exit_task is None:
+            self._exit_task = asyncio.ensure_future(
+                self._exit_when_drained())
+
+    async def _exit_when_drained(self) -> None:
+        if self._drain_task is not None:
+            try:
+                await self._drain_task
+            except asyncio.CancelledError:
+                return  # server torn down underneath us
+        # one beat for handlers to deliver final bytes / observe aborts
+        # before the server tears down
+        await asyncio.sleep(0.1)
+        asyncio.get_running_loop().call_soon(self._exit)
+
+    def _exit(self) -> None:
+        """Raise GracefulExit out of run_forever → run_app's cleanup path
+        (on_cleanup → _on_stop → JAX backend released). Called as a plain
+        loop callback so the BaseException propagates; tests replace this
+        attribute to observe exit without killing their loop."""
+        from aiohttp.web_runner import GracefulExit
+
+        raise GracefulExit()
+
     # -- infra endpoints ------------------------------------------------------
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "healthy"})
+
+    async def ready(self, request: web.Request) -> web.Response:
+        """Readiness, distinct from /health liveness: 503 while DRAINING
+        (stop sending new work; do NOT restart — live streams are
+        finishing) and while the stuck-step watchdog sees a wedged engine
+        (alive for debugging, unfit for traffic)."""
+        if self.draining:
+            remaining = 0.0
+            if self._drain_t0 is not None:
+                remaining = max(
+                    0.0,
+                    self._drain_t0 + self.drain_deadline - time.monotonic())
+            return web.json_response(
+                {"status": "draining", "reason": self.drain_reason,
+                 "inflight": len(self._inflight),
+                 "deadline_remaining": round(remaining, 3)},
+                status=503,
+            )
+        if self.watchdog.stalled:
+            return web.json_response(
+                {"status": "stalled",
+                 "stalled_for": round(self.watchdog.progress_age(), 3)},
+                status=503,
+            )
+        return web.json_response({"status": "ready"})
+
+    async def drain(self, request: web.Request) -> web.Response:
+        """Begin draining (idempotent). The helm preStop hook POSTs here
+        so new work stops flowing before K8s delivers SIGTERM; the SIGTERM
+        path owns the actual process exit."""
+        started = self.begin_drain("api")
+        return web.json_response({
+            "status": "draining",
+            "already_draining": not started,
+            "deadline": self.drain_deadline,
+            "inflight": len(self._inflight),
+        })
 
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": __version__})
@@ -1090,7 +1278,8 @@ class EngineServer:
             body.update(error_rate=s.error_rate, latency_ms=s.latency_ms,
                         drop_rate=s.drop_rate, stall_ms=s.stall_ms,
                         stream_abort_rate=s.stream_abort_rate,
-                        stream_abort_after_ms=s.stream_abort_after_ms)
+                        stream_abort_after_ms=s.stream_abort_after_ms,
+                        hang_after_ms=s.hang_after_ms)
         return web.json_response(body)
 
     # -- profiling ------------------------------------------------------------
@@ -1570,6 +1759,7 @@ class EngineServer:
                 request, gens, rids, rid, created, model, chat, t_start,
                 n_prompt, sampling,
                 include_usage=bool(so.get("include_usage")),
+                continuous_usage=bool(so.get("continuous_usage_stats")),
                 deadline=deadline,
             )
         return await self._full_response(
@@ -1892,7 +2082,7 @@ class EngineServer:
 
     async def _stream_response(self, request, gens, rids, rid, created, model,
                                chat, t_start, n_prompt, sampling,
-                               include_usage=False,
+                               include_usage=False, continuous_usage=False,
                                deadline=None) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -1931,6 +2121,11 @@ class EngineServer:
         # not to be one.
         holdback = max((len(s) for s in sampling.stop), default=1) - 1
         shared = {"first_token_t": None}
+        # per-choice generated-token counts for continuous_usage_stats
+        # (vLLM stream_options extension): every content chunk carries
+        # cumulative usage so a mid-stream death leaves the router's
+        # resume accounting token-exact, not event-count-approximate
+        kept_so_far: dict = {}
 
         want_lp = sampling.logprobs is not None
 
@@ -1999,10 +2194,17 @@ class EngineServer:
                         choice = {"index": idx, "text": delta,
                                   "logprobs": chunk_lp,
                                   "finish_reason": fr if done else None}
-                    await send(
-                        {"id": rid, "object": obj, "created": created,
-                         "model": model, "choices": [choice]}
-                    )
+                    chunk = {"id": rid, "object": obj, "created": created,
+                             "model": model, "choices": [choice]}
+                    if continuous_usage:
+                        kept_so_far[idx] = n_kept
+                        n_gen = sum(kept_so_far.values())
+                        chunk["usage"] = {
+                            "prompt_tokens": n_prompt,
+                            "completion_tokens": n_gen,
+                            "total_tokens": n_prompt + n_gen,
+                        }
+                    await send(chunk)
                 if finish_reason is not None:
                     break
             return n_kept
@@ -2123,6 +2325,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "unbounded)")
     p.add_argument("--overload-retry-after", type=float, default=1.0,
                    help="Retry-After seconds advertised on overload 429s")
+    p.add_argument("--drain-deadline", type=float, default=30.0,
+                   help="graceful-drain budget (seconds): on SIGTERM or "
+                        "POST /drain, in-flight sequences get this long "
+                        "to finish before stragglers are aborted (KV "
+                        "blocks freed) and the process exits; readiness "
+                        "(GET /ready) answers 503 for the whole window "
+                        "while /health stays truthful")
+    p.add_argument("--watchdog-stall-seconds", type=float, default=0.0,
+                   help="stuck-step watchdog: flip readiness (GET /ready) "
+                        "to 503 when no scheduler step completes for this "
+                        "many seconds while work is queued — a wedged XLA "
+                        "dispatch blocks the engine thread but not this "
+                        "detector thread, so the router ejects the pod "
+                        "within one probe interval. 0 = disabled")
     p.add_argument("--otel-endpoint", default=None,
                    help="OTLP gRPC endpoint; engine spans JOIN the "
                         "router's trace via the propagated traceparent "
@@ -2430,7 +2646,12 @@ def main(argv=None) -> None:
                           otel_endpoint=args.otel_endpoint,
                           otel_service_name=args.otel_service_name,
                           otel_secure=args.otel_secure,
-                          flight_recorder_size=args.flight_recorder_size)
+                          flight_recorder_size=args.flight_recorder_size,
+                          drain_deadline=args.drain_deadline,
+                          watchdog_stall_seconds=args.watchdog_stall_seconds)
+    # the real process drains on SIGTERM instead of dying mid-stream;
+    # in-process test servers keep run_app semantics untouched
+    server.drain_on_sigterm = True
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
     if broadcaster is not None:

@@ -95,6 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "— audio/images requests get a clean 501 when no "
                         "backend advertises the capability)")
     p.add_argument("--health-check-interval", type=float, default=10.0)
+    p.add_argument("--health-check-failure-threshold", type=int, default=3,
+                   help="consecutive failed probes before a static "
+                        "backend is ejected (flap damping); one success "
+                        "restores it")
     p.add_argument("--k8s-namespace", default="default")
     p.add_argument("--k8s-label-selector", default="")
     p.add_argument("--k8s-port", type=int, default=8000)
@@ -139,6 +143,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-deadline-propagation", dest="deadline_propagation",
                    action="store_false", default=True,
                    help="do not derive/propagate x-request-deadline")
+    p.add_argument("--stream-resume", dest="stream_resume",
+                   action="store_true", default=True,
+                   help="resume-from-prefix replay: when a backend dies "
+                        "mid-stream, re-dispatch to a surviving backend "
+                        "with the generated tokens appended to the prompt "
+                        "and splice the streams seamlessly (default on)")
+    p.add_argument("--no-stream-resume", dest="stream_resume",
+                   action="store_false")
     # stats
     p.add_argument("--engine-stats-interval", type=float, default=10.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -286,6 +298,8 @@ class RouterApp:
                     urls, models, labels,
                     health_check=args.static_backend_health_checks,
                     health_check_interval=args.health_check_interval,
+                    health_check_failure_threshold=(
+                        args.health_check_failure_threshold),
                     query_models=args.static_query_models,
                     model_types=types or None,
                 )
@@ -339,6 +353,7 @@ class RouterApp:
                 hedge_enabled=args.enable_hedging,
                 hedge_delay_ms=args.hedge_delay_ms,
                 deadline_propagation=args.deadline_propagation,
+                stream_resume=args.stream_resume,
             ),
             breaker_state_hook=lambda url, state:
                 m.circuit_breaker_state.labels(server=url).set(state),

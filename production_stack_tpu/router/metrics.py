@@ -87,6 +87,13 @@ retry_budget_remaining = Gauge(
 hedged_requests_total = Counter(
     "vllm:hedged_requests", "Hedged (speculative second) attempts fired"
 )
+stream_resumes_total = Counter(
+    "vllm:stream_resumes",
+    "Mid-stream backend failures replayed via resume-from-prefix "
+    "(outcome: resumed=spliced seamlessly, failed=in-band error sent, "
+    "budget_exhausted=retry budget refused the replay)",
+    ["outcome"],
+)
 # SLO engine (router/slo.py): multi-window burn rates per objective
 slo_burn_rate = Gauge(
     "vllm:slo_burn_rate",

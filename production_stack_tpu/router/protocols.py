@@ -30,6 +30,11 @@ class EndpointInfo:
     namespace: Optional[str] = None
     added_timestamp: float = dataclasses.field(default_factory=time.time)
     sleep: bool = False
+    # third endpoint state between healthy and gone: the pod is shutting
+    # down (K8s deletionTimestamp / readiness 503 "draining") or its
+    # stuck-step watchdog tripped. Routing skips draining endpoints for
+    # NEW requests while live streams keep flowing to them.
+    draining: bool = False
     # endpoint families the engine advertises in its /v1/models card
     # ("chat", "embeddings", "audio.transcriptions", ...). None = the
     # backend doesn't advertise (external vLLM/whisper pods) — no

@@ -183,10 +183,43 @@ class RequestService:
     # -- endpoint selection ---------------------------------------------------
     def _filter_endpoints(self, model: str) -> list[EndpointInfo]:
         eps = get_service_discovery().get_endpoint_info()
-        return [e for e in eps if e.serves(model) and not e.sleep]
+        eps = [e for e in eps if e.serves(model) and not e.sleep]
+        # draining endpoints (engine shutting down, watchdog-stalled, or
+        # pod stamped with a deletionTimestamp) keep their live streams
+        # but take no NEW requests — unless EVERY backend is draining
+        # (single-replica rollout): then keep the full list, because a
+        # draining engine still answers an honest 503 + Retry-After that
+        # failover and clients can act on (docs/resilience.md)
+        return [e for e in eps if not e.draining] or eps
 
     def resolve_model(self, model: str) -> str:
         return self.model_aliases.get(model, model)
+
+    def _resume_state(self, endpoint_path: str, body: dict,
+                      raw_body: Optional[bytes]) -> Optional["_ResumeState"]:
+        """Arm resume-from-prefix replay when the request shape supports
+        continuation semantics: a single streamed completion with a
+        string prompt (or a chat message list). Echo/logprobs/suffix and
+        n>1 are excluded — their outputs can't be spliced seamlessly."""
+        if not self.resilience.config.stream_resume or raw_body is not None:
+            return None
+        if not body.get("stream", False):
+            return None
+        chat = endpoint_path == "/v1/chat/completions"
+        if not chat and endpoint_path != "/v1/completions":
+            return None
+        if body.get("n") not in (None, 1):
+            return None
+        if any(body.get(k) for k in ("echo", "logprobs", "suffix",
+                                     "top_logprobs")):
+            return None
+        if chat:
+            if not isinstance(body.get("messages"), list) \
+                    or not body["messages"]:
+                return None
+        elif not isinstance(body.get("prompt"), str):
+            return None
+        return _ResumeState(chat=chat)
 
     # -- the main proxy -------------------------------------------------------
     async def route_general_request(
@@ -358,19 +391,23 @@ class RequestService:
                     t_start, deadline, hedge_delay,
                 )
 
+        resume = self._resume_state(endpoint_path, body, raw_body)
         attempts = 1 + max(self.max_failover_attempts, 0)
         failed: set[str] = set()
         last_error: Optional[str] = None
+        give_up = "failed"
         for attempt in range(attempts):
             if attempt > 0:
                 if deadline is not None and time.time() >= deadline:
-                    return web.json_response(
-                        {"error": {"message": "deadline exceeded during "
-                                   f"failover: {last_error}"}}, status=504)
+                    last_error = ("deadline exceeded during failover: "
+                                  f"{last_error}")
+                    give_up = "deadline"
+                    break
                 if not res.budget.try_acquire():
                     logger.warning(
                         "retry budget exhausted; shedding retry of request "
                         "%s", request_id)
+                    give_up = "budget_exhausted"
                     break
                 m.retry_budget_remaining.set(res.budget.remaining())
             avail = [e for e in endpoints if e.url not in failed] or endpoints
@@ -383,10 +420,29 @@ class RequestService:
             logger.info("Routing request %s to %s (attempt %d)", request_id,
                         url, attempt + 1)
             try:
-                return await self._proxy_and_stream(
+                resp = await self._proxy_and_stream(
                     request, endpoint_path, body, url, resolved, request_id,
                     t_start, raw_body=raw_body, deadline=deadline,
+                    resume=resume,
                 )
+                if resume is not None and resume.resumed:
+                    # every mid-stream death was spliced over seamlessly
+                    m.stream_resumes_total.labels(outcome="resumed").inc(
+                        resume.resumed)
+                return resp
+            except StreamInterrupted as e:
+                # backend died with the client stream already prepared:
+                # the next loop iteration replays from the generated
+                # prefix (breaker already told in _attempt)
+                last_error = str(e)
+                failed.add(url)
+                m.request_errors_total.labels(
+                    server=url, model=resolved, error_type="stream_abort"
+                ).inc()
+                logger.warning(
+                    "backend %s died mid-stream for request %s after %d "
+                    "token(s) (%s); resuming from generated prefix", url,
+                    request_id, e.state.completion_tokens(), e)
             except BackendError as e:
                 last_error = str(e)
                 failed.add(url)
@@ -399,9 +455,38 @@ class RequestService:
                     "backend %s failed for request %s (%s); rerouting", url,
                     request_id, e,
                 )
+        if resume is not None and resume.resp is not None:
+            # stream already prepared: a JSON error can't be sent, so
+            # terminate in-band like the engine's deadline path does
+            outcome = "failed" if give_up == "deadline" else give_up
+            return await self._fail_resumed_stream(resume, last_error,
+                                                   outcome)
+        if give_up == "deadline":
+            return web.json_response(
+                {"error": {"message": last_error}}, status=504)
         return web.json_response(
             {"error": {"message": f"all backends failed: {last_error}"}}, status=503
         )
+
+    async def _fail_resumed_stream(self, resume: "_ResumeState",
+                                   last_error: Optional[str],
+                                   outcome: str) -> web.StreamResponse:
+        """Every replay avenue is gone (no surviving backend, deadline,
+        or retry budget) with the client mid-stream: send an in-band
+        error event and a clean [DONE] instead of a raw connection
+        reset, and record the loss."""
+        m.stream_resumes_total.labels(outcome=outcome).inc()
+        err = {"error": {"message": "stream interrupted and could not be "
+                         f"resumed: {last_error}",
+                         "type": "stream_resume_error"}}
+        resp = resume.resp
+        try:
+            await resp.write(f"data: {json.dumps(err)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, aiohttp.ClientError):
+            pass  # client is gone too; nothing left to salvage
+        return resp
 
     def _request_deadline(self, request: web.Request,
                           t_start: float) -> Optional[float]:
@@ -517,23 +602,37 @@ class RequestService:
     async def _proxy_and_stream(
         self, request, endpoint_path, body, url, model, request_id, t_start,
         raw_body: Optional[bytes] = None, deadline: Optional[float] = None,
+        resume: Optional["_ResumeState"] = None,
     ) -> web.StreamResponse:
         """One backend attempt. Raises BackendError before any byte has been
         relayed (so failover is safe); after first byte, errors terminate the
-        stream. ``raw_body`` (multipart audio) is relayed byte-identical
-        instead of re-serialising ``body``."""
+        stream — unless ``resume`` is armed, in which case a mid-stream death
+        raises StreamInterrupted carrying the prepared response and generated
+        prefix so the failover loop can replay the remainder. ``raw_body``
+        (multipart audio) is relayed byte-identical instead of re-serialising
+        ``body``."""
         monitor = get_request_stats_monitor()
         stream = bool(body.get("stream", False))
         strip_usage = False
+        strip_chunk_usage = False
         if stream and raw_body is None:
             # ask the engine for the final usage chunk so streamed requests
             # feed token accounting; if the client didn't request it, the
             # chunk is stripped from the relayed stream (OpenAI parity)
             so = body.get("stream_options")
             so = so if isinstance(so, dict) else {}
+            inject = {}
             if not so.get("include_usage"):
-                body = {**body, "stream_options": {**so, "include_usage": True}}
+                inject["include_usage"] = True
                 strip_usage = True
+            if resume is not None and not so.get("continuous_usage_stats"):
+                # per-chunk cumulative usage keeps the resume accounting
+                # token-exact (one SSE event can carry several tokens);
+                # the injected field is stripped before relay
+                inject["continuous_usage_stats"] = True
+                strip_chunk_usage = True
+            if inject:
+                body = {**body, "stream_options": {**so, **inject}}
         monitor.on_new_request(url, request_id, time.time(), model=model)
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
@@ -557,7 +656,8 @@ class RequestService:
             resp = await self._attempt(
                 request, endpoint_path, body, url, model, request_id, t_start,
                 monitor, stream, headers, span_cm, strip_usage=strip_usage,
-                raw_body=raw_body,
+                strip_chunk_usage=strip_chunk_usage,
+                raw_body=raw_body, resume=resume,
             )
             if attempt_info is not None:
                 attempt_info["status"] = resp.status
@@ -566,13 +666,24 @@ class RequestService:
             if attempt_info is not None:
                 attempt_info["error"] = e.kind
             raise
+        except StreamInterrupted:
+            if attempt_info is not None:
+                attempt_info["error"] = "stream_abort"
+            raise
         finally:
             span_cm.__exit__(None, None, None)
 
     async def _attempt(self, request, endpoint_path, body, url, model,
                        request_id, t_start, monitor, stream, headers,
-                       span_cm, strip_usage=False,
-                       raw_body: Optional[bytes] = None) -> web.StreamResponse:
+                       span_cm, strip_usage=False, strip_chunk_usage=False,
+                       raw_body: Optional[bytes] = None,
+                       resume: Optional["_ResumeState"] = None,
+                       ) -> web.StreamResponse:
+        is_continuation = resume is not None and resume.resp is not None
+        if is_continuation:
+            # replay: everything relayed so far becomes prompt prefix
+            body = _continuation_body(body, resume)
+            resume.start_attempt()
         try:
             if raw_body is not None:  # multipart: original bytes + boundary
                 backend = await self.session.post(
@@ -611,13 +722,20 @@ class RequestService:
                                retry_after=retry_after)
 
         self.resilience.breaker.record_success(url, time.time() - t_start)
-        resp = web.StreamResponse(
-            status=backend.status,
-            headers={
-                **sanitize_headers(backend.headers),
-                "x-request-id": request_id,
-            },
-        )
+        if is_continuation:
+            # splice into the client response prepared by the attempt
+            # that died; the continuation backend's status/headers are
+            # consumed here, never seen by the client
+            resume.resumed += 1
+            resp = resume.resp
+        else:
+            resp = web.StreamResponse(
+                status=backend.status,
+                headers={
+                    **sanitize_headers(backend.headers),
+                    "x-request-id": request_id,
+                },
+            )
         first = True
         n_output_tokens = 0
         buffer = b""
@@ -625,36 +743,64 @@ class RequestService:
         strip = (strip_usage and backend.status == 200
                  and backend.headers.get("Content-Type", "")
                  .startswith("text/event-stream"))
+        # event-split relay when stripping usage OR accumulating resume
+        # state; writing event+sep back is byte-preserving, so the happy
+        # path stays bit-identical to a raw relay
+        use_events = strip or (resume is not None and backend.status == 200)
         pending = b""
         try:
-            await resp.prepare(request)
+            if not is_continuation:
+                await resp.prepare(request)
+                if resume is not None and backend.status == 200:
+                    # from here on a backend death can't fail over — it
+                    # must resume into this prepared response
+                    resume.resp = resp
             async for chunk in backend.content.iter_any():
                 if first:
                     monitor.on_request_response(url, request_id, time.time())
                     first = False
                 buffer = (buffer + chunk)[-65536:]  # tail only, usage lives there
-                if not strip:
+                if not use_events:
                     await resp.write(chunk)
                     continue
                 # SSE-event-aware relay: drop the router-injected usage-only
-                # chunk the client didn't ask for
+                # chunk the client didn't ask for, fold events into the
+                # resume accumulator, rewrite continuation events to look
+                # like the original stream
                 pending += chunk
                 while True:
                     event, sep, rest = _split_sse_event(pending)
                     if sep is None:
                         break
                     pending = rest
-                    if not _is_usage_only_event(event):
-                        await resp.write(event + sep)
+                    if resume is not None:
+                        resume.observe(event)
+                    if strip and _is_usage_only_event(event):
+                        continue
+                    if strip_chunk_usage:
+                        event = _strip_inline_usage(event)
+                    if is_continuation:
+                        # the continuation opens its own stream: drop its
+                        # fresh role delta (the client already got one)
+                        # and make its events look like the original's
+                        if resume.chat and _is_role_only_event(event):
+                            continue
+                        event = resume.rewrite(event)
+                    await resp.write(event + sep)
             if pending:
                 await resp.write(pending)
             await resp.write_eof()
-        except aiohttp.ClientError:
+        except aiohttp.ClientError as e:
             # backend died mid-stream (e.g. stream_abort_rate fault); the
-            # client already got bytes so we can't fail over, but the
-            # breaker should know
+            # client already got bytes so a clean failover is impossible,
+            # but with resume armed the failover loop can replay from the
+            # generated prefix. Either way the breaker should know.
             status_label = "stream_abort"
             self.resilience.breaker.record_failure(url, "stream_abort")
+            if resume is not None and resume.resp is not None \
+                    and not resume.finished:
+                raise StreamInterrupted(
+                    resume, f"{type(e).__name__}: {e}") from e
             raise
         except (ConnectionResetError, asyncio.CancelledError):
             status_label = "client_disconnect"
@@ -871,6 +1017,143 @@ class BackendError(Exception):
         self.retry_after = retry_after
 
 
+class _ResumeState:
+    """Accumulator for resume-from-prefix stream replay.
+
+    While a streaming response relays, every SSE event is parsed on the
+    side to accumulate the generated text. If the backend dies
+    mid-stream, the failover loop re-dispatches to a surviving backend
+    with that text appended to the prompt (continuation semantics) and
+    splices the continuation into the SAME prepared client response —
+    events are rewritten to the original stream id/created and the final
+    usage chunk is adjusted, so the client sees one seamless completion.
+    Under greedy (temperature-0) sampling the spliced text is
+    bit-identical to an uninterrupted run; under sampling the suffix is
+    a fresh draw from the same prefix (docs/resilience.md)."""
+
+    def __init__(self, chat: bool):
+        self.chat = chat
+        #: the prepared client StreamResponse (set after first prepare);
+        #: its existence is what makes a plain failover impossible
+        self.resp: Optional[web.StreamResponse] = None
+        self.stream_id: Optional[str] = None
+        self.created: Optional[int] = None
+        self.text = ""          # generated text relayed so far
+        self.chunks = 0         # content-bearing events relayed so far
+        self.offset = 0         # chunks relayed before the CURRENT attempt
+        self.finished = False   # finish_reason or [DONE] seen
+        self.resumed = 0        # continuation attempts started
+        #: completion tokens relayed by FINISHED attempts (token-exact)
+        self.tokens_base = 0
+        #: cumulative completion_tokens reported by the current attempt's
+        #: per-chunk usage (continuous_usage_stats), None until seen
+        self.attempt_tokens: Optional[int] = None
+
+    def completion_tokens(self) -> int:
+        """Completion tokens relayed so far. One SSE event can carry
+        several tokens (fused engine steps, stop-string holdback flush),
+        so the per-chunk usage the router requests via
+        continuous_usage_stats is authoritative; the content-event count
+        is the floor for backends that ignore the flag."""
+        attempt = self.chunks - self.offset
+        if self.attempt_tokens is not None:
+            attempt = max(self.attempt_tokens, attempt)
+        return self.tokens_base + attempt
+
+    def start_attempt(self) -> None:
+        """Snapshot the accounting before a continuation attempt: what
+        was relayed so far becomes the fixed prefix the new backend is
+        asked to continue from."""
+        self.tokens_base = self.completion_tokens()
+        self.offset = self.chunks
+        self.attempt_tokens = None
+
+    def observe(self, event: bytes) -> None:
+        """Fold one raw SSE event into the accumulated state."""
+        ev = event.strip()
+        if not ev.startswith(b"data: "):
+            return
+        if ev == b"data: [DONE]":
+            self.finished = True
+            return
+        try:
+            data = json.loads(ev[6:])
+        except Exception:
+            return
+        if self.stream_id is None and data.get("id"):
+            self.stream_id = data.get("id")
+            self.created = data.get("created")
+        usage = data.get("usage")
+        if isinstance(usage, dict) \
+                and isinstance(usage.get("completion_tokens"), int):
+            self.attempt_tokens = usage["completion_tokens"]
+        for c in data.get("choices") or []:
+            piece = ((c.get("delta") or {}).get("content") if self.chat
+                     else c.get("text"))
+            if piece:
+                self.text += piece
+                self.chunks += 1
+            if c.get("finish_reason"):
+                self.finished = True
+
+    def rewrite(self, event: bytes) -> bytes:
+        """Make a continuation event look like part of the original
+        stream: original id/created, usage adjusted to cover the whole
+        completion (completion_tokens += tokens relayed by the dead
+        attempts; the continuation reports only its own)."""
+        ev = event.strip()
+        if not ev.startswith(b"data: ") or ev == b"data: [DONE]":
+            return event
+        try:
+            data = json.loads(ev[6:])
+        except Exception:
+            return event
+        if self.stream_id is not None:
+            data["id"] = self.stream_id
+        if self.created is not None:
+            data["created"] = self.created
+        usage = data.get("usage")
+        if isinstance(usage, dict) and self.tokens_base:
+            usage["completion_tokens"] = (
+                (usage.get("completion_tokens") or 0) + self.tokens_base)
+            usage["total_tokens"] = (
+                (usage.get("prompt_tokens") or 0)
+                + usage["completion_tokens"])
+        return b"data: " + json.dumps(data).encode()
+
+
+class StreamInterrupted(Exception):
+    """A streaming backend died AFTER the client response was prepared.
+    Too late for a clean failover (headers and bytes are out), but not
+    too late to resume: carries the :class:`_ResumeState` so the
+    failover loop can replay the remainder from the generated prefix."""
+
+    def __init__(self, state: _ResumeState, msg: str):
+        super().__init__(msg)
+        self.state = state
+
+
+def _continuation_body(body: dict, state: _ResumeState) -> dict:
+    """The re-dispatch request: original request with the generated
+    prefix appended (completions: onto the prompt; chat: as a trailing
+    assistant message with continue_final_message) and the token budget
+    reduced by what was already streamed. A greedy engine picks up
+    exactly where the dead one stopped."""
+    out = dict(body)
+    if state.chat:
+        msgs = list(body.get("messages") or [])
+        msgs.append({"role": "assistant", "content": state.text})
+        out["messages"] = msgs
+        out["continue_final_message"] = True
+        out["add_generation_prompt"] = False
+    else:
+        out["prompt"] = (body.get("prompt") or "") + state.text
+    for key in ("max_tokens", "max_completion_tokens"):
+        if isinstance(body.get(key), int):
+            out[key] = max(1, body[key] - state.completion_tokens())
+    return out
+
+
 def _overload_retry_after(backend) -> Optional[float]:
     """Seconds from a 429's Retry-After header, or None when the 429
     should be relayed to the client verbatim (no/malformed header)."""
@@ -896,6 +1179,56 @@ def _split_sse_event(buf: bytes):
     if i_lf >= 0:
         return buf[:i_lf], b"\n\n", buf[i_lf + 2:]
     return buf, None, b""
+
+
+def _strip_inline_usage(event: bytes) -> bytes:
+    """Remove the router-injected continuous_usage_stats field from a
+    content-bearing chunk before relay — the client asked for a plain
+    OpenAI stream. Final chunks (finish_reason set, or the usage-only
+    include_usage chunk) pass through untouched so client-requested
+    usage reporting still works."""
+    if b'"usage"' not in event:  # cheap pre-filter: keep the per-token
+        return event             # delta hot path byte-preserving
+    ev = event.strip()
+    if not ev.startswith(b"data: ") or ev == b"data: [DONE]":
+        return event
+    try:
+        data = json.loads(ev[6:])
+    except Exception:
+        return event
+    choices = data.get("choices")
+    if not choices or "usage" not in data:
+        return event
+    if any(c.get("finish_reason") for c in choices):
+        return event
+    del data["usage"]
+    return b"data: " + json.dumps(data).encode()
+
+
+def _is_role_only_event(event: bytes) -> bool:
+    """True for a chat chunk whose every choice is a bare role delta (no
+    content, no finish_reason) — the stream-opening chunk. A continuation
+    backend emits its own; relaying it would hand the client a second
+    'assistant' role marker mid-stream."""
+    if b'"role"' not in event:
+        return False
+    ev = event.strip()
+    if not ev.startswith(b"data: ") or ev == b"data: [DONE]":
+        return False
+    try:
+        data = json.loads(ev[6:])
+    except Exception:
+        return False
+    choices = data.get("choices")
+    if not choices or data.get("usage"):
+        return False
+    for c in choices:
+        delta = c.get("delta")
+        if not isinstance(delta, dict) or "role" not in delta:
+            return False
+        if delta.get("content") or c.get("finish_reason"):
+            return False
+    return True
 
 
 def _is_usage_only_event(event: bytes) -> bool:

@@ -90,6 +90,14 @@ class ResilienceConfig:
     #: propagate/derive ``x-request-deadline`` toward engines.
     deadline_propagation: bool = True
 
+    # -- mid-stream resume --
+    #: when a backend dies mid-stream, re-dispatch the request to a
+    #: surviving backend with the already-generated tokens appended to
+    #: the prompt (continuation semantics) and splice the streams into
+    #: one seamless completion. Resumes draw from the retry budget like
+    #: any other failover attempt.
+    stream_resume: bool = True
+
 
 @dataclass
 class _BackendState:

@@ -280,12 +280,17 @@ def breaker_filter(endpoints: list[EndpointInfo]) -> list[EndpointInfo]:
     """Drop endpoints whose circuit breaker is open before the routing
     logic sees them, so ejected backends stop receiving first attempts.
 
-    HALF_OPEN backends stay in the pool only while they have probe slots
-    free; if every endpoint is ejected the full list is returned
-    (degraded beats unreachable). No-op when the resilience layer is not
-    initialized (e.g. unit tests driving a Router directly)."""
+    Draining endpoints (engine shutting down or stuck-step watchdog
+    tripped) are dropped the same way: they keep serving their live
+    streams but must not receive first attempts. HALF_OPEN backends stay
+    in the pool only while they have probe slots free; if every endpoint
+    is ejected the full list is returned (degraded beats unreachable —
+    a draining engine at least answers an honest 503). No-op when the
+    resilience layer is not initialized (e.g. unit tests driving a
+    Router directly)."""
     from production_stack_tpu.router.resilience import get_resilience
 
+    endpoints = [e for e in endpoints if not e.draining] or endpoints
     res = get_resilience()
     if res is None or not endpoints:
         return endpoints

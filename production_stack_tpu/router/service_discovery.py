@@ -84,6 +84,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         model_labels: Optional[list[str]] = None,
         health_check: bool = False,
         health_check_interval: float = 10.0,
+        health_check_failure_threshold: int = 3,
         query_models: bool = False,
         aliases: Optional[dict[str, str]] = None,
         model_types: Optional[list[Optional[str]]] = None,
@@ -94,6 +95,11 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self.model_labels = model_labels or [None] * len(urls)
         self.health_check = health_check
         self.health_check_interval = health_check_interval
+        # flap damping: a single dropped probe (GC pause, transient
+        # network blip) must not eject a backend that is mid-stream for
+        # dozens of clients. N consecutive failures eject; ONE success
+        # restores (recovery should be fast, ejection deliberate).
+        self.failure_threshold = max(1, int(health_check_failure_threshold))
         self.query_models = query_models
         self.model_types = model_types or [None] * len(urls)
         if len(self.model_types) != len(urls):
@@ -112,6 +118,11 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 )
         self.unhealthy: set[str] = set()
         self.sleeping: set[str] = set()
+        # backends whose /ready probe said 503 ("draining"/"stalled"):
+        # kept in the endpoint list (live streams still flow) but
+        # flagged so routing skips them for NEW requests
+        self.draining_urls: set[str] = set()
+        self._fail_counts: dict[str, int] = {}
         self._task: Optional[asyncio.Task] = None
         self._queried_models: dict[str, list[str]] = {}
         self._queried_caps: dict[str, frozenset[str]] = {}
@@ -135,6 +146,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     model_info={m: ModelInfo(m) for m in models},
                     model_label=self.model_labels[i],
                     sleep=url in self.sleeping,
+                    draining=url in self.draining_urls,
                     capabilities=caps,
                 )
             )
@@ -151,7 +163,44 @@ class StaticServiceDiscovery(ServiceDiscovery):
     def set_sleep(self, url: str, sleeping: bool) -> None:
         (self.sleeping.add if sleeping else self.sleeping.discard)(url)
 
+    async def _probe_readiness(
+        self, session: aiohttp.ClientSession, url: str
+    ) -> None:
+        """Classify the third endpoint state. GET /ready answers 200
+        (taking traffic), or 503 while the engine drains or its stuck-step
+        watchdog tripped — in both cases the pod is ALIVE and must keep
+        its live streams, so it stays in the endpoint list flagged
+        draining rather than being ejected. Backends without a /ready
+        surface (external vLLM/whisper) 404 or error: fall back to the
+        /v1/models health probe alone, no draining classification."""
+        try:
+            async with session.get(
+                f"{url}/ready", timeout=aiohttp.ClientTimeout(total=5)
+            ) as resp:
+                if resp.status == 200:
+                    if url in self.draining_urls:
+                        logger.info("endpoint %s ready again, restoring "
+                                    "to rotation", url)
+                    self.draining_urls.discard(url)
+                elif resp.status == 503:
+                    if url not in self.draining_urls:
+                        try:
+                            why = (await resp.json()).get("status", "draining")
+                        except Exception:
+                            why = "draining"
+                        logger.warning(
+                            "endpoint %s reports %s; skipping for new "
+                            "requests (live streams keep flowing)", url, why)
+                    self.draining_urls.add(url)
+                else:
+                    self.draining_urls.discard(url)
+        except Exception:
+            # unreachable: the /v1/models probe below decides health;
+            # a definitive draining verdict needs an actual 503
+            pass
+
     async def _probe(self, session: aiohttp.ClientSession, url: str) -> None:
+        await self._probe_readiness(session, url)
         try:
             async with session.get(
                 f"{url}/v1/models", timeout=aiohttp.ClientTimeout(total=5)
@@ -179,11 +228,26 @@ class StaticServiceDiscovery(ServiceDiscovery):
         except Exception:
             ok = False
         if ok:
+            self._fail_counts[url] = 0
+            if url in self.unhealthy:
+                # one success restores: recovery should be fast even
+                # though ejection is deliberate
+                logger.info("endpoint %s passed health check, restoring", url)
             self.unhealthy.discard(url)
         else:
-            if url not in self.unhealthy:
-                logger.warning("endpoint %s failed health check, removing", url)
-            self.unhealthy.add(url)
+            n = self._fail_counts.get(url, 0) + 1
+            self._fail_counts[url] = n
+            if n < self.failure_threshold:
+                logger.info(
+                    "endpoint %s failed health check (%d/%d consecutive "
+                    "before ejection)", url, n, self.failure_threshold)
+            elif url not in self.unhealthy:
+                # log the TRANSITION only — a dead backend must not
+                # re-log every probe interval
+                logger.warning(
+                    "endpoint %s failed %d consecutive health checks, "
+                    "removing", url, n)
+                self.unhealthy.add(url)
 
     async def _health_worker(self) -> None:
         async with aiohttp.ClientSession() as session:
@@ -287,9 +351,14 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 await asyncio.sleep(2)
 
     @staticmethod
+    def _is_terminating(pod: dict) -> bool:
+        """deletionTimestamp set: K8s has begun deleting the pod (preStop
+        hook running, grace period ticking). The engine is still serving
+        its in-flight streams — draining, not gone."""
+        return bool(pod.get("metadata", {}).get("deletionTimestamp"))
+
+    @staticmethod
     def _is_ready(pod: dict) -> bool:
-        if pod.get("metadata", {}).get("deletionTimestamp"):
-            return False
         statuses = pod.get("status", {}).get("containerStatuses") or []
         return bool(statuses) and all(c.get("ready") for c in statuses)
 
@@ -301,7 +370,25 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         if not name:
             return
         pod_ip = pod.get("status", {}).get("podIP")
-        if etype == "DELETED" or not self._is_ready(pod) or not pod_ip:
+        if etype == "DELETED" or not pod_ip:
+            if name in self.endpoints:
+                logger.info("engine pod %s removed", name)
+                del self.endpoints[name]
+            return
+        if self._is_terminating(pod):
+            # the instant K8s stamps deletionTimestamp — before the
+            # readiness probe has a chance to fail — stop sending NEW
+            # requests while the endpoint keeps serving live streams
+            # through its drain window. Never (re-)register a
+            # terminating pod.
+            ep = self.endpoints.get(name)
+            if ep is not None and not ep.draining:
+                ep.draining = True
+                logger.info(
+                    "engine pod %s terminating; draining (live streams "
+                    "keep flowing until the pod exits)", name)
+            return
+        if not self._is_ready(pod):
             if name in self.endpoints:
                 logger.info("engine pod %s removed", name)
                 del self.endpoints[name]

@@ -1,0 +1,203 @@
+"""Scenario chaos harness: timed fleet events over in-process fake-engine
+fleets.
+
+The resilience drills up to now each hand-rolled their failure choreography
+(arm a fault, fire requests, assert). This module gives the choreography a
+first-class shape: a :class:`ChaosFleet` of fake engines behind real
+listening sockets, and a :class:`ChaosScenario` that applies a script of
+timed :class:`ChaosEvent`\\ s — kill backend 1 at t=0.2s, SIGTERM (drain)
+backend 0 at t=0.5s, wedge backend 2's dispatch at t=1s — while the test
+drives client traffic through a router. Everything runs in one process on
+one event loop, so the drills are deterministic tier-1 tests instead of
+manual pod-kill runbooks.
+
+Event actions (``ChaosEvent.action``):
+
+  kill        abort every live connection AND close the listening socket:
+              mid-stream clients see a connection reset, new connects are
+              refused — a pod OOM-kill from the router's viewpoint
+  partition   same teardown as ``kill`` but intended to be healed later —
+              a network partition, not a dead process (state survives)
+  heal        re-open the listening socket closed by kill/partition
+  drain       POST /drain — what the K8s preStop hook does on SIGTERM;
+              the fake flips DRAINING (readiness 503, new work 503)
+  hang        arm the ``hang_after_ms`` fault: requests are admitted and
+              then never progress, modelling a wedged device dispatch —
+              drives the stuck-step watchdog / readiness-ejection path
+  fault       arm an arbitrary fault spec string (testing/faults.py)
+  clear       clear all faults on the target
+
+Scenarios drive the FAKE fleet; real-engine drain/watchdog behavior is
+exercised directly against EngineServer in tests (the fake mirrors its
+/ready, /drain and 503 surfaces so router-side drills see the same
+contract either way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.testing.faults import FaultSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One timed action against one backend of the fleet."""
+
+    at: float           # seconds after ChaosScenario.run() starts
+    action: str         # kill | partition | heal | drain | hang | fault | clear
+    target: int         # backend index in the fleet
+    spec: Optional[str] = None  # fault spec for action in ("hang", "fault")
+
+    _ACTIONS = ("kill", "partition", "heal", "drain", "hang", "fault",
+                "clear")
+
+    def __post_init__(self):
+        if self.action not in self._ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {self.action!r}; known: "
+                f"{', '.join(self._ACTIONS)}")
+        if self.action == "fault" and not self.spec:
+            raise ValueError("action 'fault' needs a spec string")
+
+
+class ChaosFleet:
+    """N fake engines on real sockets, with the levers to hurt them.
+
+    The listening sockets are real (TestServer), so connection resets and
+    refused connects exercise the router's actual aiohttp error paths —
+    not mocks of them.
+    """
+
+    def __init__(self, n: int, model: str = "fake-model",
+                 tokens_per_second: float = 200.0, ttft: float = 0.005,
+                 watchdog_stall_seconds: float = 0.0, **engine_kwargs):
+        self.engines = [
+            FakeEngine(model=model, tokens_per_second=tokens_per_second,
+                       ttft=ttft,
+                       watchdog_stall_seconds=watchdog_stall_seconds,
+                       **engine_kwargs)
+            for _ in range(n)
+        ]
+        self.servers: list[TestServer] = []
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def start(self) -> list[str]:
+        for e in self.engines:
+            ts = TestServer(e.build_app())
+            await ts.start_server()
+            self.servers.append(ts)
+        self._session = aiohttp.ClientSession()
+        return self.urls
+
+    async def stop(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+        for ts in self.servers:
+            try:
+                await ts.close()
+            except Exception:
+                pass  # killed servers are already partially torn down
+
+    @property
+    def urls(self) -> list[str]:
+        return [f"http://127.0.0.1:{ts.port}" for ts in self.servers]
+
+    def url(self, i: int) -> str:
+        return self.urls[i]
+
+    # -- the levers ---------------------------------------------------------
+
+    async def kill(self, i: int) -> None:
+        """Abrupt death: abort live connections (mid-stream clients see a
+        reset, not a clean close) and stop listening (new connects are
+        refused). The engine object survives so tests can still read its
+        counters post-mortem."""
+        ts = self.servers[i]
+        runner = ts.runner
+        for site in list(runner.sites):
+            await site.stop()
+        server = getattr(runner, "server", None)
+        for proto in list(getattr(server, "connections", []) or []):
+            transport = getattr(proto, "transport", None)
+            if transport is not None:
+                transport.abort()
+
+    async def heal(self, i: int) -> None:
+        """Re-open the listening socket closed by kill/partition on the
+        SAME port, so discovered URLs stay valid across the partition."""
+        ts = self.servers[i]
+        site = web.TCPSite(ts.runner, host=ts.host, port=ts.port)
+        await site.start()
+
+    async def drain(self, i: int) -> None:
+        """What the preStop hook does on pod SIGTERM: POST /drain over
+        the wire, exercising the HTTP surface rather than engine state."""
+        async with self._session.post(f"{self.url(i)}/drain") as r:
+            r.raise_for_status()
+
+    def hang(self, i: int, after_ms: float = 1.0) -> None:
+        """Wedge the backend's generation path: requests are admitted and
+        then never progress (the stuck-step failure mode)."""
+        self.engines[i].fault_state.set(
+            FaultSpec.parse(f"hang_after_ms={after_ms}"))
+
+    def fault(self, i: int, spec: str) -> None:
+        self.engines[i].fault_state.set(FaultSpec.parse(spec))
+
+    def clear(self, i: int) -> None:
+        self.engines[i].fault_state.set(None)
+
+
+class ChaosScenario:
+    """Apply a script of timed events to a fleet.
+
+    ``run()`` sleeps toward each event's offset and applies it; the test
+    drives its workload concurrently (``asyncio.ensure_future(s.run())``)
+    or awaits ``run()`` when the workload is itself event-driven. Applied
+    events are recorded in ``self.log`` as (offset_seconds, event).
+    """
+
+    def __init__(self, fleet: ChaosFleet, events: list[ChaosEvent]):
+        self.fleet = fleet
+        self.events = sorted(events, key=lambda e: e.at)
+        self.log: list[tuple[float, ChaosEvent]] = []
+
+    async def run(self) -> list[tuple[float, ChaosEvent]]:
+        t0 = time.monotonic()
+        for ev in self.events:
+            delay = ev.at - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await self._apply(ev)
+            self.log.append((round(time.monotonic() - t0, 4), ev))
+        return self.log
+
+    async def _apply(self, ev: ChaosEvent) -> None:
+        logger.info("chaos: %s backend %d%s", ev.action, ev.target,
+                    f" ({ev.spec})" if ev.spec else "")
+        fleet = self.fleet
+        if ev.action in ("kill", "partition"):
+            await fleet.kill(ev.target)
+        elif ev.action == "heal":
+            await fleet.heal(ev.target)
+        elif ev.action == "drain":
+            await fleet.drain(ev.target)
+        elif ev.action == "hang":
+            fleet.hang(ev.target,
+                       float(ev.spec) if ev.spec else 1.0)
+        elif ev.action == "fault":
+            fleet.fault(ev.target, ev.spec)
+        elif ev.action == "clear":
+            fleet.clear(ev.target)

@@ -32,11 +32,18 @@ class FakeEngine:
                  ttft: float = 0.02, max_tokens_default: int = 32,
                  kv_hit_tokens: int = 0,
                  capabilities: "list[str] | None" = None,
-                 faults: Optional[FaultSpec] = None):
+                 faults: Optional[FaultSpec] = None,
+                 watchdog_stall_seconds: float = 0.0,
+                 tokens_per_chunk: int = 1):
         self.model = model
         self.tps = tokens_per_second
         self.ttft = ttft
         self.max_tokens_default = max_tokens_default
+        # tokens folded into each SSE event: >1 mirrors the real engine's
+        # fused steps / stop-string holdback flushes, where one event
+        # carries several tokens — the case that breaks event-count-based
+        # resume accounting
+        self.tokens_per_chunk = max(1, int(tokens_per_chunk))
         self.kv_hit_tokens = kv_hit_tokens  # fixed /kv/lookup answer
         # advertised on the /v1/models card like the real engine; None =
         # no capabilities field (external-backend behavior: unfiltered)
@@ -51,6 +58,14 @@ class FakeEngine:
         # construction or flipped live via POST /debug/faults, so breaker
         # drills can sicken one fake backend of a fleet mid-test
         self.fault_state = FaultState(faults)
+        # same drain/readiness surface as the real engine (GET /ready,
+        # POST /drain): DRAINING answers 503 on new generation work while
+        # /health stays truthful. The watchdog emulation keys off the
+        # hang_after_ms fault's first-wedged-request stamp, standing in
+        # for the real engine's step-counter watchdog.
+        self.draining = False
+        self.drain_rejected = 0
+        self.watchdog_stall_seconds = watchdog_stall_seconds
 
     def build_app(self) -> web.Application:
         app = web.Application(
@@ -60,6 +75,8 @@ class FakeEngine:
         app.router.add_post("/v1/chat/completions", self.chat)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/ready", self.ready)
+        app.router.add_post("/drain", self.drain)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/is_sleeping", self.is_sleeping)
         app.router.add_post("/sleep", self.sleep)
@@ -93,7 +110,8 @@ class FakeEngine:
             body.update(error_rate=s.error_rate, latency_ms=s.latency_ms,
                         drop_rate=s.drop_rate, stall_ms=s.stall_ms,
                         stream_abort_rate=s.stream_abort_rate,
-                        stream_abort_after_ms=s.stream_abort_after_ms)
+                        stream_abort_after_ms=s.stream_abort_after_ms,
+                        hang_after_ms=s.hang_after_ms)
         return web.json_response(body)
 
     async def load_lora(self, request):
@@ -115,6 +133,29 @@ class FakeEngine:
 
     async def health(self, request):
         return web.json_response({"status": "healthy"})
+
+    def _stalled(self) -> bool:
+        if self.watchdog_stall_seconds <= 0:
+            return False
+        t0 = self.fault_state.last_hang_t
+        return (t0 is not None
+                and time.monotonic() - t0 >= self.watchdog_stall_seconds)
+
+    async def ready(self, request):
+        if self.draining:
+            return web.json_response(
+                {"status": "draining", "inflight": self.running},
+                status=503)
+        if self._stalled():
+            return web.json_response({"status": "stalled"}, status=503)
+        return web.json_response({"status": "ready"})
+
+    async def drain(self, request):
+        started = not self.draining
+        self.draining = True
+        return web.json_response({"status": "draining",
+                                  "already_draining": not started,
+                                  "inflight": self.running})
 
     async def is_sleeping(self, request):
         return web.json_response({"is_sleeping": self.sleeping})
@@ -166,7 +207,37 @@ class FakeEngine:
     async def chat(self, request):
         return await self._serve(request, chat=True)
 
+    def _resume_index(self, body, chat: bool) -> int:
+        """Continuation semantics for resume-from-prefix replay: the
+        canned stream is 'tok0 tok1 …', so a prompt (or trailing
+        assistant message) ending in that sequence is the router resuming
+        a dead backend's stream — continue from the next index, exactly
+        what a greedy real engine does when the generated prefix is
+        appended to the prompt."""
+        import re
+
+        if chat:
+            msgs = body.get("messages") or []
+            tail = ""
+            if msgs and isinstance(msgs[-1], dict) \
+                    and msgs[-1].get("role") == "assistant":
+                tail = str(msgs[-1].get("content") or "")
+        else:
+            prompt = body.get("prompt")
+            tail = prompt if isinstance(prompt, str) else ""
+        m = re.search(r"tok(\d+) $", tail)
+        return int(m.group(1)) + 1 if m else 0
+
     async def _serve(self, request, chat: bool):
+        if self.draining:
+            # the real engine's drain middleware: honest 503 so the
+            # router fails the attempt over instead of queueing here
+            self.drain_rejected += 1
+            return web.json_response(
+                {"error": {"message": "engine is draining; no new "
+                           "requests are admitted",
+                           "type": "service_unavailable_error"}},
+                status=503, headers={"Retry-After": "1"})
         body = await request.json()
         n = int(body.get("max_tokens") or self.max_tokens_default)
         stream = bool(body.get("stream", False))
@@ -176,7 +247,8 @@ class FakeEngine:
         self.total_requests += 1
         try:
             await asyncio.sleep(self.ttft)
-            words = [f"tok{i} " for i in range(n)]
+            first = self._resume_index(body, chat)
+            words = [f"tok{i} " for i in range(first, first + n)]
             usage = {"prompt_tokens": 8, "completion_tokens": n,
                      "total_tokens": 8 + n}
             if not stream:
@@ -194,23 +266,44 @@ class FakeEngine:
                      "text_completion", "created": created,
                      "model": self.model, "choices": [choice], "usage": usage}
                 )
+            so = body.get("stream_options")
+            so = so if isinstance(so, dict) else {}
+            continuous = bool(so.get("continuous_usage_stats"))
             resp = web.StreamResponse(
                 headers={"Content-Type": "text/event-stream"}
             )
             await resp.prepare(request)
             obj = "chat.completion.chunk" if chat else "text_completion"
-            for i, w in enumerate(words):
-                await asyncio.sleep(1.0 / self.tps)
-                delta = {"content": w} if chat else None
+            if chat:
+                # OpenAI chat streams open with a bare role delta; a
+                # resume splice must not relay the continuation's copy
+                opener = {"id": rid, "object": obj, "created": created,
+                          "model": self.model,
+                          "choices": [{"index": 0,
+                                       "delta": {"role": "assistant"},
+                                       "finish_reason": None}]}
+                await resp.write(f"data: {json.dumps(opener)}\n\n".encode())
+            step = self.tokens_per_chunk
+            groups = [words[j:j + step] for j in range(0, len(words), step)]
+            sent = 0
+            for gi, group in enumerate(groups):
+                await asyncio.sleep(len(group) / self.tps)
+                w = "".join(group)
+                sent += len(group)
                 choice = (
-                    {"index": 0, "delta": delta, "finish_reason": None}
+                    {"index": 0, "delta": {"content": w},
+                     "finish_reason": None}
                     if chat else
                     {"index": 0, "text": w, "finish_reason": None,
                      "logprobs": None}
                 )
                 payload = {"id": rid, "object": obj, "created": created,
                            "model": self.model, "choices": [choice]}
-                if i == len(words) - 1:
+                if continuous:
+                    payload["usage"] = {"prompt_tokens": 8,
+                                        "completion_tokens": sent,
+                                        "total_tokens": 8 + sent}
+                if gi == len(groups) - 1:
                     payload["usage"] = usage
                     payload["choices"][0]["finish_reason"] = "length"
                 await resp.write(f"data: {json.dumps(payload)}\n\n".encode())

@@ -28,6 +28,11 @@ the flag wins when both are set):
                router's stream-abort accounting and the engine's
                disconnect-abort KV cleanup)
   stream_abort_after_ms  delay before the mid-stream teardown (default 50)
+  hang_after_ms          the handler is admitted, then the request NEVER
+               progresses (sleeps forever after this delay): models a
+               wedged XLA dispatch — the pod still answers /health 200
+               while every request stalls. Drives the stuck-step watchdog
+               and outlier-ejection paths without a real stuck TPU step
   seed         deterministic PRNG seed (omit for nondeterministic)
 
 error_rate + drop_rate must not exceed 1 (they partition one roll);
@@ -56,6 +61,7 @@ class FaultSpec:
     stall_ms: float = 0.0
     stream_abort_rate: float = 0.0
     stream_abort_after_ms: float = 50.0
+    hang_after_ms: float = 0.0
     seed: Optional[int] = None
 
     @classmethod
@@ -69,7 +75,8 @@ class FaultSpec:
             key = key.strip()
             if key not in ("error_rate", "latency_ms", "drop_rate",
                            "stall_ms", "stream_abort_rate",
-                           "stream_abort_after_ms", "seed"):
+                           "stream_abort_after_ms", "hang_after_ms",
+                           "seed"):
                 raise ValueError(f"unknown fault key {key!r}")
             kwargs[key] = int(value) if key == "seed" else float(value)
         spec_obj = cls(**kwargs)
@@ -81,15 +88,17 @@ class FaultSpec:
             raise ValueError("error_rate + drop_rate must not exceed 1 "
                              "(they partition one roll)")
         if spec_obj.latency_ms < 0 or spec_obj.stall_ms < 0 \
-                or spec_obj.stream_abort_after_ms < 0:
-            raise ValueError("latency_ms/stall_ms/stream_abort_after_ms "
-                             "must be >= 0")
+                or spec_obj.stream_abort_after_ms < 0 \
+                or spec_obj.hang_after_ms < 0:
+            raise ValueError("latency_ms/stall_ms/stream_abort_after_ms/"
+                             "hang_after_ms must be >= 0")
         return spec_obj
 
     @property
     def active(self) -> bool:
         return bool(self.error_rate or self.latency_ms or self.drop_rate
-                    or self.stall_ms or self.stream_abort_rate)
+                    or self.stall_ms or self.stream_abort_rate
+                    or self.hang_after_ms)
 
 
 class FaultState:
@@ -102,6 +111,11 @@ class FaultState:
     def set(self, spec: Optional[FaultSpec]) -> None:
         self.spec = spec if spec is not None and spec.active else None
         self.rng = random.Random(spec.seed if spec is not None else None)
+        # monotonic stamp of the first request currently wedged by
+        # hang_after_ms (None once faults change): lets a fake engine's
+        # watchdog emulation flip readiness off the same signal a real
+        # engine's StepWatchdog derives from its step counter
+        self.last_hang_t: Optional[float] = None
 
 
 def fault_middleware(state: FaultState):
@@ -137,6 +151,17 @@ def fault_middleware(state: FaultState):
             # pay it, so the backend looks slow-but-correct (latency
             # outlier, not error source)
             await asyncio.sleep(spec.stall_ms / 1000.0)
+        if spec.hang_after_ms:
+            # admitted-then-wedged: the request is in flight but never
+            # progresses and never errors — the client hangs until it
+            # gives up (task cancellation on disconnect unblocks us).
+            # Models a stuck device dispatch from the router's viewpoint.
+            await asyncio.sleep(spec.hang_after_ms / 1000.0)
+            import time as _time
+
+            if state.last_hang_t is None:
+                state.last_hang_t = _time.monotonic()
+            await asyncio.Event().wait()
         if spec.stream_abort_rate and rng.random() < spec.stream_abort_rate:
             # mid-stream truncation: let the handler start responding,
             # then kill the transport under it — the peer sees a

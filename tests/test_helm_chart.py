@@ -768,6 +768,63 @@ def test_perf_slo_values_render_flags():
     assert "--perf-peak-hbm-gbps" not in eargs
 
 
+def test_drain_lifecycle_contract():
+    """Graceful-drain wiring (docs/resilience.md "Drain & migration"):
+    readiness asks /ready (liveness stays /health), preStop POSTs /drain
+    before SIGTERM lands, and the kubelet waits out the drain deadline
+    plus teardown margin before SIGKILL."""
+    objs = render_objects(HELM)
+    eng = engine_deployments(objs)[0]
+    pod = eng["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    # drain deadline 30 (values default) + 30s teardown margin
+    assert pod["terminationGracePeriodSeconds"] == 60
+    assert c["readinessProbe"]["httpGet"]["path"] == "/ready"
+    assert c["livenessProbe"]["httpGet"]["path"] == "/health"
+    assert c["startupProbe"]["httpGet"]["path"] == "/health"
+    hook = c["lifecycle"]["preStop"]["exec"]["command"]
+    assert hook[0] == "python"
+    assert "/drain" in hook[-1] and "127.0.0.1:8000" in hook[-1]
+    args = c["args"]
+    assert args[args.index("--drain-deadline") + 1] == "30"
+    assert args[args.index("--watchdog-stall-seconds") + 1] == "0"
+
+    # a larger per-model deadline stretches the kill grace accordingly
+    objs = render_objects(HELM, {"servingEngineSpec": {"modelSpec": [{
+        "name": "slow", "modelRef": "llama-3-8b",
+        "engineConfig": {"maxModelLen": 2048, "maxNumSeqs": 8,
+                         "dtype": "bfloat16", "tensorParallelSize": 1,
+                         "drainDeadline": 120},
+    }]}})
+    eng = engine_deployments(objs)[0]
+    pod = eng["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 150
+    args = pod["containers"][0]["args"]
+    assert args[args.index("--drain-deadline") + 1] == "120"
+
+    # multihost StatefulSet carries the same drain contract
+    sts = by_kind(render_objects(HELM, MULTIHOST_VALUES), "StatefulSet")[0]
+    spod = sts["spec"]["template"]["spec"]
+    assert spod["terminationGracePeriodSeconds"] == 60
+    assert (spod["containers"][0]["readinessProbe"]["httpGet"]["path"]
+            == "/ready")
+
+
+def test_stream_resume_and_probe_threshold_flags():
+    """resilience.streamResume=false renders the off flag; the flap-damping
+    threshold maps onto --health-check-failure-threshold; defaults leave
+    resume on."""
+    args = router_args(render_objects(HELM))
+    assert "--no-stream-resume" not in args
+    assert args[args.index("--health-check-failure-threshold") + 1] == "3"
+
+    objs = render_objects(HELM, {"routerSpec": {"resilience": {
+        "streamResume": False, "healthCheckFailureThreshold": 5}}})
+    args = router_args(objs)
+    assert "--no-stream-resume" in args
+    assert args[args.index("--health-check-failure-threshold") + 1] == "5"
+
+
 def test_alert_rules_configmap_renders():
     """monitoring.alertRules.enabled ships observability/alert-rules.yaml
     as a ConfigMap for the Prometheus sidecar; off by default."""

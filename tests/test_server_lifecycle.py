@@ -72,8 +72,12 @@ def test_sigterm_while_serving_exits_promptly():
         # aiohttp's GracefulExit path exits 0 after on_cleanup ran
         # (_on_stop → _release_jax_backend)
         assert rc == 0, f"expected clean exit, got rc={rc}"
-        # no orphaned child still holds the port
+        # no orphaned child still holds the port. SO_REUSEADDR lets the
+        # probe bind over kernel TIME_WAIT remnants of the health-check
+        # connections (the server may win the close race and leave one),
+        # but still fails EADDRINUSE against a live LISTEN socket.
         s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
             s.bind(("127.0.0.1", port))
         finally:

@@ -209,6 +209,33 @@ def test_cli_list():
 
 # ---- the gate: this repo is clean -----------------------------------------
 
+def test_lifecycle_surface_is_inside_the_gates():
+    """The drain/watchdog/resume surface is covered by the gates, not
+    grandfathered around them: config-drift sees the chart's new flags
+    as declared CLI flags (so a template typo would be an active
+    finding), and metric-hygiene tracks the lifecycle metrics as both
+    defined in code and documented (so deleting a docs/observability.md
+    row would fail test_repo_has_no_active_findings)."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    engine_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "engine" / "server.py")
+    assert {"--drain-deadline", "--watchdog-stall-seconds"} <= engine_flags
+    router_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "router" / "app.py")
+    assert {"--no-stream-resume",
+            "--health-check-failure-threshold"} <= router_flags
+
+    lifecycle = {"vllm:drain_state", "vllm:drain_rejected_requests",
+                 "vllm:drain_aborted_seqs", "vllm:watchdog_stalled",
+                 "vllm:watchdog_stalls", "vllm:stream_resumes"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert lifecycle <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert lifecycle <= documented
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

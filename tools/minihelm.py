@@ -13,7 +13,7 @@ Supported subset (everything this chart uses):
   data        .Values/.Chart/.Release paths, $var, $ (root), dot
   functions   include, tpl, toYaml, nindent, indent, default, quote,
               squote, trunc, trimSuffix, printf, ternary, empty, dict,
-              list, eq, ne, and, or, not, lt, gt, int, toString, b64enc,
+              list, eq, ne, and, or, not, lt, gt, int, add, toString, b64enc,
               lower, upper, join, hasKey, hasPrefix, hasSuffix,
               required, fromYaml
   pipelines   a | b | c (previous value appended as the LAST argument)
@@ -443,6 +443,7 @@ FUNCTIONS = {
     "lt": lambda r, d, v, a, b: a < b,
     "gt": lambda r, d, v, a, b: a > b,
     "int": lambda r, d, v, x: int(x or 0),
+    "add": lambda r, d, v, *a: sum(int(x or 0) for x in a),
     "toString": lambda r, d, v, x: to_string(x),
     "toJson": lambda r, d, v, x: __import__("json").dumps(x),
     "b64enc": lambda r, d, v, s:
