@@ -49,6 +49,20 @@ import time
 
 import aiohttp
 
+try:
+    from production_stack_tpu.testing.arrivals import (
+        add_arrival_args, process_from_args,
+    )
+except ImportError:  # run as a loose script: benchmarks/ -> repo root
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from production_stack_tpu.testing.arrivals import (
+        add_arrival_args, process_from_args,
+    )
+
 
 def lorem(n_tokens: int, seed: int) -> str:
     rng = random.Random(seed)
@@ -209,6 +223,14 @@ async def run(args) -> dict:
     # reference pacing: each user fires every num_users/qps seconds; the
     # whole population therefore arrives at `qps`
     user_gap = args.num_users / args.qps if args.qps > 0 else 0.0
+    # non-constant arrival processes replace the uniform per-user gap
+    # with a shared generator (testing/arrivals.py): round launches
+    # follow Poisson/bursty/diurnal arrival timestamps at aggregate rate
+    # `qps` — the same (kind, rate, seed) the traffic simulator replays,
+    # so bench and simulator workloads are identical
+    proc = (process_from_args(args, args.qps)
+            if args.arrival_process != "constant" and args.qps > 0
+            else None)
     session_alive = user_gap * max(args.num_rounds - 1, 1)
     join_gap = session_alive / max(args.num_users, 1)
 
@@ -259,6 +281,7 @@ async def run(args) -> dict:
             new_user(offset=offset)
         last_join = t_start
         last_log = t_start
+        next_arrival = 0.0  # process-paced: next launch, relative to start
 
         while True:
             now = time.perf_counter()
@@ -268,17 +291,38 @@ async def run(args) -> dict:
                 new_user()
                 last_join = now
             fired_any = False
-            for u in list(users):
-                if u.finished:
-                    users.remove(u)
-                    continue
-                if u.round >= args.num_rounds or u.in_flight:
-                    continue
-                if u.last_fire is None or now - u.last_fire >= user_gap:
+            if proc is not None:
+                # process-paced: fire the longest-idle eligible user at
+                # each arrival timestamp; an arrival with every user busy
+                # waits (open-loop backpressure is visible as TTFT)
+                for u in list(users):
+                    if u.finished:
+                        users.remove(u)
+                while next_arrival <= now - t_start:
+                    ready = [u for u in users
+                             if not u.in_flight and u.round < args.num_rounds]
+                    if not ready:
+                        break
+                    u = min(ready, key=lambda x: (
+                        x.last_fire if x.last_fire is not None else -1e18,
+                        x.uid))
                     u.last_fire = now
                     tasks.append(asyncio.create_task(
                         one_request(session, args, u, results)))
                     fired_any = True
+                    next_arrival = proc.next_after(next_arrival)
+            else:
+                for u in list(users):
+                    if u.finished:
+                        users.remove(u)
+                        continue
+                    if u.round >= args.num_rounds or u.in_flight:
+                        continue
+                    if u.last_fire is None or now - u.last_fire >= user_gap:
+                        u.last_fire = now
+                        tasks.append(asyncio.create_task(
+                            one_request(session, args, u, results)))
+                        fired_any = True
             if not open_loop and not users:
                 break
             if args.log_interval and now - last_log > args.log_interval:
@@ -332,6 +376,7 @@ def main(argv=None):
     p.add_argument("--num-users", type=int, default=32)
     p.add_argument("--num-rounds", type=int, default=5)
     p.add_argument("--qps", type=float, default=2.0)
+    add_arrival_args(p)
     p.add_argument("--system-prompt-len", "--shared-system-prompt",
                    dest="system_prompt_len", type=int, default=1000)
     p.add_argument("--user-history-len", "--user-history-prompt",

@@ -870,8 +870,18 @@ ValuePtr runtime_actions(const Value& cr, const Value* live_deploy,
         auto it = au->obj.find("enabled");
         if (it != au->obj.end()) au_enabled = present_truthy(*au, "enabled");
     }
+    // mode keda (default) delegates to a KEDA ScaledObject; mode native
+    // runs the operator's own advisor-polling loop — a leftover
+    // ScaledObject from a keda→native flip would fight it over
+    // .spec.replicas, so it gets the same delete treatment as
+    // autoscaling-off (Python parity: autoscaling.get("mode", "keda"))
+    bool native_mode = false;
+    if (au_enabled && au) {
+        const Value* mv = get(*au, "mode");
+        native_mode = mv && mv->kind == Value::Str && mv->str == "native";
+    }
     bool del_scaled = false;
-    if (au_enabled) {
+    if (au_enabled && !native_mode) {
         ensure->arr.push_back(S("scaledobject"));
     } else if (scaledobject_exists) {
         del_scaled = true;
@@ -898,6 +908,10 @@ ValuePtr runtime_actions(const Value& cr, const Value* live_deploy,
     auto out = mk(Value::Obj);
     out->obj["ensure"] = std::move(ensure);
     out->obj["delete_scaledobject"] = B(del_scaled);
+    // pin_replicas=false when ANY autoscaler owns .spec.replicas (keda
+    // or native): the reconciler must stop reverting scaler writes
+    out->obj["pin_replicas"] = B(!au_enabled);
+    out->obj["native_autoscaler"] = B(native_mode);
     out->obj["status"] = std::move(status);
     return out;
 }

@@ -254,6 +254,19 @@ class LifecycleCollector:
             "Stall episodes the stuck-step watchdog has detected",
             s["watchdog_stalls_total"],
         )
+        yield gauge(
+            "vllm:engine_warming",
+            "1 while the engine runs its warmup compiles (readiness "
+            "answers 503 \"warming\"; the router keeps the replica out "
+            "of rotation until this clears)",
+            1.0 if s.get("warming") else 0.0,
+        )
+        yield gauge(
+            "vllm:engine_warmup_seconds",
+            "Wall time the completed warmup (all shape variants) took; "
+            "0 until it finishes",
+            s.get("warmup_seconds", 0.0),
+        )
 
 
 _BUCKETS_TTFT = (

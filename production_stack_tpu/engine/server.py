@@ -236,6 +236,16 @@ class EngineServer:
         self.drain_deadline = drain_deadline
         self.draining = False
         self.drain_reason: Optional[str] = None
+        # WARMING precedes SERVING: a fresh TPU replica must run its
+        # warmup compiles (all shape variants) before it is fit for
+        # traffic — /ready answers 503 {"status": "warming"} until they
+        # finish, so service discovery (and therefore the autoscaler's
+        # scale-ups) never cuts a cold replica into the ring. /health
+        # stays 200 the whole time: the pod is alive, just not ready.
+        self.warming = False
+        self.warmup_seconds = 0.0
+        self._warmup_t0: Optional[float] = None
+        self._warmup_task: Optional[asyncio.Task] = None
         # main() flips this on before run_app so SIGTERM drains instead of
         # killing the loop; in-process test servers leave it off.
         self.drain_on_sigterm = False
@@ -320,12 +330,27 @@ class EngineServer:
         if self.drain_on_sigterm:
             self._install_signal_drain()
         if self.warmup_on_start:
-            t0 = time.monotonic()
+            # warm in the background so the server binds immediately and
+            # /ready can answer 503 {"status": "warming"} while the
+            # compiles run — discovery and the autoscaler need to SEE the
+            # warming state, not a connection-refused socket
+            self.warming = True
+            self._warmup_t0 = time.monotonic()
+            self._warmup_task = asyncio.ensure_future(self._run_warmup())
+
+    async def _run_warmup(self) -> None:
+        assert self._warmup_t0 is not None
+        try:
             await self.async_engine.run_on_engine(lambda eng: eng.warmup())
-            print(f"engine warmup (all shape variants) done in "
-                  f"{time.monotonic() - t0:.1f}s", flush=True)
+        finally:
+            self.warmup_seconds = time.monotonic() - self._warmup_t0
+            self.warming = False
+        print(f"engine warmup (all shape variants) done in "
+              f"{self.warmup_seconds:.1f}s", flush=True)
 
     async def _on_stop(self, app) -> None:
+        if self._warmup_task is not None:
+            self._warmup_task.cancel()
         if self._drain_task is not None:
             self._drain_task.cancel()
         self.watchdog.stop()
@@ -363,6 +388,8 @@ class EngineServer:
             "drain_aborted_total": self._drain_aborted,
             "watchdog_stalled": self.watchdog.stalled,
             "watchdog_stalls_total": self.watchdog.stalls_total,
+            "warming": self.warming,
+            "warmup_seconds": self.warmup_seconds,
         }
 
     def begin_drain(self, reason: str) -> bool:
@@ -470,6 +497,14 @@ class EngineServer:
                 {"status": "draining", "reason": self.drain_reason,
                  "inflight": len(self._inflight),
                  "deadline_remaining": round(remaining, 3)},
+                status=503,
+            )
+        if self.warming:
+            elapsed = 0.0
+            if self._warmup_t0 is not None:
+                elapsed = time.monotonic() - self._warmup_t0
+            return web.json_response(
+                {"status": "warming", "warming_for": round(elapsed, 3)},
                 status=503,
             )
         if self.watchdog.stalled:

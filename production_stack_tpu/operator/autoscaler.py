@@ -1,0 +1,405 @@
+"""Native autoscaler loop: poll the router's scale advisor, actuate the
+fleet — TPU-aware on both edges.
+
+``spec.autoscaling.mode: native`` turns this on per TPURuntime CR
+(operator/controller.py wires it; mode ``keda`` keeps the ScaledObject
+path). Each loop polls ``GET /debug/scale`` on the CR's router
+(router/scale_advisor.py — burn rate + queue depth + KV pressure fused
+with hysteresis) and patches the engine Deployment's ``.spec.replicas``.
+
+TPU-awareness is the point of owning this loop instead of delegating to
+an HPA:
+
+- **Scale-up is pre-warmed.** A fresh replica answers ``/ready`` 503
+  ``{"status": "warming"}`` until its XLA warmup compiles finish, so
+  service discovery never cuts a cold replica into the ring; the loop
+  tracks the warming→ready transition per replica and records the warmup
+  seconds (the real cost of every scale-up decision).
+- **Scale-down is drain-based.** The loop picks the least-loaded ready
+  replica, POSTs ``/drain`` (PR 7 lifecycle: 503 on new work, in-flight
+  streams finish, stragglers aborted with KV freed at the deadline), and
+  only shrinks ``.spec.replicas`` once the victim is empty — never
+  SIGKILL with live streams. On Kubernetes the victim is additionally
+  marked with ``controller.kubernetes.io/pod-deletion-cost`` so the
+  Deployment controller removes *that* pod when replicas drop.
+
+The decision/actuation split (``AutoscalerLoop`` over a ``FleetActuator``)
+lets testing/traffic_sim.py drive the identical loop logic against a
+simulated fleet in virtual time at 10^4–10^6 users.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.router.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class AutoscalerConfig:
+    poll_interval: float = 5.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # scale-down: how long to wait for the drained victim to empty before
+    # shrinking anyway (its engine-side drain deadline aborts stragglers
+    # and frees their KV, so this is a ceiling, not a cliff)
+    drain_grace: float = 60.0
+
+    @staticmethod
+    def from_cr_spec(au: dict) -> "AutoscalerConfig":
+        return AutoscalerConfig(
+            poll_interval=au.get("pollingInterval", 5.0),
+            min_replicas=au.get("minReplicas", 1),
+            max_replicas=au.get("maxReplicas", 8),
+            drain_grace=au.get("drainGrace", 60.0),
+        )
+
+
+@dataclass
+class ReplicaInfo:
+    """One replica as the actuator sees it."""
+    ref: str                 # stable identity (pod name / sim id)
+    url: str = ""
+    status: str = "ready"    # ready | warming | draining | unknown
+    running: float = 0.0
+    waiting: float = 0.0
+
+
+class FleetActuator(abc.ABC):
+    """What the loop needs from a fleet; K8s and the simulator implement
+    it."""
+
+    @abc.abstractmethod
+    async def get_replicas(self) -> Optional[int]:
+        """Current desired replica count (.spec.replicas), None if the
+        fleet object is missing."""
+
+    @abc.abstractmethod
+    async def set_replicas(self, n: int,
+                           victim: Optional[str] = None) -> None:
+        """Patch the desired count. ``victim`` (on shrink) names the
+        drained replica that should be the one removed."""
+
+    @abc.abstractmethod
+    async def endpoints(self) -> List[ReplicaInfo]:
+        """Census of live replicas with lifecycle status and load."""
+
+    @abc.abstractmethod
+    async def drain(self, replica: ReplicaInfo) -> bool:
+        """POST /drain the replica; True when the drain was accepted."""
+
+
+class AutoscalerLoop:
+    """Poll advisor → clamp → actuate, one replica-safe step at a time.
+
+    ``advisor`` is an async callable returning the ``/debug/scale`` JSON
+    (or None when unreachable). ``step(now)`` is re-entrant-free and
+    clock-injected for virtual-time tests; ``run()`` wraps it for the
+    operator.
+    """
+
+    def __init__(self, advisor: Callable, actuator: FleetActuator,
+                 config: AutoscalerConfig, model: Optional[str] = None):
+        self.advisor = advisor
+        self.actuator = actuator
+        self.config = config
+        self.model = model
+        # one drain in flight at a time: (ref, started_at)
+        self._pending_drain: Optional[tuple] = None
+        # warming→ready observation: ref → first-seen-warming ts
+        self._warming_since: Dict[str, float] = {}
+        self.warmups: List[float] = []
+        self.scale_events = {"up": 0, "down": 0}
+        self.replica_hours = 0.0
+        self._last_tick: Optional[float] = None
+        self._last_ready = 0
+        self.last_action: dict = {}
+
+    # -- accounting ----------------------------------------------------------
+    def _observe_fleet(self, eps: List[ReplicaInfo], now: float) -> None:
+        ready = 0
+        seen = set()
+        for ep in eps:
+            seen.add(ep.ref)
+            if ep.status == "warming":
+                self._warming_since.setdefault(ep.ref, now)
+            elif ep.status == "ready":
+                ready += 1
+                t0 = self._warming_since.pop(ep.ref, None)
+                if t0 is not None:
+                    self.warmups.append(now - t0)
+        for ref in list(self._warming_since):
+            if ref not in seen:
+                del self._warming_since[ref]  # died mid-warmup
+        # bill the elapsed interval at the count that was ready DURING it,
+        # not the count we just observed
+        if self._last_tick is not None and now > self._last_tick:
+            self.replica_hours += ((now - self._last_tick)
+                                   * self._last_ready / 3600.0)
+        self._last_tick = now
+        self._last_ready = ready
+
+    def _desired_from(self, snapshot: Optional[dict]) -> Optional[int]:
+        if not snapshot or not snapshot.get("enabled", True):
+            return None
+        models = snapshot.get("models") or {}
+        if self.model is not None:
+            rec = models.get(self.model)
+            recs = [rec] if rec else []
+        else:
+            recs = list(models.values())
+        if not recs:
+            return None
+        # multi-model pool: the hungriest model's recommendation wins
+        return max(r["desired_replicas"] for r in recs)
+
+    # -- one decision step ---------------------------------------------------
+    async def step(self, now: Optional[float] = None) -> dict:
+        now = now if now is not None else time.monotonic()
+        eps = await self.actuator.endpoints()
+        self._observe_fleet(eps, now)
+        current = await self.actuator.get_replicas()
+        if current is None:
+            return self._done({"action": "none", "reason": "no-fleet"})
+
+        # finish an in-flight drain before any new decision
+        if self._pending_drain is not None:
+            ref, t0 = self._pending_drain
+            victim = next((e for e in eps if e.ref == ref), None)
+            emptied = victim is None or (victim.status == "draining"
+                                         and victim.running <= 0)
+            if emptied or now - t0 >= self.config.drain_grace:
+                self._pending_drain = None
+                target = max(self.config.min_replicas, current - 1)
+                if target < current:
+                    await self.actuator.set_replicas(target, victim=ref)
+                    self.scale_events["down"] += 1
+                    logger.info("autoscaler: scale-down %d→%d (drained %s)",
+                                current, target, ref)
+                    return self._done({"action": "down", "from": current,
+                                       "to": target, "victim": ref,
+                                       "emptied": emptied})
+                return self._done({"action": "none",
+                                   "reason": "drain-at-min"})
+            return self._done({"action": "none", "reason": "draining",
+                               "victim": ref})
+
+        snapshot = await self.advisor()
+        desired = self._desired_from(snapshot)
+        if desired is None:
+            return self._done({"action": "none", "reason": "no-advice"})
+        desired = max(self.config.min_replicas,
+                      min(self.config.max_replicas, desired))
+
+        if desired > current:
+            await self.actuator.set_replicas(desired)
+            self.scale_events["up"] += 1
+            logger.info("autoscaler: scale-up %d→%d", current, desired)
+            return self._done({"action": "up", "from": current,
+                               "to": desired})
+        if desired < current:
+            ready = [e for e in eps if e.status == "ready"]
+            # keep a margin: never drain the replica the advisor still
+            # needs — only shrink from actually-ready capacity
+            if len(ready) <= desired:
+                return self._done({"action": "none",
+                                   "reason": "not-enough-ready"})
+            victim = min(ready, key=lambda e: (e.running + e.waiting,
+                                               e.ref))
+            if await self.actuator.drain(victim):
+                self._pending_drain = (victim.ref, now)
+                logger.info("autoscaler: draining %s (least loaded: "
+                            "running=%.0f waiting=%.0f) toward %d→%d",
+                            victim.ref, victim.running, victim.waiting,
+                            current, desired)
+                return self._done({"action": "drain",
+                                   "victim": victim.ref,
+                                   "from": current, "to": desired})
+            return self._done({"action": "none", "reason": "drain-refused",
+                               "victim": victim.ref})
+        return self._done({"action": "none", "reason": "steady"})
+
+    def _done(self, action: dict) -> dict:
+        self.last_action = action
+        return action
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning("autoscaler step failed: %s", e)
+            await asyncio.sleep(self.config.poll_interval)
+
+    def stats(self) -> dict:
+        return {
+            "scale_events": dict(self.scale_events),
+            "replica_hours": round(self.replica_hours, 4),
+            "warmups": [round(w, 3) for w in self.warmups],
+            "pending_drain": self._pending_drain[0]
+            if self._pending_drain else None,
+            "last_action": self.last_action,
+        }
+
+
+# -- Kubernetes actuator -----------------------------------------------------
+
+# the Deployment controller deletes the lowest pod-deletion-cost pod
+# first — mark the drained victim well below the default (0) so the
+# shrink takes exactly the pod we emptied
+_DELETION_COST = "controller.kubernetes.io/pod-deletion-cost"
+
+_RUNNING_RE = None  # lazy-compiled metric parsers
+
+
+class K8sFleetActuator(FleetActuator):
+    """Actuate one TPURuntime's engine Deployment + pods through the
+    apiserver (works against testing/fake_apiserver.py identically)."""
+
+    def __init__(self, client, namespace: str, cr_name: str,
+                 engine_port: int = 8000, group: str = "production.tpu"):
+        self.client = client
+        self.ns = namespace
+        self.name = cr_name
+        self.engine_port = engine_port
+        self.group = group
+
+    @property
+    def _deploy_path(self) -> str:
+        return (f"/apis/apps/v1/namespaces/{self.ns}/deployments/"
+                f"{self.name}-engine")
+
+    async def get_replicas(self) -> Optional[int]:
+        dep = await self.client.get(self._deploy_path)
+        if dep is None:
+            return None
+        return dep.get("spec", {}).get("replicas", 1)
+
+    async def set_replicas(self, n: int,
+                           victim: Optional[str] = None) -> None:
+        if victim:
+            await self._mark_victim(victim)
+        dep = await self.client.get(self._deploy_path)
+        if dep is None:
+            return
+        dep["spec"]["replicas"] = n
+        await self.client.replace(self._deploy_path, dep)
+
+    async def _mark_victim(self, pod_name: str) -> None:
+        path = f"/api/v1/namespaces/{self.ns}/pods/{pod_name}"
+        pod = await self.client.get(path)
+        if pod is None:
+            return
+        ann = pod.setdefault("metadata", {}).setdefault("annotations", {})
+        ann[_DELETION_COST] = "-1000"
+        try:
+            await self.client.replace(path, pod)
+        except Exception as e:
+            logger.warning("pod-deletion-cost annotation failed for %s: %s",
+                           pod_name, e)
+
+    async def endpoints(self) -> List[ReplicaInfo]:
+        pods = await self.client.list(
+            f"/api/v1/namespaces/{self.ns}/pods",
+            label_selector=f"{self.group}/model={self.name}")
+        out: List[ReplicaInfo] = []
+        session = await self.client.session()
+        for pod in pods.get("items", []):
+            name = pod["metadata"]["name"]
+            ip = pod.get("status", {}).get("podIP")
+            if not ip:
+                out.append(ReplicaInfo(ref=name, status="unknown"))
+                continue
+            url = ip if "://" in ip else f"http://{ip}:{self.engine_port}"
+            info = ReplicaInfo(ref=name, url=url)
+            await self._probe(session, info)
+            out.append(info)
+        return out
+
+    async def _probe(self, session: aiohttp.ClientSession,
+                     info: ReplicaInfo) -> None:
+        timeout = aiohttp.ClientTimeout(total=5)
+        try:
+            async with session.get(f"{info.url}/ready",
+                                   timeout=timeout) as resp:
+                if resp.status == 200:
+                    info.status = "ready"
+                elif resp.status == 503:
+                    try:
+                        body = await resp.json()
+                    except Exception:
+                        body = {}
+                    info.status = body.get("status", "draining")
+                    info.running = float(body.get("inflight", 0))
+                    return
+                else:
+                    info.status = "unknown"
+                    return
+        except Exception:
+            info.status = "unknown"
+            return
+        # ready replica: load from /metrics (victim selection signal)
+        global _RUNNING_RE
+        if _RUNNING_RE is None:
+            import re
+
+            _RUNNING_RE = (
+                re.compile(r"^vllm:num_requests_running\{[^}]*\} +([0-9.eE+-]+)",
+                           re.M),
+                re.compile(r"^vllm:num_requests_waiting\{[^}]*\} +([0-9.eE+-]+)",
+                           re.M),
+            )
+        try:
+            async with session.get(f"{info.url}/metrics",
+                                   timeout=timeout) as resp:
+                if resp.status != 200:
+                    return
+                text = await resp.text()
+            run_m = _RUNNING_RE[0].search(text)
+            wait_m = _RUNNING_RE[1].search(text)
+            if run_m:
+                info.running = float(run_m.group(1))
+            if wait_m:
+                info.waiting = float(wait_m.group(1))
+        except Exception:
+            pass
+
+    async def drain(self, replica: ReplicaInfo) -> bool:
+        if not replica.url:
+            return False
+        try:
+            session = await self.client.session()
+            async with session.post(
+                    f"{replica.url}/drain",
+                    timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                return resp.status == 200
+        except Exception as e:
+            logger.warning("drain %s failed: %s", replica.ref, e)
+            return False
+
+
+def advisor_over_http(session_factory, url: str) -> Callable:
+    """Async fetcher for the router's /debug/scale document."""
+
+    async def fetch() -> Optional[dict]:
+        try:
+            session = await session_factory()
+            async with session.get(
+                    url, timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+        except Exception:
+            return None
+
+    return fetch

@@ -50,8 +50,14 @@ def runtime_actions_py(cr: dict, live_deploy: Optional[dict],
         ensure.append("pvc")
     autoscaling = spec.get("autoscaling") or {}
     enabled = bool(autoscaling) and autoscaling.get("enabled", True)
+    # mode keda (default) delegates to a KEDA ScaledObject; mode native
+    # runs the operator's own advisor-polling loop instead — a leftover
+    # ScaledObject from a keda→native flip would fight it over
+    # .spec.replicas, so it gets the same delete treatment as
+    # autoscaling-off
+    native_mode = bool(enabled) and autoscaling.get("mode", "keda") == "native"
     delete_scaled = False
-    if enabled:
+    if enabled and not native_mode:
         ensure.append("scaledobject")
     elif scaledobject_exists:
         delete_scaled = True
@@ -68,7 +74,11 @@ def runtime_actions_py(cr: dict, live_deploy: Optional[dict],
         "modelStatus": _model_status(live_deploy, want),
         "state": "Reconciled",
     }
+    # pin_replicas=False when ANY autoscaler owns .spec.replicas (keda or
+    # native): the reconciler must stop reverting scaler writes on the
+    # Deployment (the replicas-pinning bug)
     return {"ensure": ensure, "delete_scaledobject": delete_scaled,
+            "pin_replicas": not enabled, "native_autoscaler": native_mode,
             "status": status}
 
 

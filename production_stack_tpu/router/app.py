@@ -170,6 +170,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-config", default=None,
                    help="JSON object of per-model objective overrides, "
                         'e.g. {"llama-3-8b": {"ttft_p95": 0.5}}')
+    # scale advisor (router/scale_advisor.py): desired-replica
+    # recommendations on GET /debug/scale, fusing burn rate + queue depth
+    # + KV pressure; consumed by the operator's native autoscaler loop
+    # and/or a KEDA metrics-api external scaler
+    p.add_argument("--scale-advisor", action="store_true",
+                   help="serve desired-replica recommendations on "
+                        "GET /debug/scale (docs/autoscaling.md)")
+    p.add_argument("--scale-min-replicas", type=int, default=1)
+    p.add_argument("--scale-max-replicas", type=int, default=8)
+    p.add_argument("--scale-target-queue", type=float, default=8.0,
+                   help="waiting requests per ready replica considered "
+                        "saturated (scale-up trigger)")
+    p.add_argument("--scale-kv-high", type=float, default=0.85,
+                   help="fleet-max KV usage fraction that forces a "
+                        "scale-up")
+    p.add_argument("--scale-burn-high", type=float, default=1.0,
+                   help="fast-pair (5m & 1h) burn rate that forces a "
+                        "scale-up")
+    p.add_argument("--scale-down-fraction", type=float, default=0.5,
+                   help="hysteresis: scale-down needs every signal under "
+                        "this fraction of its scale-up threshold")
+    p.add_argument("--scale-down-stable", type=int, default=3,
+                   help="consecutive idle evaluations required before a "
+                        "scale-down is recommended")
+    p.add_argument("--scale-up-cooldown", type=float, default=30.0)
+    p.add_argument("--scale-down-cooldown", type=float, default=300.0)
+    p.add_argument("--scale-interval", type=float, default=5.0,
+                   help="seconds between advisor evaluations")
     p.add_argument("--log-stats", action="store_true")
     p.add_argument("--log-stats-interval", type=float, default=30.0)
     # misc
@@ -243,6 +271,7 @@ class RouterApp:
         self.pii_middleware = None
         self.batch_processor = None
         self._log_stats_task: Optional[asyncio.Task] = None
+        self._scale_task: Optional[asyncio.Task] = None
 
     # -- initialization (reference: app.py initialize_all) -------------------
     def initialize(self) -> None:
@@ -332,6 +361,13 @@ class RouterApp:
         )
 
         initialize_slo_tracker(SLOConfig.from_args(args))
+
+        from production_stack_tpu.router.scale_advisor import (
+            ScaleAdvisorConfig,
+            initialize_scale_advisor,
+        )
+
+        initialize_scale_advisor(ScaleAdvisorConfig.from_args(args))
 
         from production_stack_tpu.router.resilience import (
             ResilienceConfig,
@@ -494,6 +530,7 @@ class RouterApp:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_get("/debug/requests", self.debug_requests)
         app.router.add_get("/debug/slo", self.debug_slo)
+        app.router.add_get("/debug/scale", self.debug_scale)
         async def _sleep(r):
             return await self.request_service.sleep_wake(r, "sleep")
 
@@ -568,6 +605,13 @@ class RouterApp:
             await self._dyn.start()
         if self.args.log_stats:
             self._log_stats_task = asyncio.create_task(self._log_stats_worker())
+        from production_stack_tpu.router.scale_advisor import (
+            current_scale_advisor,
+        )
+
+        if current_scale_advisor() is not None:
+            self._scale_task = asyncio.create_task(
+                self._scale_advisor_worker())
 
     async def _on_stop(self, app) -> None:
         if self.batch_processor is not None:
@@ -580,6 +624,8 @@ class RouterApp:
         await get_routing_logic().close()
         if self._log_stats_task:
             self._log_stats_task.cancel()
+        if self._scale_task:
+            self._scale_task.cancel()
 
     async def _log_stats_worker(self) -> None:
         while True:
@@ -694,6 +740,51 @@ class RouterApp:
             return web.json_response({"enabled": False})
         return web.json_response({"enabled": True, **tracker.snapshot()})
 
+    async def debug_scale(self, request: web.Request) -> web.Response:
+        """Scale advisor snapshot (router/scale_advisor.py): the fused
+        desired-replica recommendation per model. The operator's native
+        autoscaler polls this; a KEDA metrics-api scaler can point at
+        ``models.<model>.desired_replicas``."""
+        from production_stack_tpu.router.scale_advisor import (
+            current_scale_advisor,
+        )
+
+        advisor = current_scale_advisor()
+        if advisor is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(advisor.snapshot())
+
+    async def _scale_advisor_worker(self) -> None:
+        """Periodic advisor evaluation: collect signals from discovery +
+        scraper + SLO tracker, refresh recommendations and gauges."""
+        from production_stack_tpu.router.scale_advisor import (
+            collect_signals,
+            current_scale_advisor,
+        )
+        from production_stack_tpu.router.slo import current_slo_tracker
+
+        advisor = current_scale_advisor()
+        if advisor is None:
+            return
+        while True:
+            await asyncio.sleep(advisor.config.interval)
+            try:
+                signals = collect_signals(
+                    get_service_discovery(),
+                    get_engine_stats_scraper().get_engine_stats(),
+                    current_slo_tracker(),
+                )
+                total_ready = 0
+                for model, sig in signals.items():
+                    advisor.evaluate(model, sig)
+                    total_ready += sig.ready
+                advisor.account(total_ready)
+                m.refresh_scale_gauges(advisor)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("scale advisor evaluation failed")
+
     # -- files / batches -------------------------------------------------------
     async def upload_file(self, request: web.Request) -> web.Response:
         from production_stack_tpu.router.services.files_service import get_storage
@@ -792,6 +883,11 @@ class RouterApp:
         from production_stack_tpu.router.slo import current_slo_tracker
 
         m.refresh_slo_gauges(current_slo_tracker())
+        from production_stack_tpu.router.scale_advisor import (
+            current_scale_advisor,
+        )
+
+        m.refresh_scale_gauges(current_scale_advisor())
         m.refresh_self_metrics()
         return web.Response(body=generate_latest(), content_type="text/plain")
 

@@ -105,6 +105,29 @@ slo_error_budget_remaining = Gauge(
     "Fraction of the 6h error budget unspent (negative = blown)",
     ["model", "slo"],
 )
+# scale advisor (router/scale_advisor.py): the native autoscaler and a
+# KEDA metrics-api scaler both follow these
+autoscaler_desired_replicas = Gauge(
+    "vllm:autoscaler_desired_replicas",
+    "Scale advisor's desired replica count for the model's pool",
+    ["model"],
+)
+autoscaler_scale_events_total = Counter(
+    "vllm:autoscaler_scale_events",
+    "Recommendation transitions by direction (up/down)",
+    ["direction"],
+)
+autoscaler_replica_hours_total = Counter(
+    "vllm:autoscaler_replica_hours",
+    "Ready-replica-hours consumed by the fleet (cost accounting)",
+)
+replica_warmup_seconds = Histogram(
+    "vllm:replica_warmup_seconds",
+    "Time a replica spent in the warming state (/ready 503 "
+    "\"warming\") before turning ready — the cold-XLA-compile cost of "
+    "each scale-up",
+    buckets=(1, 5, 15, 30, 60, 120, 300, 600, float("inf")),
+)
 # router self-metrics (reference: routers/metrics_router.py:43-57)
 router_cpu_percent = Gauge("router:cpu_usage_perc", "Router CPU usage percent")
 router_mem_percent = Gauge("router:memory_usage_perc", "Router memory usage percent")
@@ -169,6 +192,39 @@ def refresh_slo_gauges(tracker) -> None:
         for window, rate in rates.items():
             slo_burn_rate.labels(model=model, slo=slo, window=window).set(rate)
         slo_error_budget_remaining.labels(model=model, slo=slo).set(remaining)
+
+
+_last_events = {"up": 0, "down": 0}
+_last_replica_hours = 0.0
+
+
+def refresh_scale_gauges(advisor) -> None:
+    """Export the scale advisor's recommendations and counters; no-op
+    when the advisor is off. Counters are diffed against the advisor's
+    monotone totals so re-exports never double-count."""
+    global _last_replica_hours
+    if advisor is None:
+        return
+    snap = advisor.snapshot()
+    for model, rec in snap["models"].items():
+        autoscaler_desired_replicas.labels(model=model).set(
+            rec["desired_replicas"])
+    for direction, total in snap["scale_events"].items():
+        delta = total - _last_events.get(direction, 0)
+        if delta > 0:
+            autoscaler_scale_events_total.labels(
+                direction=direction).inc(delta)
+        _last_events[direction] = total
+    dh = snap["replica_hours"] - _last_replica_hours
+    if dh > 0:
+        autoscaler_replica_hours_total.inc(dh)
+        _last_replica_hours = snap["replica_hours"]
+
+
+def observe_warmup(seconds: float) -> None:
+    """A replica left the warming state: record the cold-compile cost
+    (called from service discovery's readiness probe)."""
+    replica_warmup_seconds.observe(seconds)
 
 
 def refresh_self_metrics() -> None:

@@ -1,0 +1,306 @@
+"""SLO-driven scale advisor: fuse burn rate, queue depth and KV pressure
+into a desired-replica recommendation.
+
+KEDA scales the reference stack on raw queue depth (survey §autoscaling).
+We have strictly better signals: the SRE-workbook burn rates the router
+already tracks (router/slo.py), the admission queue depth and KV-block
+pressure the stats scraper already collects (router/stats.py). This
+module fuses them into one per-model recommendation with hysteresis,
+cooldowns and min/max bounds, served on ``GET /debug/scale`` so the
+operator's native loop (operator/autoscaler.py) and a KEDA
+``metrics-api`` external scaler consume the *same* decision.
+
+The decision core is deliberately I/O-free and clock-injected: the
+operator polls it over HTTP in real time, while testing/traffic_sim.py
+drives the identical code at 10^4–10^6 simulated users in virtual time.
+
+TPU-specific capacity accounting: a fresh replica is useless until its
+warmup compiles finish (engine ``/ready`` answers 503
+``{"status": "warming"}``), so warming replicas count toward
+*provisioned* capacity (don't keep scaling up while capacity is already
+on the way) but not toward *serving* capacity (queue pressure is
+per-ready-replica), and scale-down is suppressed while anything is still
+warming — shrinking while the fleet is mid-grow is how oscillation
+starts.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from production_stack_tpu.router.slo import FAST_PAIR, SLOW_PAIR
+
+
+@dataclass
+class ScaleAdvisorConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # queue: waiting requests per READY replica considered saturated
+    target_queue: float = 8.0
+    # KV pressure: max gpu_cache_usage_perc across the fleet that forces
+    # a scale-up regardless of queue depth
+    kv_high: float = 0.85
+    # burn: fast-window (5m & 1h) burn rate that forces a scale-up —
+    # burning budget faster than earning it means latency/availability is
+    # already out of objective, queue math notwithstanding
+    burn_high: float = 1.0
+    # hysteresis: scale-down needs every signal under this fraction of
+    # its scale-up threshold, for down_stable consecutive evaluations
+    down_fraction: float = 0.5
+    down_stable: int = 3
+    up_cooldown: float = 30.0
+    down_cooldown: float = 300.0
+    interval: float = 5.0
+
+    @staticmethod
+    def from_args(args) -> Optional["ScaleAdvisorConfig"]:
+        if not getattr(args, "scale_advisor", False):
+            return None
+        return ScaleAdvisorConfig(
+            min_replicas=args.scale_min_replicas,
+            max_replicas=args.scale_max_replicas,
+            target_queue=args.scale_target_queue,
+            kv_high=args.scale_kv_high,
+            burn_high=args.scale_burn_high,
+            down_fraction=args.scale_down_fraction,
+            down_stable=args.scale_down_stable,
+            up_cooldown=args.scale_up_cooldown,
+            down_cooldown=args.scale_down_cooldown,
+            interval=args.scale_interval,
+        )
+
+
+@dataclass
+class ScaleSignals:
+    """One evaluation's fused inputs for one model's replica pool."""
+    ready: int = 0          # replicas serving traffic
+    warming: int = 0        # replicas still compiling (503 "warming")
+    draining: int = 0       # replicas shutting down (excluded everywhere)
+    waiting: float = 0.0    # admission-queue depth across the pool
+    running: float = 0.0    # in-flight requests across the pool
+    kv_usage: float = 0.0   # max gpu_cache_usage_perc across the pool
+    burn_fast: float = 0.0  # min over FAST_PAIR windows (both must burn)
+    burn_slow: float = 0.0  # min over SLOW_PAIR windows
+
+
+def pair_burn(rates: Dict[str, float], pair=FAST_PAIR) -> float:
+    """Multi-window AND, as a number: the pair's *minimum* burn rate —
+    the alert fires only when both windows exceed the threshold, so the
+    min is the actionable signal (SRE workbook ch.5)."""
+    vals = [rates.get(w, 0.0) for w in pair]
+    return min(vals) if vals else 0.0
+
+
+@dataclass
+class _ModelState:
+    last_up: float = -math.inf
+    last_change: float = -math.inf
+    down_streak: int = 0
+    last_desired: int = 0
+    recommendation: dict = field(default_factory=dict)
+
+
+class ScaleAdvisor:
+    """Per-model desired-replica recommendation with hysteresis.
+
+    ``evaluate(model, signals, now)`` is pure state-machine: no I/O, no
+    global clock — callers inject ``now`` (the router passes wall time,
+    the simulator passes virtual time).
+    """
+
+    def __init__(self, config: ScaleAdvisorConfig):
+        self.config = config
+        self._models: Dict[str, _ModelState] = {}
+        # replica-hour accounting: integral of ready replicas over time
+        self.replica_hours = 0.0
+        self._last_accounted: Optional[float] = None
+        # recommendation-transition counters (exported as
+        # vllm:autoscaler_scale_events_total{direction})
+        self.events = {"up": 0, "down": 0}
+
+    # -- decision ------------------------------------------------------------
+    def evaluate(self, model: str, sig: ScaleSignals,
+                 now: Optional[float] = None) -> dict:
+        now = now if now is not None else time.time()
+        cfg = self.config
+        st = self._models.setdefault(model, _ModelState())
+        # provisioned capacity: what we already asked for (ready + still
+        # warming); draining replicas are on their way out and don't count
+        cap = sig.ready + sig.warming
+        queue_per = sig.waiting / max(sig.ready, 1)
+        reason = "steady"
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, max(cap, 1)))
+
+        up_queue = queue_per > cfg.target_queue
+        up_kv = sig.kv_usage >= cfg.kv_high
+        up_burn = sig.burn_fast >= cfg.burn_high
+        down_ok = (
+            queue_per <= cfg.down_fraction * cfg.target_queue
+            and sig.kv_usage < cfg.down_fraction * cfg.kv_high
+            and sig.burn_fast < cfg.burn_high
+            and sig.burn_slow < cfg.burn_high
+            and sig.warming == 0
+        )
+
+        if cap < cfg.min_replicas:
+            desired, reason = cfg.min_replicas, "below-min"
+            st.down_streak = 0
+        elif up_queue or up_kv or up_burn:
+            st.down_streak = 0
+            if now - st.last_up >= cfg.up_cooldown:
+                # queue pressure sizes the step (proportional: the
+                # backlog piled up during the provision+warmup lag has to
+                # clear before TTFT degrades, so under-stepping costs more
+                # burn than overshooting costs replica-hours — hysteresis
+                # shrinks the excess afterwards); burn/KV pressure without
+                # queue evidence grows by one
+                step = 1
+                if up_queue and cfg.target_queue > 0:
+                    step = max(1, math.ceil(
+                        sig.ready * (queue_per - cfg.target_queue)
+                        / cfg.target_queue))
+                desired = min(cfg.max_replicas, cap + step)
+                reason = ("queue" if up_queue else
+                          "kv-pressure" if up_kv else "burn-rate")
+                if desired > cap:
+                    st.last_up = now
+                    st.last_change = now
+            else:
+                desired, reason = min(cfg.max_replicas, cap), "up-cooldown"
+        elif down_ok and cap > cfg.min_replicas:
+            st.down_streak += 1
+            if (st.down_streak >= cfg.down_stable
+                    and now - st.last_change >= cfg.down_cooldown):
+                desired, reason = max(cfg.min_replicas, cap - 1), "idle"
+                st.last_change = now
+                st.down_streak = 0
+            else:
+                desired, reason = cap, "down-hysteresis"
+        else:
+            st.down_streak = 0
+
+        prev = st.last_desired
+        if prev and desired > prev:
+            self.events["up"] += 1
+        elif prev and desired < prev:
+            self.events["down"] += 1
+        st.last_desired = desired
+        st.recommendation = {
+            "model": model,
+            "desired_replicas": desired,
+            "reason": reason,
+            "signals": {
+                "ready": sig.ready, "warming": sig.warming,
+                "draining": sig.draining,
+                "waiting": round(sig.waiting, 2),
+                "running": round(sig.running, 2),
+                "queue_per_replica": round(queue_per, 3),
+                "kv_usage": round(sig.kv_usage, 4),
+                "burn_fast": round(sig.burn_fast, 4),
+                "burn_slow": round(sig.burn_slow, 4),
+            },
+            "bounds": {"min": cfg.min_replicas, "max": cfg.max_replicas},
+            "ts": now,
+        }
+        return st.recommendation
+
+    # -- replica-hour accounting --------------------------------------------
+    def account(self, ready: int, now: Optional[float] = None) -> None:
+        """Integrate ready-replica count into replica-hours. Call once
+        per evaluation tick with the fleet-wide ready count."""
+        now = now if now is not None else time.time()
+        if self._last_accounted is not None and now > self._last_accounted:
+            self.replica_hours += (
+                (now - self._last_accounted) * ready / 3600.0)
+        self._last_accounted = now
+
+    # -- introspection -------------------------------------------------------
+    def recommendation(self, model: str) -> Optional[dict]:
+        st = self._models.get(model)
+        return st.recommendation if st and st.recommendation else None
+
+    def snapshot(self) -> dict:
+        """JSON document for ``GET /debug/scale`` — consumed by the
+        operator's native loop and by a KEDA metrics-api external scaler
+        (valueLocation ``models.<name>.desired_replicas``)."""
+        cfg = self.config
+        return {
+            "enabled": True,
+            "config": {
+                "min_replicas": cfg.min_replicas,
+                "max_replicas": cfg.max_replicas,
+                "target_queue": cfg.target_queue,
+                "kv_high": cfg.kv_high,
+                "burn_high": cfg.burn_high,
+                "down_fraction": cfg.down_fraction,
+                "down_stable": cfg.down_stable,
+                "up_cooldown": cfg.up_cooldown,
+                "down_cooldown": cfg.down_cooldown,
+                "interval": cfg.interval,
+            },
+            "models": {m: st.recommendation
+                       for m, st in sorted(self._models.items())
+                       if st.recommendation},
+            "replica_hours": round(self.replica_hours, 4),
+            "scale_events": dict(self.events),
+        }
+
+
+# -- router glue: build signals from the live monitors -----------------------
+
+def collect_signals(discovery, engine_stats, tracker,
+                    now: Optional[float] = None) -> Dict[str, ScaleSignals]:
+    """Fuse the router's live monitors into per-model ScaleSignals.
+
+    ``discovery`` supplies the replica census (ready vs warming vs
+    draining — warming is a ``/ready`` 503 with status "warming", which
+    discovery tracks via ``not_ready_reason``), ``engine_stats`` the
+    queue/KV numbers per backend URL, ``tracker`` the burn rates. A model
+    with endpoints but no stats yet still gets a (zero-signal) entry so
+    the advisor can hold min_replicas for it.
+    """
+    now = now if now is not None else time.time()
+    reasons = getattr(discovery, "not_ready_reason", {}) or {}
+    out: Dict[str, ScaleSignals] = {}
+    for ep in discovery.get_endpoint_info():
+        model = ep.model_names[0] if ep.model_names else "unknown"
+        sig = out.setdefault(model, ScaleSignals())
+        status = reasons.get(ep.url)
+        if status == "warming":
+            sig.warming += 1
+            continue  # a warming replica contributes no load stats
+        if ep.draining:
+            sig.draining += 1
+            continue
+        sig.ready += 1
+        es = engine_stats.get(ep.url)
+        if es is not None:
+            sig.waiting += es.num_queuing_requests
+            sig.running += es.num_running_requests
+            sig.kv_usage = max(sig.kv_usage, es.gpu_cache_usage_perc)
+    if tracker is not None:
+        for model, sig in out.items():
+            worst_fast = worst_slow = 0.0
+            for slo in tracker.config.objectives(model):
+                rates = tracker.burn_rates(model, slo, now)
+                worst_fast = max(worst_fast, pair_burn(rates, FAST_PAIR))
+                worst_slow = max(worst_slow, pair_burn(rates, SLOW_PAIR))
+            sig.burn_fast, sig.burn_slow = worst_fast, worst_slow
+    return out
+
+
+_advisor: Optional[ScaleAdvisor] = None
+
+
+def initialize_scale_advisor(
+        config: Optional[ScaleAdvisorConfig]) -> Optional[ScaleAdvisor]:
+    global _advisor
+    _advisor = ScaleAdvisor(config) if config is not None else None
+    return _advisor
+
+
+def current_scale_advisor() -> Optional[ScaleAdvisor]:
+    return _advisor

@@ -118,10 +118,18 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 )
         self.unhealthy: set[str] = set()
         self.sleeping: set[str] = set()
-        # backends whose /ready probe said 503 ("draining"/"stalled"):
-        # kept in the endpoint list (live streams still flow) but
-        # flagged so routing skips them for NEW requests
+        # backends whose /ready probe said 503 ("warming"/"draining"/
+        # "stalled"): kept in the endpoint list (live streams still flow
+        # on draining ones) but flagged so routing skips them for NEW
+        # requests. not_ready_reason keeps the status string so the
+        # scale advisor can tell a warming replica (capacity on the way)
+        # from a draining one (capacity on the way out).
         self.draining_urls: set[str] = set()
+        self.not_ready_reason: dict[str, str] = {}
+        # warming → ready transition accounting: when first seen warming,
+        # so the warmup (cold XLA compile) duration can be observed into
+        # vllm:replica_warmup_seconds on the flip to ready
+        self._warming_since: dict[str, float] = {}
         self._fail_counts: dict[str, int] = {}
         self._task: Optional[asyncio.Task] = None
         self._queried_models: dict[str, list[str]] = {}
@@ -181,19 +189,40 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     if url in self.draining_urls:
                         logger.info("endpoint %s ready again, restoring "
                                     "to rotation", url)
+                    warming_t0 = self._warming_since.pop(url, None)
+                    if warming_t0 is not None:
+                        # cold-compile pre-warm finished: the replica is
+                        # now safe to cut into the ring
+                        from production_stack_tpu.router import metrics as m
+
+                        elapsed = time.time() - warming_t0
+                        m.observe_warmup(elapsed)
+                        logger.info("endpoint %s finished warmup in "
+                                    "%.1fs, entering rotation", url, elapsed)
                     self.draining_urls.discard(url)
+                    self.not_ready_reason.pop(url, None)
                 elif resp.status == 503:
+                    try:
+                        why = (await resp.json()).get("status", "draining")
+                    except Exception:
+                        why = "draining"
                     if url not in self.draining_urls:
-                        try:
-                            why = (await resp.json()).get("status", "draining")
-                        except Exception:
-                            why = "draining"
                         logger.warning(
                             "endpoint %s reports %s; skipping for new "
                             "requests (live streams keep flowing)", url, why)
                     self.draining_urls.add(url)
+                    self.not_ready_reason[url] = why
+                    if why == "warming":
+                        self._warming_since.setdefault(url, time.time())
+                    else:
+                        # a replica that went warming → draining never
+                        # finished its compile; don't count that as a
+                        # warmup duration
+                        self._warming_since.pop(url, None)
                 else:
                     self.draining_urls.discard(url)
+                    self.not_ready_reason.pop(url, None)
+                    self._warming_since.pop(url, None)
         except Exception:
             # unreachable: the /v1/models probe below decides health;
             # a definitive draining verdict needs an actual 503

@@ -97,19 +97,20 @@ class _BinSeries:
     def __init__(self):
         self.bins: deque = deque()
 
-    def add(self, ok: bool, ts: float) -> None:
+    def add(self, ok: bool, ts: float, count: int = 1) -> None:
         start = ts - ts % BIN_SECONDS
         if not self.bins or self.bins[-1][0] < start:
             self.bins.append([start, 0, 0])
             while self.bins and self.bins[0][0] < start - _HORIZON:
                 self.bins.popleft()
         # out-of-order stamps land in the newest bin — close enough for
-        # 10s-granularity accounting
+        # 10s-granularity accounting; count>1 records a weighted batch in
+        # one shot (the virtual-time traffic simulator's bulk path)
         row = self.bins[-1]
         if ok:
-            row[1] += 1
+            row[1] += count
         else:
-            row[2] += 1
+            row[2] += count
 
     def bad_fraction(self, window: float, now: float) -> float:
         good = bad = 0
@@ -134,30 +135,30 @@ class SLOTracker:
 
     # -- ingest --------------------------------------------------------------
     def _observe(self, model: str, slo: str, ok: bool,
-                 ts: Optional[float]) -> None:
+                 ts: Optional[float], count: int = 1) -> None:
         if slo not in self.config.objectives(model):
             return
         key = (model, slo)
         series = self._series.get(key)
         if series is None:
             series = self._series[key] = _BinSeries()
-        series.add(ok, ts if ts is not None else time.time())
+        series.add(ok, ts if ts is not None else time.time(), count)
 
     def record_ttft(self, model: str, seconds: float,
-                    ts: Optional[float] = None) -> None:
+                    ts: Optional[float] = None, count: int = 1) -> None:
         obj = self.config.objectives(model).get("ttft_p95")
         if obj:
-            self._observe(model, "ttft_p95", seconds <= obj[0], ts)
+            self._observe(model, "ttft_p95", seconds <= obj[0], ts, count)
 
     def record_itl(self, model: str, seconds: float,
-                   ts: Optional[float] = None) -> None:
+                   ts: Optional[float] = None, count: int = 1) -> None:
         obj = self.config.objectives(model).get("itl_p95")
         if obj:
-            self._observe(model, "itl_p95", seconds <= obj[0], ts)
+            self._observe(model, "itl_p95", seconds <= obj[0], ts, count)
 
     def record_attempt(self, model: str, ok: bool,
-                       ts: Optional[float] = None) -> None:
-        self._observe(model, "availability", ok, ts)
+                       ts: Optional[float] = None, count: int = 1) -> None:
+        self._observe(model, "availability", ok, ts, count)
 
     # -- reductions ----------------------------------------------------------
     def burn_rates(self, model: str, slo: str,

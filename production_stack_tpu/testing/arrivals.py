@@ -1,0 +1,202 @@
+"""Session arrival processes, shared by the load bench and the traffic
+simulator.
+
+``benchmarks/multi_round_qa.py`` (real time, against a live router) and
+``testing/traffic_sim.py`` (virtual time, against a simulated fleet)
+both draw their session arrivals from here, so a bench run and a
+simulator run with the same ``(kind, rate, seed)`` produce the *same*
+arrival timestamps — the simulator's scaling verdicts transfer to the
+bench workload and vice versa.
+
+Processes
+---------
+``constant``   deterministic ``1/rate`` gaps (the bench's historical
+               open-loop pacing).
+``poisson``    homogeneous Poisson: i.i.d. exponential gaps at ``rate``.
+``bursty``     Markov-modulated Poisson: a base state at ``rate`` and a
+               burst state at ``burst_factor * rate``; exponential dwell
+               times put ``burst_fraction`` of wall time in the burst
+               state. Models thundering herds / retry storms.
+``diurnal``    inhomogeneous Poisson with a raised-cosine day: the
+               instantaneous rate swings between ``trough * rate`` and
+               ``rate`` over ``period`` seconds (peak at mid-period).
+               Sampled by Lewis-Shedler thinning against the peak rate.
+
+Everything is seeded and self-contained (``random.Random``; no numpy),
+so arrival sequences are reproducible across processes and platforms.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Iterator, Optional
+
+ARRIVAL_KINDS = ("constant", "poisson", "bursty", "diurnal")
+
+
+def _poisson_draw(lam: float, rng: random.Random) -> int:
+    """Poisson(lam) variate. Knuth for small lam; normal approximation
+    above 64 (exact tails don't matter at fleet scale, determinism and
+    O(1) cost do)."""
+    if lam <= 0:
+        return 0
+    if lam > 64:
+        return max(0, int(round(rng.gauss(lam, math.sqrt(lam)))))
+    limit = math.exp(-lam)
+    n, prod = 0, rng.random()
+    while prod > limit:
+        n += 1
+        prod *= rng.random()
+    return n
+
+
+class ArrivalProcess:
+    """Seeded arrival-time generator over one of ``ARRIVAL_KINDS``.
+
+    Two consumption styles, usable together on one instance:
+
+    - ``next_after(t)`` / ``iter_arrivals(horizon)``: exact per-arrival
+      timestamps (the bench's pacing loop).
+    - ``sample_count(t, dt)``: Poisson draw of the number of arrivals in
+      ``[t, t+dt)`` from the same rate function (the tick-based
+      simulator, where 10^6 users make per-arrival events unaffordable).
+    """
+
+    def __init__(self, kind: str, rate: float, seed: int = 0, *,
+                 burst_factor: float = 8.0, burst_fraction: float = 0.1,
+                 period: float = 3600.0, trough: float = 0.2,
+                 phase: float = 0.0):
+        if kind not in ARRIVAL_KINDS:
+            raise ValueError(
+                f"unknown arrival kind {kind!r}; choose from "
+                f"{', '.join(ARRIVAL_KINDS)}")
+        if rate <= 0:
+            raise ValueError("arrival rate must be > 0")
+        self.kind = kind
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.burst_factor = max(1.0, float(burst_factor))
+        self.burst_fraction = min(max(float(burst_fraction), 0.0), 1.0)
+        self.period = float(period)
+        self.trough = min(max(float(trough), 0.0), 1.0)
+        self.phase = float(phase)
+        self._rng = random.Random(self.seed)
+        # bursty: current modulation state and when it expires
+        self._burst = False
+        self._state_until = 0.0
+
+    # -- rate function ------------------------------------------------------
+    def rate_at(self, t: float) -> float:
+        """Instantaneous expected arrival rate at virtual time ``t``
+        (arrivals/second). For ``bursty`` this is the *mean* rate — the
+        sampled paths modulate around it."""
+        if self.kind == "diurnal":
+            x = ((t + self.phase) % self.period) / self.period
+            return self.rate * (
+                self.trough + (1.0 - self.trough) * 0.5
+                * (1.0 - math.cos(2.0 * math.pi * x)))
+        return self.rate
+
+    def peak_rate(self) -> float:
+        if self.kind == "bursty":
+            return self.rate * self.burst_factor
+        return self.rate
+
+    # -- per-arrival sampling ----------------------------------------------
+    def _bursty_rate(self, t: float) -> float:
+        """Advance the two-state Markov modulation to ``t`` and return
+        the state's rate. Dwell times are exponential with means chosen
+        so the burst state owns ``burst_fraction`` of wall time (mean
+        cycle 60s)."""
+        cycle = 60.0
+        mean_burst = max(cycle * self.burst_fraction, 1e-6)
+        mean_base = max(cycle - mean_burst, 1e-6)
+        while t >= self._state_until:
+            self._burst = not self._burst
+            dwell = self._rng.expovariate(
+                1.0 / (mean_burst if self._burst else mean_base))
+            self._state_until += dwell
+        return self.rate * (self.burst_factor if self._burst else 1.0)
+
+    def next_after(self, t: float) -> float:
+        """The first arrival strictly after ``t``."""
+        if self.kind == "constant":
+            gap = 1.0 / self.rate
+            k = math.floor(t / gap + 1e-9) + 1
+            return k * gap
+        if self.kind == "poisson":
+            return t + self._rng.expovariate(self.rate)
+        if self.kind == "bursty":
+            now = t
+            while True:
+                lam = self._bursty_rate(now)
+                gap = self._rng.expovariate(lam)
+                # re-draw when the gap crosses a modulation boundary so
+                # the burst state's higher rate actually applies there
+                if now + gap <= self._state_until:
+                    return now + gap
+                now = self._state_until
+        # diurnal: thinning against the peak rate
+        now = t
+        while True:
+            now += self._rng.expovariate(self.rate)
+            if self._rng.random() * self.rate <= self.rate_at(now):
+                return now
+
+    def iter_arrivals(self, horizon: float,
+                      limit: Optional[int] = None) -> Iterator[float]:
+        """Arrival timestamps in ``(0, horizon]``, at most ``limit``."""
+        t, n = 0.0, 0
+        while True:
+            t = self.next_after(t)
+            if t > horizon or (limit is not None and n >= limit):
+                return
+            n += 1
+            yield t
+
+    # -- tick-based sampling (the simulator) --------------------------------
+    def sample_count(self, t: float, dt: float) -> int:
+        """Number of arrivals in ``[t, t+dt)`` — one Poisson draw from
+        the integrated rate (bursty: the modulated state rate)."""
+        lam = (self._bursty_rate(t) if self.kind == "bursty"
+               else self.rate_at(t + dt / 2.0)) * dt
+        if self.kind == "constant":
+            # deterministic: accumulate exact fractional arrivals
+            whole = math.floor((t + dt) * self.rate + 1e-9) \
+                - math.floor(t * self.rate + 1e-9)
+            return int(whole)
+        return _poisson_draw(lam, self._rng)
+
+
+def add_arrival_args(parser, default_rate_flag: str = "--qps") -> None:
+    """The shared CLI surface: ``benchmarks/multi_round_qa.py`` and
+    ``testing/traffic_sim.py`` register identical flags so one workload
+    spec drives both."""
+    parser.add_argument(
+        "--arrival-process", default="constant", choices=ARRIVAL_KINDS,
+        help="session arrival process; the rate comes from "
+             f"{default_rate_flag} (constant keeps the legacy uniform "
+             "pacing)")
+    parser.add_argument("--arrival-seed", type=int, default=0,
+                        help="seed for the arrival process (same seed + "
+                             "same process = identical workload in bench "
+                             "and simulator)")
+    parser.add_argument("--arrival-burst-factor", type=float, default=8.0,
+                        help="bursty: burst-state rate multiplier")
+    parser.add_argument("--arrival-burst-fraction", type=float, default=0.1,
+                        help="bursty: fraction of wall time in the burst "
+                             "state")
+    parser.add_argument("--arrival-period", type=float, default=3600.0,
+                        help="diurnal: seconds per day-cycle (compressed "
+                             "days make short drills)")
+    parser.add_argument("--arrival-trough", type=float, default=0.2,
+                        help="diurnal: trough rate as a fraction of peak")
+
+
+def process_from_args(args, rate: float) -> ArrivalProcess:
+    return ArrivalProcess(
+        args.arrival_process, rate, seed=args.arrival_seed,
+        burst_factor=args.arrival_burst_factor,
+        burst_fraction=args.arrival_burst_fraction,
+        period=args.arrival_period, trough=args.arrival_trough)

@@ -34,7 +34,8 @@ class FakeEngine:
                  capabilities: "list[str] | None" = None,
                  faults: Optional[FaultSpec] = None,
                  watchdog_stall_seconds: float = 0.0,
-                 tokens_per_chunk: int = 1):
+                 tokens_per_chunk: int = 1,
+                 warmup_seconds: float = 0.0):
         self.model = model
         self.tps = tokens_per_second
         self.ttft = ttft
@@ -66,6 +67,16 @@ class FakeEngine:
         self.draining = False
         self.drain_rejected = 0
         self.watchdog_stall_seconds = watchdog_stall_seconds
+        # pre-warm emulation (the real engine's cold-XLA-compile phase):
+        # /ready answers 503 {"status": "warming"} for warmup_seconds
+        # after construction, standing in for the background warmup task —
+        # the autoscaler/pre-warm drills scale a fleet of these
+        self.warmup_seconds = warmup_seconds
+        self._warm_t0 = time.monotonic()
+        # queue-depth knob: tests and the traffic simulator set this to
+        # shape vllm:num_requests_waiting (the scale advisor's primary
+        # signal) without generating real traffic
+        self.waiting = 0
 
     def build_app(self) -> web.Application:
         app = web.Application(
@@ -141,10 +152,24 @@ class FakeEngine:
         return (t0 is not None
                 and time.monotonic() - t0 >= self.watchdog_stall_seconds)
 
+    def _warming(self) -> bool:
+        return (self.warmup_seconds > 0
+                and time.monotonic() - self._warm_t0 < self.warmup_seconds)
+
+    def finish_warmup(self) -> None:
+        """Force the warming window closed (drills that don't want to
+        wait wall time for the emulated compile)."""
+        self.warmup_seconds = 0.0
+
     async def ready(self, request):
         if self.draining:
             return web.json_response(
                 {"status": "draining", "inflight": self.running},
+                status=503)
+        if self._warming():
+            elapsed = time.monotonic() - self._warm_t0
+            return web.json_response(
+                {"status": "warming", "warming_for": round(elapsed, 3)},
                 status=503)
         if self._stalled():
             return web.json_response({"status": "stalled"}, status=503)
@@ -187,7 +212,11 @@ class FakeEngine:
             "# TYPE vllm:num_requests_running gauge",
             f'vllm:num_requests_running{{model_name="{self.model}"}} {self.running}',
             "# TYPE vllm:num_requests_waiting gauge",
-            f'vllm:num_requests_waiting{{model_name="{self.model}"}} 0',
+            f'vllm:num_requests_waiting{{model_name="{self.model}"}} '
+            f"{self.waiting}",
+            "# TYPE vllm:engine_warming gauge",
+            f'vllm:engine_warming{{model_name="{self.model}"}} '
+            f"{1 if self._warming() else 0}",
             "# TYPE vllm:gpu_cache_usage_perc gauge",
             f'vllm:gpu_cache_usage_perc{{model_name="{self.model}"}} '
             f"{min(self.running / 32, 1.0)}",
@@ -322,6 +351,10 @@ def main(argv=None):
     p.add_argument("--tokens-per-second", type=float, default=500)
     p.add_argument("--ttft", type=float, default=0.02)
     p.add_argument("--kv-hit-tokens", type=int, default=0)
+    p.add_argument("--warmup-seconds", type=float, default=0.0,
+                   help="emulate the cold-XLA-compile pre-warm: /ready "
+                        "answers 503 {\"status\": \"warming\"} for this "
+                        "long after start")
     p.add_argument(
         "--fault-injection", default=None, metavar="SPEC",
         help="fault spec string, e.g. error_rate=0.5,stall_ms=500 "
@@ -335,7 +368,8 @@ def main(argv=None):
         spec_str = os.environ.get("FAULT_INJECTION")
     faults = FaultSpec.parse(spec_str) if spec_str else None
     engine = FakeEngine(args.model, args.tokens_per_second, args.ttft,
-                        kv_hit_tokens=args.kv_hit_tokens, faults=faults)
+                        kv_hit_tokens=args.kv_hit_tokens, faults=faults,
+                        warmup_seconds=args.warmup_seconds)
     web.run_app(engine.build_app(), host=args.host, port=args.port,
                 access_log=None)
 

@@ -1,0 +1,618 @@
+"""Million-user virtual-time traffic simulator: the autoscaler's proof
+harness.
+
+Replays diurnal / bursty / multi-model request mixes from 10^4 to 10^6
+simulated users against a simulated TPU replica fleet, driving the REAL
+decision stack end to end:
+
+- the real ``ScaleAdvisor`` (router/scale_advisor.py) evaluates fused
+  queue/KV/burn signals each advisor interval,
+- the real ``AutoscalerLoop`` (operator/autoscaler.py) polls it through a
+  ``SimFleetActuator`` and actuates the fleet — scale-up replicas go
+  through provisioning → warming (XLA compile) → ready, scale-down goes
+  through drain-and-empty, exactly the Kubernetes lifecycle,
+- the real ``SLOTracker`` (router/slo.py) ingests every TTFT/ITL/
+  availability observation with virtual timestamps and weighted counts.
+
+Only the *fleet* is simulated: replicas are processor-sharing token
+servers with KV-block accounting, and users arrive through
+testing/arrivals.py (the same processes benchmarks/multi_round_qa.py
+replays against real deployments).
+
+Scale trick: arrivals are **weighted request groups** — one Python
+object stands for ``weight`` identical concurrent streams, and SLO
+observations are recorded with ``count=weight`` — so a 10^6-user soak
+allocates roughly the same object count as a 10^4-user drill.
+
+The run artifact (``--output``) reports per-model burn rates,
+replica-hours (vs. flat peak provisioning), scale events, warmup
+durations, and the violation counters the acceptance gate asserts are
+zero: cold routes (a request sent to a warming replica), failed streams,
+leaked KV blocks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import random
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+from production_stack_tpu.operator.autoscaler import (
+    AutoscalerConfig, AutoscalerLoop, FleetActuator, ReplicaInfo,
+)
+from production_stack_tpu.router.scale_advisor import (
+    ScaleAdvisor, ScaleAdvisorConfig, ScaleSignals, pair_burn,
+)
+from production_stack_tpu.router.slo import (
+    FAST_PAIR, SLOW_PAIR, SLOConfig, SLOTracker,
+)
+from production_stack_tpu.testing.arrivals import (
+    ArrivalProcess, add_arrival_args, process_from_args,
+)
+
+PROVISIONING, WARMING, READY, DRAINING, GONE = (
+    "provisioning", "warming", "ready", "draining", "gone")
+
+
+@dataclass
+class ReplicaSpec:
+    """Capacity model for one simulated TPU engine replica."""
+    tokens_per_sec: float = 16000.0    # decode throughput, shared
+    prefill_tokens_per_sec: float = 20000.0
+    max_streams: int = 256             # concurrent decode slots
+    kv_blocks: int = 4096
+    block_tokens: int = 16
+    provision_s: float = 15.0          # pod schedule + container start
+    warmup_s: float = 45.0             # XLA warmup compiles
+
+
+@dataclass
+class Group:
+    """``weight`` identical user streams travelling together."""
+    model: str
+    weight: int
+    arrived: float
+    prompt_tokens: int
+    output_tokens: int
+    admitted: float = -1.0
+    tokens_done: float = 0.0           # per-stream decode progress
+    kv: int = 0                        # blocks held (all streams)
+
+    def blocks(self, spec: ReplicaSpec) -> int:
+        per = math.ceil(
+            (self.prompt_tokens + self.output_tokens) / spec.block_tokens)
+        return per * self.weight
+
+
+class SimReplica:
+    def __init__(self, rid: str, spec: ReplicaSpec, now: float,
+                 warm: bool = False):
+        self.rid = rid
+        self.spec = spec
+        self.state = READY if warm else PROVISIONING
+        self.born = now
+        self.warm_started: Optional[float] = None
+        self.warmup_seconds = 0.0
+        self.running: List[Group] = []
+        self.queue: Deque[Group] = deque()
+        self.alloc = 0                 # KV blocks currently held
+        self.drain_deadline: Optional[float] = None
+
+    # -- capacity ------------------------------------------------------------
+    @property
+    def streams(self) -> int:
+        return sum(g.weight for g in self.running)
+
+    @property
+    def load(self) -> float:
+        return self.streams + sum(g.weight for g in self.queue)
+
+    def kv_usage(self) -> float:
+        return self.alloc / self.spec.kv_blocks
+
+    # -- lifecycle -----------------------------------------------------------
+    def advance_lifecycle(self, now: float) -> None:
+        if self.state == PROVISIONING and now - self.born >= self.spec.provision_s:
+            self.state = WARMING
+            self.warm_started = now
+        if (self.state == WARMING
+                and now - self.warm_started >= self.spec.warmup_s):
+            self.warmup_seconds = now - self.warm_started
+            self.state = READY
+
+    def start_drain(self, now: float, grace: float) -> None:
+        if self.state in (READY, WARMING, PROVISIONING):
+            self.state = DRAINING
+            self.drain_deadline = now + grace
+
+    # -- service -------------------------------------------------------------
+    def admit_from_queue(self) -> None:
+        spec = self.spec
+        while self.queue:
+            g = self.queue[0]
+            need = g.blocks(spec)
+            if (self.streams + g.weight > spec.max_streams
+                    or self.alloc + need > spec.kv_blocks):
+                break
+            self.queue.popleft()
+            g.kv = need
+            self.alloc += need
+            self.running.append(g)
+
+    def serve(self, now: float, dt: float, sim: "ModelSim") -> None:
+        """Processor-sharing decode: total token rate split equally
+        across streams; finished groups record SLO samples and free KV."""
+        if self.state not in (READY, DRAINING):
+            return
+        if self.state == READY:
+            self.admit_from_queue()
+        streams = self.streams
+        if streams == 0:
+            return
+        per_stream = self.spec.tokens_per_sec * dt / streams
+        itl = streams / self.spec.tokens_per_sec  # seconds per token
+        done: List[Group] = []
+        for g in self.running:
+            if g.admitted < 0:
+                g.admitted = now
+                prefill = g.prompt_tokens / self.spec.prefill_tokens_per_sec
+                sim.record_ttft(g, (now - g.arrived) + prefill, now)
+            g.tokens_done += per_stream
+            if g.tokens_done >= g.output_tokens:
+                done.append(g)
+        for g in done:
+            self.running.remove(g)
+            self.alloc -= g.kv
+            sim.record_finish(g, itl, now)
+
+    def abort_all(self, sim: "ModelSim", now: float) -> None:
+        """Drain deadline: abort stragglers, free their KV (the engine's
+        drain path does the same — these count as failed streams)."""
+        for g in list(self.running):
+            self.running.remove(g)
+            self.alloc -= g.kv
+            sim.record_abort(g, now)
+        for g in list(self.queue):
+            self.queue.remove(g)
+            sim.router.pending.append(g)  # requeue unserved work
+
+
+class SimRouter:
+    """Least-loaded routing over READY replicas only; a route to anything
+    not ready is a cold route — the violation the acceptance gate pins
+    at zero."""
+
+    def __init__(self, sim: "ModelSim"):
+        self.sim = sim
+        self.pending: Deque[Group] = deque()
+        self.cold_routes = 0
+        self.routed = 0
+
+    def route(self, g: Group) -> None:
+        ready = [r for r in self.sim.fleet.alive() if r.state == READY]
+        if not ready:
+            self.pending.append(g)
+            return
+        target = min(ready, key=lambda r: (r.load, r.rid))
+        if target.state != READY:          # defensive: prove the property
+            self.cold_routes += 1
+        target.queue.append(g)
+        self.routed += 1
+
+    def flush_pending(self) -> None:
+        n = len(self.pending)
+        for _ in range(n):
+            self.route(self.pending.popleft())
+
+    @property
+    def waiting(self) -> float:
+        return (sum(g.weight for g in self.pending)
+                + sum(sum(g.weight for g in r.queue)
+                      for r in self.sim.fleet.alive()))
+
+
+class SimFleet:
+    def __init__(self, model: str, spec: ReplicaSpec, now: float):
+        self.model = model
+        self.spec = spec
+        self.desired = 1
+        self._next_id = 0
+        self.replicas: List[SimReplica] = []
+        self.gone: List[SimReplica] = []
+        # bootstrap: one pre-warmed replica (the pre-scale steady state)
+        self.spawn(now, warm=True)
+
+    def spawn(self, now: float, warm: bool = False) -> SimReplica:
+        r = SimReplica(f"{self.model}-r{self._next_id}", self.spec, now,
+                       warm=warm)
+        self._next_id += 1
+        self.replicas.append(r)
+        return r
+
+    def alive(self) -> List[SimReplica]:
+        return [r for r in self.replicas if r.state != GONE]
+
+    def remove(self, r: SimReplica) -> None:
+        r.state = GONE
+        self.replicas.remove(r)
+        self.gone.append(r)
+
+    def signals(self, router: SimRouter,
+                tracker: SLOTracker, now: float) -> ScaleSignals:
+        sig = ScaleSignals()
+        for r in self.alive():
+            if r.state == READY:
+                sig.ready += 1
+                sig.running += r.streams
+                sig.kv_usage = max(sig.kv_usage, r.kv_usage())
+            elif r.state in (WARMING, PROVISIONING):
+                sig.warming += 1
+            elif r.state == DRAINING:
+                sig.draining += 1
+        sig.waiting = router.waiting
+        worst_fast = worst_slow = 0.0
+        for slo in tracker.config.objectives(self.model):
+            rates = tracker.burn_rates(self.model, slo, now)
+            worst_fast = max(worst_fast, pair_burn(rates, FAST_PAIR))
+            worst_slow = max(worst_slow, pair_burn(rates, SLOW_PAIR))
+        sig.burn_fast, sig.burn_slow = worst_fast, worst_slow
+        return sig
+
+
+class SimFleetActuator(FleetActuator):
+    """operator/autoscaler.py's FleetActuator over the simulated fleet —
+    the loop logic under test is the real one, byte for byte."""
+
+    def __init__(self, sim: "ModelSim", drain_grace: float = 120.0):
+        self.sim = sim
+        self.drain_grace = drain_grace
+        self.now = 0.0  # advanced by the tick loop
+
+    async def get_replicas(self) -> Optional[int]:
+        return self.sim.fleet.desired
+
+    async def set_replicas(self, n: int,
+                           victim: Optional[str] = None) -> None:
+        fleet = self.sim.fleet
+        fleet.desired = n
+        if victim is not None:
+            v = next((r for r in fleet.alive() if r.rid == victim), None)
+            if v is not None:
+                if v.running or v.queue:
+                    v.abort_all(self.sim, self.now)
+                self.sim.kv_leaked += max(0, v.alloc)
+                fleet.remove(v)
+        while len(fleet.alive()) < n:
+            fleet.spawn(self.now)
+
+    async def endpoints(self) -> List[ReplicaInfo]:
+        out = []
+        for r in self.sim.fleet.alive():
+            status = {PROVISIONING: "unknown", WARMING: "warming",
+                      READY: "ready", DRAINING: "draining"}[r.state]
+            out.append(ReplicaInfo(
+                ref=r.rid, url=r.rid, status=status,
+                running=float(r.streams),
+                waiting=float(sum(g.weight for g in r.queue))))
+        return out
+
+    async def drain(self, replica: ReplicaInfo) -> bool:
+        r = next((x for x in self.sim.fleet.alive()
+                  if x.rid == replica.ref), None)
+        if r is None:
+            return False
+        r.start_drain(self.now, self.drain_grace)
+        # queued-but-unstarted work goes back through the router
+        for g in list(r.queue):
+            r.queue.remove(g)
+            self.sim.router.pending.append(g)
+        return True
+
+
+@dataclass
+class Workload:
+    model: str
+    users: int
+    process: ArrivalProcess
+    weight: int
+    prompt_tokens: int = 200
+    output_lo: int = 60
+    output_hi: int = 140
+
+
+class ModelSim:
+    """One model's world: workload + fleet + router + real autoscaler."""
+
+    def __init__(self, wl: Workload, spec: ReplicaSpec,
+                 advisor: ScaleAdvisor, tracker: SLOTracker,
+                 loop_cfg: AutoscalerConfig, seed: int = 0):
+        self.wl = wl
+        self.tracker = tracker
+        self.advisor = advisor
+        self.fleet = SimFleet(wl.model, spec, 0.0)
+        self.router = SimRouter(self)
+        self.actuator = SimFleetActuator(self,
+                                         drain_grace=loop_cfg.drain_grace)
+        self.loop = AutoscalerLoop(self._advise, self.actuator, loop_cfg,
+                                   model=wl.model)
+        self.rng = random.Random(seed)
+        self.arrivals = 0
+        self.completed = 0
+        self.failed = 0
+        self.kv_leaked = 0
+        self.replica_seconds = 0.0
+        self.max_replicas_seen = 1
+        self.peak_burn_fast = 0.0
+
+    async def _advise(self) -> dict:
+        return self.advisor.snapshot()
+
+    # -- SLO recording (weighted; virtual ts) --------------------------------
+    def record_ttft(self, g: Group, ttft: float, now: float) -> None:
+        self.tracker.record_ttft(g.model, ttft, ts=now, count=g.weight)
+
+    def record_finish(self, g: Group, itl: float, now: float) -> None:
+        self.tracker.record_itl(g.model, itl, ts=now, count=g.weight)
+        self.tracker.record_attempt(g.model, True, ts=now, count=g.weight)
+        self.completed += g.weight
+
+    def record_abort(self, g: Group, now: float) -> None:
+        self.tracker.record_attempt(g.model, False, ts=now, count=g.weight)
+        self.failed += g.weight
+
+    # -- one virtual tick ----------------------------------------------------
+    def inject_arrivals(self, t: float, dt: float) -> None:
+        n = self.wl.process.sample_count(t, dt)
+        if n <= 0:
+            return
+        self.arrivals += n
+        w = self.wl.weight
+        full, rem = divmod(n, w)
+        sizes = [w] * full + ([rem] if rem else [])
+        for size in sizes:
+            self.router.route(Group(
+                model=self.wl.model, weight=size, arrived=t,
+                prompt_tokens=self.wl.prompt_tokens,
+                output_tokens=self.rng.randint(self.wl.output_lo,
+                                               self.wl.output_hi)))
+
+    def tick_fleet(self, now: float, dt: float) -> None:
+        self.actuator.now = now
+        for r in self.fleet.alive():
+            r.advance_lifecycle(now)
+        self.router.flush_pending()
+        ready = 0
+        for r in list(self.fleet.alive()):
+            r.serve(now, dt, self)
+            if r.state == READY:
+                ready += 1
+            elif r.state == DRAINING:
+                if not r.running and not r.queue:
+                    pass  # loop's next step shrinks through the victim
+                elif (r.drain_deadline is not None
+                      and now >= r.drain_deadline):
+                    r.abort_all(self, now)
+        self.replica_seconds += ready * dt
+        self.max_replicas_seen = max(self.max_replicas_seen,
+                                     len(self.fleet.alive()))
+
+    def advise(self, now: float) -> ScaleSignals:
+        sig = self.fleet.signals(self.router, self.tracker, now)
+        self.peak_burn_fast = max(self.peak_burn_fast, sig.burn_fast)
+        self.advisor.evaluate(self.wl.model, sig, now)
+        return sig
+
+    def drained_everything(self) -> bool:
+        return all(not r.running and not r.queue
+                   for r in self.fleet.alive()) and not self.router.pending
+
+    def residual_kv(self) -> int:
+        leaked = self.kv_leaked
+        for r in self.fleet.gone:
+            leaked += max(0, r.alloc)
+        for r in self.fleet.alive():
+            backed = sum(g.kv for g in r.running)
+            leaked += max(0, r.alloc - backed)
+        return leaked
+
+    def report(self, now: float) -> dict:
+        burns = {}
+        for slo in self.tracker.config.objectives(self.wl.model):
+            rates = self.tracker.burn_rates(self.wl.model, slo, now)
+            burns[slo] = {
+                "fast": round(pair_burn(rates, FAST_PAIR), 4),
+                "slow": round(pair_burn(rates, SLOW_PAIR), 4),
+            }
+        return {
+            "users": self.wl.users,
+            "arrival_kind": self.wl.process.kind,
+            "group_weight": self.wl.weight,
+            "arrivals": self.arrivals,
+            "completed": self.completed,
+            "failed_streams": self.failed,
+            "cold_routes": self.router.cold_routes,
+            "kv_leaked_blocks": self.residual_kv(),
+            "final_burn": burns,
+            "peak_burn_fast": round(self.peak_burn_fast, 4),
+            "replica_hours": round(self.replica_seconds / 3600.0, 4),
+            "max_replicas_seen": self.max_replicas_seen,
+            "scale_events": dict(self.loop.scale_events),
+            "warmup_seconds": [round(w, 1) for w in self.loop.warmups],
+        }
+
+
+# ---------------------------------------------------------------------------
+# scenario construction + the virtual-time main loop
+# ---------------------------------------------------------------------------
+
+def build_workloads(args) -> List[Workload]:
+    weight = max(1, args.users // args.max_groups)
+    if args.mix == "multimodel":
+        half = args.users // 2
+        rate = half * args.per_user_rate
+        return [
+            Workload("sim-chat", half,
+                     ArrivalProcess("diurnal", rate, seed=args.arrival_seed,
+                                    period=args.arrival_period,
+                                    trough=args.arrival_trough),
+                     weight),
+            Workload("sim-batch", args.users - half,
+                     ArrivalProcess("bursty", rate,
+                                    seed=args.arrival_seed + 1,
+                                    burst_factor=args.arrival_burst_factor,
+                                    burst_fraction=args.arrival_burst_fraction),
+                     weight),
+        ]
+    rate = args.users * args.per_user_rate
+    return [Workload("sim-chat", args.users,
+                     process_from_args(args, rate), weight)]
+
+
+async def simulate(args) -> dict:
+    slo_cfg = SLOConfig(ttft_p95=args.slo_ttft_p95,
+                        itl_p95=args.slo_itl_p95,
+                        availability=args.slo_availability)
+    tracker = SLOTracker(slo_cfg)
+    adv_cfg = ScaleAdvisorConfig(
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        target_queue=args.target_queue,
+        up_cooldown=args.up_cooldown, down_cooldown=args.down_cooldown,
+        down_stable=args.down_stable, interval=args.advisor_interval)
+    advisor = ScaleAdvisor(adv_cfg)
+    loop_cfg = AutoscalerConfig(
+        poll_interval=args.poll_interval, min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas, drain_grace=args.drain_grace)
+    spec = ReplicaSpec(tokens_per_sec=args.replica_tokens_per_sec,
+                       max_streams=args.replica_max_streams,
+                       kv_blocks=args.replica_kv_blocks,
+                       provision_s=args.provision_seconds,
+                       warmup_s=args.warmup_seconds)
+    sims = [ModelSim(wl, spec, advisor, tracker, loop_cfg,
+                     seed=args.arrival_seed + i)
+            for i, wl in enumerate(build_workloads(args))]
+
+    dt = args.dt
+    steps = int(args.horizon / dt)
+    next_advise = 0.0
+    next_poll = 0.0
+    for step in range(steps):
+        now = step * dt
+        for sim in sims:
+            sim.inject_arrivals(now, dt)
+            sim.tick_fleet(now, dt)
+        if now >= next_advise:
+            # replica-hours integrate fleet-wide: account() once per tick
+            # with the total ready count (per-sim calls at the same `now`
+            # would integrate only the first model's fleet)
+            total_ready = sum(sim.advise(now).ready for sim in sims)
+            advisor.account(total_ready, now)
+            next_advise = now + adv_cfg.interval
+        if now >= next_poll:
+            for sim in sims:
+                await sim.loop.step(now=now)
+            next_poll = now + loop_cfg.poll_interval
+    # cool-down: stop arrivals, let in-flight work finish (bounded)
+    now = steps * dt
+    settle_deadline = now + args.settle_seconds
+    while (now < settle_deadline
+           and not all(s.drained_everything() for s in sims)):
+        for sim in sims:
+            sim.tick_fleet(now, dt)
+        now += dt
+
+    end = now
+    flat_hours = args.max_replicas * (end / 3600.0) * len(sims)
+    models = {s.wl.model: s.report(end) for s in sims}
+    total_hours = sum(m["replica_hours"] for m in models.values())
+    return {
+        "users": args.users,
+        "mix": args.mix,
+        "horizon_seconds": args.horizon,
+        "virtual_end": round(end, 1),
+        "dt": dt,
+        "models": models,
+        "fleet": {
+            "replica_hours": round(total_hours, 4),
+            "replica_hours_flat_peak": round(flat_hours, 4),
+            "savings_vs_flat": round(1.0 - total_hours / flat_hours, 4)
+            if flat_hours else 0.0,
+            "advisor_replica_hours": round(advisor.replica_hours, 4),
+            "advisor_scale_events": dict(advisor.events),
+        },
+        "violations": {
+            "cold_routes": sum(m["cold_routes"] for m in models.values()),
+            "failed_streams": sum(m["failed_streams"]
+                                  for m in models.values()),
+            "kv_leaked_blocks": sum(m["kv_leaked_blocks"]
+                                    for m in models.values()),
+        },
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "traffic-sim",
+        description="virtual-time autoscaler drill at 10^4-10^6 users")
+    p.add_argument("--users", type=int, default=10_000)
+    p.add_argument("--mix", choices=("single", "multimodel"),
+                   default="single")
+    p.add_argument("--per-user-rate", type=float, default=0.01,
+                   help="peak requests/sec per user")
+    p.add_argument("--max-groups", type=int, default=10_000,
+                   help="target count of weighted request-group objects; "
+                        "weight = users // max-groups (the 10^6 trick)")
+    p.add_argument("--horizon", type=float, default=3600.0,
+                   help="virtual seconds of traffic")
+    p.add_argument("--dt", type=float, default=1.0)
+    p.add_argument("--settle-seconds", type=float, default=300.0)
+    add_arrival_args(p)
+    p.set_defaults(arrival_process="diurnal", arrival_period=1800.0)
+    # advisor + autoscaler knobs (mirror the router's --scale-* flags)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--target-queue", type=float, default=8.0)
+    p.add_argument("--up-cooldown", type=float, default=30.0)
+    p.add_argument("--down-cooldown", type=float, default=120.0)
+    p.add_argument("--down-stable", type=int, default=3)
+    p.add_argument("--advisor-interval", type=float, default=5.0)
+    p.add_argument("--poll-interval", type=float, default=5.0)
+    p.add_argument("--drain-grace", type=float, default=120.0)
+    # fleet capacity model
+    p.add_argument("--replica-tokens-per-sec", type=float, default=16000.0)
+    p.add_argument("--replica-max-streams", type=int, default=256)
+    p.add_argument("--replica-kv-blocks", type=int, default=4096)
+    p.add_argument("--provision-seconds", type=float, default=15.0)
+    p.add_argument("--warmup-seconds", type=float, default=45.0)
+    # SLOs under test
+    p.add_argument("--slo-ttft-p95", type=float, default=10.0)
+    p.add_argument("--slo-itl-p95", type=float, default=0.2)
+    p.add_argument("--slo-availability", type=float, default=0.999)
+    p.add_argument("--output", default=None,
+                   help="write the run artifact JSON here")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    artifact = asyncio.run(simulate(args))
+    text = json.dumps(artifact, indent=2, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    v = artifact["violations"]
+    ok = (v["cold_routes"] == 0 and v["failed_streams"] == 0
+          and v["kv_leaked_blocks"] == 0
+          and all(b["fast"] < 1.0 and b["slow"] < 1.0
+                  for m in artifact["models"].values()
+                  for b in m["final_burn"].values()))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -186,6 +186,49 @@ def test_autoscaling_renders_keda_scaledobject():
     assert trig["metadata"]["query"].startswith("sum(vllm:")
 
 
+def test_autoscaling_native_mode_skips_scaledobject():
+    """autoscaling.mode: native hands scaling to the operator's loop —
+    a rendered ScaledObject would fight it over .spec.replicas
+    (docs/autoscaling.md)."""
+    objs = render_objects(HELM, {"autoscaling": {"enabled": True,
+                                                 "mode": "native"}})
+    assert not by_kind(objs, "ScaledObject")
+    # and explicit keda keeps the render
+    objs = render_objects(HELM, {"autoscaling": {"enabled": True,
+                                                 "mode": "keda"}})
+    assert by_kind(objs, "ScaledObject")
+
+
+def test_scale_advisor_values_render_flags():
+    """routerSpec.scaleAdvisor.* maps onto the router's --scale-* flags
+    (docs/autoscaling.md); disabled (default) renders none of them."""
+    objs = render_objects(HELM, {
+        "routerSpec": {"scaleAdvisor": {
+            "enabled": True, "minReplicas": 2, "maxReplicas": 12,
+            "targetQueue": 6, "kvHigh": 0.9, "burnHigh": 1.5,
+            "downFraction": 0.4, "downStable": 5,
+            "upCooldown": 20, "downCooldown": 240, "interval": 10,
+        }},
+    })
+    args = router_args(objs)
+    assert "--scale-advisor" in args
+    for flag, value in (("--scale-min-replicas", "2"),
+                        ("--scale-max-replicas", "12"),
+                        ("--scale-target-queue", "6"),
+                        ("--scale-kv-high", "0.9"),
+                        ("--scale-burn-high", "1.5"),
+                        ("--scale-down-fraction", "0.4"),
+                        ("--scale-down-stable", "5"),
+                        ("--scale-up-cooldown", "20"),
+                        ("--scale-down-cooldown", "240"),
+                        ("--scale-interval", "10")):
+        assert flag in args, f"router missing {flag}"
+        assert args[args.index(flag) + 1] == value
+
+    args = router_args(render_objects(HELM))
+    assert not [a for a in args if a.startswith("--scale-")]
+
+
 def test_every_template_renders_alone_with_all_features_on():
     """Feature-complete render: no template may crash or emit bad YAML."""
     rendered = render_chart(HELM, {

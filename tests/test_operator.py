@@ -593,6 +593,16 @@ def test_native_decision_parity():
         (cr(model="x", autoscaling={"enabled": ""}), None, True),
         (cr(model="x", autoscaling={"enabled": "false"}), None, False),
         (cr(model="x", autoscaling={"enabled": None}), None, True),
+        # autoscaling.mode: native runs the operator's own loop (no
+        # ScaledObject; a stale one gets deleted), keda is the default
+        (cr(model="x", autoscaling={"mode": "native"}), None, True),
+        (cr(model="x", autoscaling={"mode": "native"}), None, False),
+        (cr(model="x", autoscaling={"enabled": True, "mode": "native"}),
+         live(2), True),
+        (cr(model="x", autoscaling={"enabled": False, "mode": "native"}),
+         None, True),
+        (cr(model="x", autoscaling={"mode": "keda"}), None, False),
+        (cr(model="x", autoscaling={"mode": 42}), None, True),  # non-str
     ]
     for c, lv, exists in cases:
         native = runtime_actions(c, lv, exists)
@@ -653,12 +663,14 @@ def test_reconcile_runtime_executes_compiled_decisions():
         async def _set_status(plural, name, status):
             client.calls.append(("set_status", plural, status))
 
-        async def _ensure(path, desired):
+        async def _ensure(path, desired, **kw):
             client.calls.append(("ensure", path.rsplit("/", 1)[-1],
-                                 desired["kind"]))
+                                 desired["kind"],
+                                 kw.get("preserve_replicas", False)))
 
         op._set_status = _set_status
         op._ensure = _ensure
+        op._autoscalers = {}
         cr = {"kind": "TPURuntime",
               "metadata": {"name": "m", "namespace": "default", "uid": "u"},
               "spec": spec}
@@ -676,3 +688,155 @@ def test_reconcile_runtime_executes_compiled_decisions():
                    for c in calls)
     status = [c for c in calls if c[0] == "set_status"][-1]
     assert status[2]["state"] == "Reconciled"
+
+
+def test_native_autoscaler_lifecycle_and_mode_flips():
+    """mode: native → no ScaledObject, in-process loop instead; keda→native
+    flip deletes the stale ScaledObject (it would fight the loop over
+    .spec.replicas); native→keda flip stops the loop and creates the
+    ScaledObject; children keep owner refs throughout."""
+    async def main():
+        api, ats, client, op = await start_env()
+        SCALED = f"/apis/keda.sh/v1alpha1/namespaces/{NS}/scaledobjects"
+        try:
+            cr = runtime_cr("rt5")
+            cr["spec"]["autoscaling"] = {
+                "mode": "native", "minReplicas": 1, "maxReplicas": 4,
+                # unroutable advisor: the loop must start and survive
+                # poll failures without crashing the operator
+                "advisorUrl": "http://127.0.0.1:1/debug/scale",
+                "pollingInterval": 0.05,
+            }
+            await client.create(f"{CRS}/tpuruntimes", cr)
+            deploy = await wait_for(lambda: client.get(f"{DEPLOYS}/rt5-engine"))
+            owner = deploy["metadata"]["ownerReferences"][0]
+            assert owner["kind"] == "TPURuntime" and owner["name"] == "rt5"
+            # native mode: loop registered, no ScaledObject ever created
+            await wait_for(lambda: asyncio.sleep(0, "rt5" in op._autoscalers))
+            assert await client.get(f"{SCALED}/rt5-scaledobject") is None
+            task, loop, _ = op._autoscalers["rt5"]
+            assert not task.done()
+
+            # native → keda: loop stops, ScaledObject appears
+            live = await client.get(f"{CRS}/tpuruntimes/rt5")
+            live["spec"]["autoscaling"]["mode"] = "keda"
+            await client.replace(f"{CRS}/tpuruntimes/rt5", live)
+            so = await wait_for(lambda: client.get(f"{SCALED}/rt5-scaledobject"))
+            assert so["metadata"]["ownerReferences"][0]["name"] == "rt5"
+            assert "rt5" not in op._autoscalers
+            await wait_for(lambda: asyncio.sleep(0, task.done()))
+
+            # keda → native: the stale ScaledObject is deleted, loop restarts
+            live = await client.get(f"{CRS}/tpuruntimes/rt5")
+            live["spec"]["autoscaling"]["mode"] = "native"
+            await client.replace(f"{CRS}/tpuruntimes/rt5", live)
+
+            async def scaled_gone():
+                return await client.get(f"{SCALED}/rt5-scaledobject") is None
+            await wait_for(scaled_gone)
+            await wait_for(lambda: asyncio.sleep(0, "rt5" in op._autoscalers))
+
+            # CR deletion tears the loop down
+            task2 = op._autoscalers["rt5"][0]
+            await client.delete(f"{CRS}/tpuruntimes/rt5")
+            await wait_for(lambda: asyncio.sleep(0, "rt5" not in op._autoscalers))
+            await wait_for(lambda: asyncio.sleep(0, task2.done()))
+        finally:
+            await op.stop()
+            await ats.close()
+
+    asyncio.run(main())
+
+
+def test_autoscaling_unpins_replicas_from_cr():
+    """Regression for the replicas-pinning bug: with autoscaling enabled the
+    reconciler must adopt a scaler's write to Deployment.spec.replicas
+    instead of reverting it to the CR value; with autoscaling off the CR
+    value is authoritative again."""
+    async def main():
+        api, ats, client, op = await start_env()
+        try:
+            cr = runtime_cr("rt6", replicas=2)
+            cr["spec"]["autoscaling"] = {"mode": "native", "maxReplicas": 8,
+                                         "advisorUrl": "http://127.0.0.1:1/x",
+                                         "pollingInterval": 60}
+            await client.create(f"{CRS}/tpuruntimes", cr)
+            deploy = await wait_for(lambda: client.get(f"{DEPLOYS}/rt6-engine"))
+            assert deploy["spec"]["replicas"] == 2  # CR seeds the create
+
+            # a scaler (here: externally) bumps the Deployment
+            deploy["spec"]["replicas"] = 7
+            await client.replace(f"{DEPLOYS}/rt6-engine", deploy)
+
+            # any CR touch re-reconciles; the scaler's 7 must survive
+            live = await client.get(f"{CRS}/tpuruntimes/rt6")
+            live["metadata"]["labels"] = {"touched": "1"}
+            await client.replace(f"{CRS}/tpuruntimes/rt6", live)
+
+            async def status_bumped():
+                c = await client.get(f"{CRS}/tpuruntimes/rt6")
+                return (c.get("metadata", {}).get("labels") or {}).get(
+                    "touched") == "1" and c.get("status")
+            await wait_for(status_bumped)
+            d = await client.get(f"{DEPLOYS}/rt6-engine")
+            assert d["spec"]["replicas"] == 7, "reconciler reverted the scaler"
+
+            # autoscaling off → CR value pins again
+            live = await client.get(f"{CRS}/tpuruntimes/rt6")
+            live["spec"]["autoscaling"] = {"enabled": False}
+            await client.replace(f"{CRS}/tpuruntimes/rt6", live)
+
+            async def repinned():
+                d = await client.get(f"{DEPLOYS}/rt6-engine")
+                return d["spec"]["replicas"] == 2
+            await wait_for(repinned)
+        finally:
+            await op.stop()
+            await ats.close()
+
+    asyncio.run(main())
+
+
+def test_k8s_fleet_actuator_scales_and_marks_victim():
+    """K8sFleetActuator against the fake apiserver: replicas patch, drained
+    victim annotated with pod-deletion-cost so the shrink takes the pod we
+    emptied."""
+    from production_stack_tpu.operator.autoscaler import K8sFleetActuator
+
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        api = FakeApiServer()
+        ats = TestServer(api.build_app())
+        await ats.start_server()
+        client = K8sClient(api_server=f"http://127.0.0.1:{ats.port}",
+                           token="fake")
+        try:
+            api.seed("/apis/apps/v1", NS, "deployments", {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": "rt7-engine", "namespace": NS},
+                "spec": {"replicas": 3},
+            })
+            api.seed("/api/v1", NS, "pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "rt7-engine-a", "namespace": NS,
+                             "labels": {f"{GROUP}/model": "rt7"}},
+                "status": {"podIP": "10.0.0.1"},
+            })
+            act = K8sFleetActuator(client, NS, "rt7", group=GROUP)
+            assert await act.get_replicas() == 3
+            await act.set_replicas(4)
+            d = await client.get(f"{DEPLOYS}/rt7-engine")
+            assert d["spec"]["replicas"] == 4
+            await act.set_replicas(2, victim="rt7-engine-a")
+            d = await client.get(f"{DEPLOYS}/rt7-engine")
+            assert d["spec"]["replicas"] == 2
+            pod = await client.get(f"/api/v1/namespaces/{NS}/pods/rt7-engine-a")
+            cost = pod["metadata"]["annotations"][
+                "controller.kubernetes.io/pod-deletion-cost"]
+            assert int(cost) < 0
+        finally:
+            await client.close()
+            await ats.close()
+
+    asyncio.run(main())

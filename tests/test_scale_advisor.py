@@ -1,0 +1,461 @@
+"""Scale advisor: signal fusion, hysteresis/cooldowns, bounds, the
+router's /debug/scale surface, and the operator's AutoscalerLoop
+decision mechanics over a fake fleet."""
+
+import asyncio
+
+from production_stack_tpu.operator.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerLoop,
+    FleetActuator,
+    ReplicaInfo,
+)
+from production_stack_tpu.router.scale_advisor import (
+    ScaleAdvisor,
+    ScaleAdvisorConfig,
+    ScaleSignals,
+    collect_signals,
+    current_scale_advisor,
+    initialize_scale_advisor,
+    pair_burn,
+)
+from production_stack_tpu.router.slo import (
+    SLOConfig,
+    SLOTracker,
+    initialize_slo_tracker,
+)
+
+T0 = 1_700_000_000.0
+
+
+def cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=8, target_queue=8.0,
+                kv_high=0.85, burn_high=1.0, down_fraction=0.5,
+                down_stable=3, up_cooldown=30.0, down_cooldown=300.0)
+    base.update(kw)
+    return ScaleAdvisorConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# pure decision core
+# ---------------------------------------------------------------------------
+
+def test_pair_burn_is_the_minimum_of_both_windows():
+    # multi-window AND: the alert fires only when BOTH windows burn, so
+    # the actionable number is the pair minimum
+    assert pair_burn({"5m": 10.0, "1h": 0.5}) == 0.5
+    assert pair_burn({"5m": 2.0, "1h": 3.0}) == 2.0
+    assert pair_burn({}) == 0.0
+
+
+def test_steady_state_holds_current_capacity():
+    adv = ScaleAdvisor(cfg())
+    # 15 waiting / 3 ready = 5/replica: above the scale-down band
+    # (0.5 * 8 = 4) but below the scale-up trigger (8) — dead zone holds
+    rec = adv.evaluate("m", ScaleSignals(ready=3, waiting=15.0), now=T0)
+    assert rec["desired_replicas"] == 3
+    assert rec["reason"] == "steady"
+
+
+def test_queue_pressure_scales_up_proportionally():
+    adv = ScaleAdvisor(cfg())
+    # 2 ready, 48 waiting → 24/replica vs target 8 → step ceil(2*16/8)=4
+    rec = adv.evaluate("m", ScaleSignals(ready=2, waiting=48.0), now=T0)
+    assert rec["reason"] == "queue"
+    assert rec["desired_replicas"] == 6
+
+
+def test_up_cooldown_holds_consecutive_scale_ups():
+    adv = ScaleAdvisor(cfg(up_cooldown=30.0))
+    r1 = adv.evaluate("m", ScaleSignals(ready=1, waiting=20.0), now=T0)
+    assert r1["desired_replicas"] > 1
+    # still saturated 5s later: cooldown holds at provisioned capacity
+    r2 = adv.evaluate("m", ScaleSignals(ready=1, warming=1, waiting=20.0),
+                      now=T0 + 5)
+    assert r2["reason"] == "up-cooldown"
+    assert r2["desired_replicas"] == 2
+    # after the cooldown the next step is allowed again
+    r3 = adv.evaluate("m", ScaleSignals(ready=1, warming=1, waiting=20.0),
+                      now=T0 + 31)
+    assert r3["desired_replicas"] > 2
+
+
+def test_kv_and_burn_pressure_trigger_single_step_up():
+    adv = ScaleAdvisor(cfg())
+    rec = adv.evaluate("m", ScaleSignals(ready=2, kv_usage=0.9), now=T0)
+    assert (rec["reason"], rec["desired_replicas"]) == ("kv-pressure", 3)
+    adv2 = ScaleAdvisor(cfg())
+    rec = adv2.evaluate("m", ScaleSignals(ready=2, burn_fast=1.5), now=T0)
+    assert (rec["reason"], rec["desired_replicas"]) == ("burn-rate", 3)
+
+
+def test_scale_up_clamps_at_max_replicas():
+    adv = ScaleAdvisor(cfg(max_replicas=4))
+    rec = adv.evaluate("m", ScaleSignals(ready=3, waiting=900.0), now=T0)
+    assert rec["desired_replicas"] == 4
+
+
+def test_bootstrap_below_min():
+    adv = ScaleAdvisor(cfg(min_replicas=2))
+    rec = adv.evaluate("m", ScaleSignals(ready=0), now=T0)
+    assert (rec["reason"], rec["desired_replicas"]) == ("below-min", 2)
+
+
+def test_scale_down_needs_stability_and_cooldown():
+    adv = ScaleAdvisor(cfg(down_stable=3, down_cooldown=100.0,
+                           up_cooldown=0.0))
+    # a scale-up stamps last_change: the down_cooldown counts from it
+    adv.evaluate("m", ScaleSignals(ready=3, waiting=40.0), now=T0)
+    idle = ScaleSignals(ready=4, waiting=0.0, kv_usage=0.1)
+    # three consecutive idle evals, but inside down_cooldown -> hold
+    for i in range(3):
+        rec = adv.evaluate("m", idle, now=T0 + 10 + i * 10)
+    assert rec["reason"] == "down-hysteresis"
+    assert rec["desired_replicas"] == 4
+    # past the cooldown AND stable -> one step down, never a cliff
+    rec = adv.evaluate("m", idle, now=T0 + 110)
+    assert (rec["reason"], rec["desired_replicas"]) == ("idle", 3)
+
+
+def test_busy_eval_resets_the_down_streak():
+    adv = ScaleAdvisor(cfg(down_stable=2, down_cooldown=0.0))
+    idle = ScaleSignals(ready=4, waiting=0.0)
+    adv.evaluate("m", idle, now=T0)
+    # a single busy evaluation resets the streak
+    adv.evaluate("m", ScaleSignals(ready=4, waiting=20.0), now=T0 + 40)
+    rec = adv.evaluate("m", idle, now=T0 + 80)
+    assert rec["reason"] == "down-hysteresis"
+
+
+def test_warming_replicas_suppress_scale_down():
+    adv = ScaleAdvisor(cfg(down_stable=1, down_cooldown=0.0))
+    sig = ScaleSignals(ready=3, warming=1, waiting=0.0)
+    rec = adv.evaluate("m", sig, now=T0)
+    # shrinking while capacity is still compiling = oscillation
+    assert rec["desired_replicas"] == 4
+    assert rec["reason"] == "steady"
+
+
+def test_scale_events_count_recommendation_transitions():
+    adv = ScaleAdvisor(cfg(down_stable=1, down_cooldown=0.0))
+    adv.evaluate("m", ScaleSignals(ready=1, waiting=0.0), now=T0)
+    adv.evaluate("m", ScaleSignals(ready=1, waiting=30.0), now=T0 + 40)
+    adv.evaluate("m", ScaleSignals(ready=4, waiting=0.0), now=T0 + 80)
+    assert adv.events["up"] == 1 and adv.events["down"] == 1
+
+
+def test_replica_hour_accounting_integrates_ready_time():
+    adv = ScaleAdvisor(cfg())
+    adv.account(4, now=T0)
+    adv.account(4, now=T0 + 1800)  # 4 replicas for half an hour
+    adv.account(2, now=T0 + 3600)  # 2 replicas for the next half
+    assert abs(adv.replica_hours - 3.0) < 1e-9
+
+
+def test_snapshot_shape_and_keda_value_location():
+    adv = ScaleAdvisor(cfg())
+    adv.evaluate("m", ScaleSignals(ready=2, waiting=48.0), now=T0)
+    snap = adv.snapshot()
+    assert snap["enabled"] is True
+    # the KEDA metrics-api trigger reads models.<name>.desired_replicas
+    assert snap["models"]["m"]["desired_replicas"] == 6
+    assert snap["models"]["m"]["signals"]["queue_per_replica"] == 24.0
+    assert set(snap["config"]) >= {"min_replicas", "max_replicas",
+                                   "target_queue", "down_cooldown"}
+    assert "replica_hours" in snap and "scale_events" in snap
+
+
+def test_from_args_disabled_without_flag():
+    import argparse
+
+    ns = argparse.Namespace(scale_advisor=False)
+    assert ScaleAdvisorConfig.from_args(ns) is None
+
+
+# ---------------------------------------------------------------------------
+# signal fusion from the router's live monitors
+# ---------------------------------------------------------------------------
+
+class _Disc:
+    def __init__(self, eps, reasons):
+        self._eps = eps
+        self.not_ready_reason = reasons
+
+    def get_endpoint_info(self):
+        return self._eps
+
+
+class _Stats:
+    def __init__(self, running=0, waiting=0, kv=0.0):
+        self.num_running_requests = running
+        self.num_queuing_requests = waiting
+        self.gpu_cache_usage_perc = kv
+
+
+def test_collect_signals_classifies_replica_states():
+    from production_stack_tpu.router.protocols import EndpointInfo
+
+    eps = [
+        EndpointInfo(url="http://a", model_names=["m"]),
+        EndpointInfo(url="http://b", model_names=["m"]),
+        EndpointInfo(url="http://c", model_names=["m"], draining=True),
+        EndpointInfo(url="http://d", model_names=["m"], draining=True),
+    ]
+    # d is draining because it is WARMING — capacity on the way, not
+    # capacity leaving
+    disc = _Disc(eps, {"http://d": "warming", "http://c": "draining"})
+    stats = {"http://a": _Stats(running=3, waiting=5, kv=0.4),
+             "http://b": _Stats(running=2, waiting=7, kv=0.6),
+             # warming replica stats must NOT count
+             "http://d": _Stats(running=9, waiting=99, kv=0.99)}
+    tracker = SLOTracker(SLOConfig(ttft_p95=0.2))
+    tracker.record_ttft("m", 5.0, ts=T0)  # a violation now
+    sig = collect_signals(disc, stats, tracker, now=T0 + 1)["m"]
+    assert sig.ready == 2 and sig.warming == 1 and sig.draining == 1
+    assert sig.waiting == 12 and sig.running == 5
+    assert sig.kv_usage == 0.6
+    assert sig.burn_fast > 0  # fused from the tracker
+
+
+def test_collect_signals_without_tracker_or_stats():
+    from production_stack_tpu.router.protocols import EndpointInfo
+
+    disc = _Disc([EndpointInfo(url="http://a", model_names=["m"])], {})
+    sig = collect_signals(disc, {}, None, now=T0)["m"]
+    assert sig.ready == 1 and sig.burn_fast == 0.0
+
+
+# ---------------------------------------------------------------------------
+# router surface: /debug/scale + autoscaler gauges
+# ---------------------------------------------------------------------------
+
+def test_router_debug_scale_and_gauges():
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import RouterApp, build_parser
+
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", "http://127.0.0.1:1",
+            "--static-models", "tiny-llama",
+            "--scale-advisor",
+            "--scale-max-replicas", "5",
+            "--scale-target-queue", "4",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            adv = current_scale_advisor()
+            assert adv is not None
+            assert adv.config.max_replicas == 5
+            adv.evaluate("tiny-llama",
+                         ScaleSignals(ready=1, waiting=40.0), now=T0)
+            adv.account(1, now=T0)
+            adv.account(1, now=T0 + 3600)
+
+            r = await client.get("/debug/scale")
+            data = await r.json()
+            assert data["enabled"] is True
+            rec = data["models"]["tiny-llama"]
+            assert rec["desired_replicas"] == 5  # clamped at max
+            assert rec["reason"] == "queue"
+            assert data["replica_hours"] == 1.0
+
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert ('vllm:autoscaler_desired_replicas'
+                    '{model="tiny-llama"} 5.0') in text
+            assert 'vllm:autoscaler_scale_events_total' in text
+            assert 'vllm:autoscaler_replica_hours_total 1.0' in text
+            assert 'vllm:replica_warmup_seconds_bucket' in text
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(main())
+    finally:
+        initialize_scale_advisor(None)
+        initialize_slo_tracker(None)
+
+
+def test_router_debug_scale_disabled_without_flag():
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import RouterApp, build_parser
+
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", "http://127.0.0.1:1",
+            "--static-models", "tiny-llama",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            assert current_scale_advisor() is None
+            r = await client.get("/debug/scale")
+            assert (await r.json())["enabled"] is False
+            r = await client.get("/metrics")  # refresh tolerates None
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(main())
+    finally:
+        initialize_scale_advisor(None)
+
+
+# ---------------------------------------------------------------------------
+# AutoscalerLoop over a scripted fleet
+# ---------------------------------------------------------------------------
+
+class ScriptedFleet(FleetActuator):
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.eps: list[ReplicaInfo] = [
+            ReplicaInfo(ref=f"p{i}", status="ready")
+            for i in range(replicas)
+        ]
+        self.drained: list[str] = []
+        self.set_calls: list[tuple] = []
+
+    async def get_replicas(self):
+        return self.replicas
+
+    async def set_replicas(self, n, victim=None):
+        self.set_calls.append((n, victim))
+        self.replicas = n
+        if victim is not None:
+            self.eps = [e for e in self.eps if e.ref != victim]
+
+    async def endpoints(self):
+        return list(self.eps)
+
+    async def drain(self, replica):
+        self.drained.append(replica.ref)
+        for e in self.eps:
+            if e.ref == replica.ref:
+                e.status = "draining"
+        return True
+
+
+def _advisor_returning(desired, model="m"):
+    async def fetch():
+        return {"enabled": True,
+                "models": {model: {"desired_replicas": desired}}}
+    return fetch
+
+
+def test_loop_scales_up_to_advised():
+    fleet = ScriptedFleet(replicas=1)
+    loop = AutoscalerLoop(_advisor_returning(3), fleet,
+                          AutoscalerConfig(), model="m")
+    action = asyncio.run(loop.step(now=T0))
+    assert action["action"] == "up"
+    assert fleet.set_calls == [(3, None)]
+    assert loop.scale_events["up"] == 1
+
+
+def test_loop_scale_down_goes_through_drain_then_shrink():
+    fleet = ScriptedFleet(replicas=3)
+    fleet.eps[0].running = 5.0
+    fleet.eps[1].running = 1.0  # least loaded -> the victim
+    fleet.eps[2].running = 9.0
+    loop = AutoscalerLoop(_advisor_returning(2), fleet,
+                          AutoscalerConfig(), model="m")
+    a1 = asyncio.run(loop.step(now=T0))
+    assert a1["action"] == "drain" and a1["victim"] == "p1"
+    assert fleet.drained == ["p1"]
+    assert fleet.set_calls == []  # NOT shrunk yet: victim still busy
+
+    # victim still has in-flight work: the loop waits
+    fleet.eps[1].running = 1.0
+    a2 = asyncio.run(loop.step(now=T0 + 5))
+    assert a2["action"] == "none" and a2["reason"] == "draining"
+
+    # victim empty -> replicas patched down with the victim named
+    fleet.eps[1].running = 0.0
+    a3 = asyncio.run(loop.step(now=T0 + 10))
+    assert a3["action"] == "down"
+    assert fleet.set_calls == [(2, "p1")]
+    assert loop.scale_events["down"] == 1
+
+
+def test_loop_drain_grace_forces_shrink():
+    fleet = ScriptedFleet(replicas=2)
+    fleet.eps[0].running = 7.0
+    fleet.eps[1].running = 9.0
+    loop = AutoscalerLoop(_advisor_returning(1), fleet,
+                          AutoscalerConfig(drain_grace=60.0), model="m")
+    asyncio.run(loop.step(now=T0))
+    assert fleet.drained == ["p0"]  # 7 < 9: the least-loaded victim
+    # the victim never empties; past the grace the engine-side drain
+    # deadline has already aborted stragglers, so the loop shrinks anyway
+    action = asyncio.run(loop.step(now=T0 + 61))
+    assert action["action"] == "down" and action["emptied"] is False
+
+
+def test_loop_never_drains_below_ready_capacity_needed():
+    fleet = ScriptedFleet(replicas=3)
+    fleet.eps[1].status = "warming"
+    fleet.eps[2].status = "warming"
+    loop = AutoscalerLoop(_advisor_returning(1), fleet,
+                          AutoscalerConfig(), model="m")
+    action = asyncio.run(loop.step(now=T0))
+    # only one READY replica and the advisor wants one: nothing to drain
+    assert action["action"] == "none"
+    assert action["reason"] == "not-enough-ready"
+    assert fleet.drained == []
+
+
+def test_loop_records_warmup_transitions_and_replica_hours():
+    fleet = ScriptedFleet(replicas=2)
+    fleet.eps[1].status = "warming"
+    loop = AutoscalerLoop(_advisor_returning(2), fleet,
+                          AutoscalerConfig(), model="m")
+    asyncio.run(loop.step(now=T0))
+    fleet.eps[1].status = "ready"
+    asyncio.run(loop.step(now=T0 + 40))
+    assert loop.warmups == [40.0]
+    # 1 ready replica for 40s, then 2
+    assert abs(loop.replica_hours - 40.0 / 3600.0) < 1e-9
+    stats = loop.stats()
+    assert stats["warmups"] == [40.0]
+    assert stats["pending_drain"] is None
+
+
+def test_loop_holds_without_advice_or_fleet():
+    fleet = ScriptedFleet(replicas=2)
+
+    async def no_advice():
+        return None
+
+    loop = AutoscalerLoop(no_advice, fleet, AutoscalerConfig(), model="m")
+    action = asyncio.run(loop.step(now=T0))
+    assert action == {"action": "none", "reason": "no-advice"}
+
+    class GoneFleet(ScriptedFleet):
+        async def get_replicas(self):
+            return None
+
+    loop2 = AutoscalerLoop(_advisor_returning(3), GoneFleet(),
+                           AutoscalerConfig(), model="m")
+    action = asyncio.run(loop2.step(now=T0))
+    assert action["reason"] == "no-fleet"
+
+
+def test_loop_multi_model_takes_the_hungriest_recommendation():
+    fleet = ScriptedFleet(replicas=2)
+
+    async def fetch():
+        return {"enabled": True,
+                "models": {"a": {"desired_replicas": 1},
+                           "b": {"desired_replicas": 4}}}
+
+    loop = AutoscalerLoop(fetch, fleet, AutoscalerConfig(), model=None)
+    action = asyncio.run(loop.step(now=T0))
+    assert action["action"] == "up" and action["to"] == 4

@@ -236,6 +236,46 @@ def test_lifecycle_surface_is_inside_the_gates():
     assert lifecycle <= documented
 
 
+def test_autoscaler_surface_is_inside_the_gates():
+    """The autoscaling surface (PR: SLO-driven autoscaler) is covered by
+    the gates, not grandfathered: config-drift sees the chart's
+    --scale-* flags as declared router CLI flags (a
+    routerSpec.scaleAdvisor template typo would be an active finding),
+    and metric-hygiene tracks the autoscaler metrics as both defined in
+    code and documented in docs/observability.md — so renaming one in
+    code, or deleting its docs row or dashboard panel, fails
+    test_repo_has_no_active_findings."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    router_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "router" / "app.py")
+    assert {"--scale-advisor", "--scale-min-replicas",
+            "--scale-max-replicas", "--scale-target-queue",
+            "--scale-kv-high", "--scale-burn-high",
+            "--scale-down-fraction", "--scale-down-stable",
+            "--scale-up-cooldown", "--scale-down-cooldown",
+            "--scale-interval"} <= router_flags
+
+    autoscaler = {"vllm:autoscaler_desired_replicas",
+                  "vllm:autoscaler_scale_events",
+                  "vllm:autoscaler_replica_hours",
+                  "vllm:replica_warmup_seconds",
+                  "vllm:engine_warming", "vllm:engine_warmup_seconds"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert autoscaler <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert autoscaler <= documented
+
+    # the chart's autoscaling.mode toggle + scaleAdvisor block must stay
+    # consumed by templates (the values-consumed gate keys off this)
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert "mode: keda" in values and "scaleAdvisor:" in values
+    scaled = (REPO / "helm" / "templates"
+              / "scaledobject-engine.yaml").read_text()
+    assert "autoscaling.mode" in scaled
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)
