@@ -122,7 +122,9 @@ async def one_request(session, args, user: UserSession, results: list):
             timeout=aiohttp.ClientTimeout(total=args.request_timeout),
         ) as resp:
             if resp.status != 200:
-                results.append({"ok": False, "error": f"HTTP {resp.status}"})
+                results.append({"ok": False, "error": f"HTTP {resp.status}",
+                                "launch": t0, "round": user.round,
+                                "user": user.uid})
                 return
             async for raw in resp.content:
                 line = raw.decode().strip()
@@ -143,7 +145,8 @@ async def one_request(session, args, user: UserSession, results: list):
                     n_out = usage.get("completion_tokens", 0)
                     n_prompt = usage.get("prompt_tokens", 0)
     except Exception as e:
-        results.append({"ok": False, "error": str(e)})
+        results.append({"ok": False, "error": str(e), "launch": t0,
+                        "round": user.round, "user": user.uid})
         return
     finally:
         user.in_flight = False
@@ -197,6 +200,33 @@ def summarize(results: list[dict], wall: float) -> dict:
     }
 
 
+def write_trace(path: str, results: list[dict], t_start: float,
+                model: str) -> int:
+    """Append one JSONL line per request: arrival offset (seconds from
+    measurement start), model, token counts, outcome — the workload
+    record ``testing/arrivals.py``'s trace source replays, so a
+    production traffic shape captured by one bench run can drive the
+    simulator (or another bench) verbatim."""
+    rows = []
+    for r in results:
+        if "launch" not in r:
+            continue
+        rows.append({
+            "offset": round(r["launch"] - t_start, 6),
+            "model": model,
+            "prompt_tokens": r.get("prompt_tokens", 0),
+            "output_tokens": r.get("output_tokens", 0),
+            "outcome": "ok" if r.get("ok") else "error",
+            "user": r.get("user"),
+            "round": r.get("round"),
+        })
+    rows.sort(key=lambda x: x["offset"])
+    with open(path, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    return len(rows)
+
+
 async def run_warmup(session, args) -> int:
     """run.sh's warmup phase: sequential single-user 2-round sessions that
     push per-user KV into the cache/offload tiers before measurement."""
@@ -228,9 +258,9 @@ async def run(args) -> dict:
     # follow Poisson/bursty/diurnal arrival timestamps at aggregate rate
     # `qps` — the same (kind, rate, seed) the traffic simulator replays,
     # so bench and simulator workloads are identical
-    proc = (process_from_args(args, args.qps)
-            if args.arrival_process != "constant" and args.qps > 0
-            else None)
+    use_proc = bool(getattr(args, "arrival_trace", None)) or (
+        args.arrival_process != "constant" and args.qps > 0)
+    proc = process_from_args(args, args.qps) if use_proc else None
     session_alive = user_gap * max(args.num_rounds - 1, 1)
     join_gap = session_alive / max(args.num_users, 1)
 
@@ -334,6 +364,10 @@ async def run(args) -> dict:
         if tasks:
             await asyncio.gather(*tasks)
     wall = time.perf_counter() - t_start
+    if getattr(args, "trace_out", None):
+        n = write_trace(args.trace_out, results, t_start, args.model)
+        print(f"trace: {n} request(s) written to {args.trace_out}",
+              flush=True)
     return summarize(results, wall)
 
 
@@ -404,6 +438,11 @@ def main(argv=None):
                    help="cap the warmup phase wall clock")
     p.add_argument("--request-timeout", type=float, default=300.0)
     p.add_argument("--output", default=None, help="write summary JSON here")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a JSONL request trace (arrival offset, "
+                        "model, token counts, outcome) replayable via the "
+                        "'trace' arrival source in testing/arrivals.py "
+                        "(sweep points append to one file)")
     p.add_argument("--qps-sweep", default=None,
                    help="comma-separated QPS values to sweep (the "
                         "reference's run.sh methodology: same workload at "
@@ -418,6 +457,9 @@ def main(argv=None):
                         "drives resilience drills from the same harness "
                         "that measures them")
     args = p.parse_args(argv)
+    if args.trace_out:
+        # truncate once up front; run() appends (sweep points share it)
+        open(args.trace_out, "w").close()
     try:
         fault_targets = parse_fault_targets(args.fault_injection or [],
                                             args.base_url)

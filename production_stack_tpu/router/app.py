@@ -239,6 +239,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flight-recorder-size", type=int, default=256,
                    help="per-request timelines kept in the router's "
                         "/debug/requests ring buffer")
+    # diagnostics & incidents (router/incidents.py; docs/observability.md)
+    p.add_argument("--no-diagnostics", dest="diagnostics",
+                   action="store_false", default=True,
+                   help="disable anomaly-triggered incident bundles "
+                        "(burn-rate pages, breaker opens, stream-resume "
+                        "failures stop capturing evidence)")
+    p.add_argument("--diagnostics-dir", default="",
+                   help="router bundle archive directory (default: a "
+                        "per-process dir under the system tmpdir)")
+    p.add_argument("--diagnostics-max-bundles", type=int, default=16,
+                   help="retention: oldest bundles evicted past this count")
+    p.add_argument("--diagnostics-max-bytes", type=int,
+                   default=64 * 1024 * 1024,
+                   help="retention: archive size cap in bytes")
+    p.add_argument("--diagnostics-cooldown", type=float, default=60.0,
+                   help="seconds between captures for the same trigger "
+                        "(incident opens bypass it)")
+    p.add_argument("--diagnostics-interval", type=float, default=5.0,
+                   help="seconds between SLO page-transition polls")
     p.add_argument("--external-providers-config", default=None,
                    help="YAML file mapping model ids to external providers")
     p.add_argument("--api-key-file", default=None)
@@ -272,6 +291,7 @@ class RouterApp:
         self.batch_processor = None
         self._log_stats_task: Optional[asyncio.Task] = None
         self._scale_task: Optional[asyncio.Task] = None
+        self._incident_task: Optional[asyncio.Task] = None
 
     # -- initialization (reference: app.py initialize_all) -------------------
     def initialize(self) -> None:
@@ -374,6 +394,16 @@ class RouterApp:
             initialize_resilience,
         )
 
+        def _breaker_state_hook(url: str, state: int) -> None:
+            m.circuit_breaker_state.labels(server=url).set(state)
+            from production_stack_tpu.router.incidents import (
+                current_incident_manager,
+            )
+
+            im = current_incident_manager()
+            if im is not None:
+                im.on_breaker_state(url, state)
+
         resilience = initialize_resilience(
             ResilienceConfig(
                 breaker_enabled=args.circuit_breaker,
@@ -391,8 +421,7 @@ class RouterApp:
                 deadline_propagation=args.deadline_propagation,
                 stream_resume=args.stream_resume,
             ),
-            breaker_state_hook=lambda url, state:
-                m.circuit_breaker_state.labels(server=url).set(state),
+            breaker_state_hook=_breaker_state_hook,
         )
 
         routing_kwargs = {
@@ -436,6 +465,18 @@ class RouterApp:
             external_providers=external,
             resilience=resilience,
             flight_recorder=self.flight_recorder,
+        )
+
+        from production_stack_tpu.router.incidents import (
+            IncidentConfig,
+            initialize_incident_manager,
+        )
+
+        initialize_incident_manager(
+            IncidentConfig.from_args(args),
+            # reuse the router's shared backend connection pool for the
+            # engine capture fan-out (lazy: the session exists at start())
+            session_provider=lambda: self.request_service.session,
         )
 
         if args.enable_batch_api:
@@ -531,6 +572,10 @@ class RouterApp:
         app.router.add_get("/debug/requests", self.debug_requests)
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/scale", self.debug_scale)
+        app.router.add_get("/debug/fleet", self.debug_fleet)
+        app.router.add_get("/debug/diagnostics", self.debug_diagnostics)
+        app.router.add_get("/debug/diagnostics/{bundle_id}",
+                           self.debug_diagnostics_bundle)
         async def _sleep(r):
             return await self.request_service.sleep_wake(r, "sleep")
 
@@ -612,6 +657,13 @@ class RouterApp:
         if current_scale_advisor() is not None:
             self._scale_task = asyncio.create_task(
                 self._scale_advisor_worker())
+        from production_stack_tpu.router.incidents import (
+            current_incident_manager,
+        )
+
+        im = current_incident_manager()
+        if im is not None and im.config.enabled:
+            self._incident_task = asyncio.create_task(im.worker())
 
     async def _on_stop(self, app) -> None:
         if self.batch_processor is not None:
@@ -626,6 +678,8 @@ class RouterApp:
             self._log_stats_task.cancel()
         if self._scale_task:
             self._scale_task.cancel()
+        if self._incident_task:
+            self._incident_task.cancel()
 
     async def _log_stats_worker(self) -> None:
         while True:
@@ -753,6 +807,54 @@ class RouterApp:
         if advisor is None:
             return web.json_response({"enabled": False})
         return web.json_response(advisor.snapshot())
+
+    async def debug_fleet(self, request: web.Request) -> web.Response:
+        """One joined snapshot of every engine (perf + KV + queue +
+        drain/watchdog/warming state) plus the router's SLO / scale /
+        incident views — the data plane behind tools/stacktop.py."""
+        from production_stack_tpu.router.fleet import fleet_snapshot
+
+        snap = await fleet_snapshot(self.request_service.session)
+        return web.json_response(snap, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def debug_diagnostics(self, request: web.Request) -> web.Response:
+        """Incident ledger + the router-tier bundle archive index.
+        Engine-tier bundles are indexed on each engine's own
+        /debug/diagnostics; incident rows carry the correlated ids."""
+        from production_stack_tpu.router.incidents import (
+            current_incident_manager,
+        )
+
+        im = current_incident_manager()
+        if im is None:
+            return web.json_response({"enabled": False})
+        return web.json_response({
+            "incidents": im.snapshot(),
+            "bundles": im.diagnostics.index(),
+        })
+
+    async def debug_diagnostics_bundle(
+            self, request: web.Request) -> web.Response:
+        """Download one router-tier bundle as a tarball."""
+        from production_stack_tpu.router.incidents import (
+            current_incident_manager,
+        )
+
+        im = current_incident_manager()
+        if im is None:
+            return web.json_response({"enabled": False}, status=400)
+        bundle_id = request.match_info["bundle_id"]
+        data = await asyncio.get_running_loop().run_in_executor(
+            None, im.diagnostics.tar_bundle, bundle_id)
+        if data is None:
+            return web.json_response(
+                {"error": {"message": f"no bundle {bundle_id!r}"}},
+                status=404)
+        return web.Response(
+            body=data, content_type="application/x-tar",
+            headers={"Content-Disposition":
+                     f'attachment; filename="{bundle_id}.tar.gz"'})
 
     async def _scale_advisor_worker(self) -> None:
         """Periodic advisor evaluation: collect signals from discovery +

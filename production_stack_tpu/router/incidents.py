@@ -1,0 +1,364 @@
+"""Router-tier incidents: correlated, fleet-wide diagnostic capture.
+
+The engine captures evidence when one of ITS bug signals fires
+(``engine/diagnostics.py``); this module does the same for the router's
+signals and adds the correlation the fleet needs: a burn-rate page
+transition (``router/slo.py``), a circuit-breaker open
+(``router/resilience.py``) or a stream-resume failure
+(``router/request_service.py``) opens an **incident** — id, trigger,
+window, implicated engines — which
+
+* captures the router's own bundle (SLO + scale + breaker + engine-stats
+  + flight-recorder views) through the same ``DiagnosticsManager``, and
+* fans a capture request out to the implicated engines
+  (``POST /debug/diagnostics/capture`` with the incident id), so the
+  engine-side bundles carry the same incident id and
+  ``GET /debug/diagnostics`` on every tier tells one joined story.
+
+Incidents close when their signal clears (page flag drops, breaker
+re-closes); ``vllm:incidents_open`` gauges the live count.  The SLO page
+flags are computed statelessly per snapshot, so this module owns the
+transition detection: a small poll loop compares each (model, slo)
+series' page flag against the previous poll.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from production_stack_tpu.engine.diagnostics import (
+    DiagnosticsConfig,
+    DiagnosticsManager,
+)
+from production_stack_tpu.router import metrics as m
+
+logger = logging.getLogger("router.incidents")
+
+_INCIDENT_TAIL = 64  # closed incidents kept in the index
+
+
+@dataclass
+class IncidentConfig:
+    enabled: bool = True
+    dir: str = ""
+    max_bundles: int = 16
+    max_bytes: int = 64 * 1024 * 1024
+    cooldown: float = 60.0
+    interval: float = 5.0  # SLO page-transition poll period
+
+    @staticmethod
+    def from_args(args) -> "IncidentConfig":
+        return IncidentConfig(
+            enabled=getattr(args, "diagnostics", True),
+            dir=getattr(args, "diagnostics_dir", ""),
+            max_bundles=getattr(args, "diagnostics_max_bundles", 16),
+            max_bytes=getattr(args, "diagnostics_max_bytes",
+                              64 * 1024 * 1024),
+            cooldown=getattr(args, "diagnostics_cooldown", 60.0),
+            interval=getattr(args, "diagnostics_interval", 5.0),
+        )
+
+
+@dataclass
+class Incident:
+    id: str
+    trigger: str
+    key: str            # dedup key: one OPEN incident per signal source
+    opened: float
+    window: dict = field(default_factory=dict)
+    status: str = "open"
+    closed: Optional[float] = None
+    close_reason: Optional[str] = None
+    bundle: Optional[str] = None          # router-tier bundle id
+    implicated: List[str] = field(default_factory=list)
+    engine_bundles: Dict[str, str] = field(default_factory=dict)
+
+    def row(self) -> dict:
+        return {
+            "id": self.id, "trigger": self.trigger, "key": self.key,
+            "opened": self.opened, "status": self.status,
+            "closed": self.closed, "close_reason": self.close_reason,
+            "window": self.window, "bundle": self.bundle,
+            "implicated": list(self.implicated),
+            "engine_bundles": dict(self.engine_bundles),
+        }
+
+
+class IncidentManager:
+    """Owns the router's bundle archive and the incident ledger.
+
+    Every entry point is loop-affine (the router is single-loop) except
+    the bundle capture itself, which ``DiagnosticsManager`` runs on its
+    own thread."""
+
+    def __init__(self, config: IncidentConfig,
+                 session_provider: Optional[Callable[[], object]] = None,
+                 clock: Callable[[], float] = time.time):
+        self.config = config
+        self.clock = clock
+        self.session_provider = session_provider
+        self.diagnostics = DiagnosticsManager(
+            DiagnosticsConfig(
+                enabled=config.enabled, dir=config.dir,
+                max_bundles=config.max_bundles, max_bytes=config.max_bytes,
+                cooldown=config.cooldown,
+            ),
+            tier="router",
+            collectors={
+                "slo.json": _collect_slo,
+                "scale.json": _collect_scale,
+                "engine_stats.json": _collect_engine_stats,
+                "endpoints.json": _collect_endpoints,
+            },
+            on_bundle=self._on_bundle,
+        )
+        self._incidents: Dict[str, Incident] = {}   # id → incident
+        self._open_by_key: Dict[str, str] = {}      # key → open incident id
+        self._page_state: Dict[tuple, bool] = {}    # (model, slo) → paged
+        self._fanout_tasks: set = set()
+
+    # -- metrics bridge ------------------------------------------------------
+    @staticmethod
+    def _on_bundle(bundle) -> None:
+        m.diagnostic_bundles_total.labels(
+            trigger=bundle.trigger, tier="router").inc()
+        m.diagnostic_capture_seconds.labels(tier="router").observe(
+            bundle.capture_seconds)
+
+    def _refresh_open_gauge(self) -> None:
+        m.incidents_open.set(len(self._open_by_key))
+
+    # -- incident lifecycle --------------------------------------------------
+    def open_incident(self, trigger: str, key: str,
+                      window: Optional[dict] = None,
+                      implicated: Optional[List[str]] = None) -> Incident:
+        """Open (or re-touch) the incident for ``key``.  Idempotent while
+        the incident is open: repeated signals update the window instead
+        of opening a duplicate."""
+        existing = self._open_by_key.get(key)
+        if existing is not None:
+            inc = self._incidents[existing]
+            if window:
+                inc.window.update(window)
+            return inc
+        inc = Incident(
+            id=f"inc-{uuid.uuid4().hex[:12]}", trigger=trigger, key=key,
+            opened=self.clock(), window=dict(window or {}),
+            implicated=list(implicated or []),
+        )
+        self._incidents[inc.id] = inc
+        self._open_by_key[key] = inc.id
+        self._trim_closed()
+        self._refresh_open_gauge()
+        logger.warning("incident %s opened (%s): %s", inc.id, trigger, key)
+        if self.config.enabled:
+            inc.bundle = self.diagnostics.trigger(
+                trigger, {"incident": inc.id, "key": key,
+                          "window": inc.window},
+                force=True)
+            self._schedule_fanout(inc)
+        return inc
+
+    def close_incident(self, key: str, reason: str) -> Optional[Incident]:
+        inc_id = self._open_by_key.pop(key, None)
+        if inc_id is None:
+            return None
+        inc = self._incidents[inc_id]
+        inc.status = "closed"
+        inc.closed = self.clock()
+        inc.close_reason = reason
+        self._refresh_open_gauge()
+        logger.warning("incident %s closed (%s): %s", inc.id, reason, key)
+        return inc
+
+    def _trim_closed(self) -> None:
+        closed = [i for i in self._incidents.values() if i.status == "closed"]
+        if len(closed) > _INCIDENT_TAIL:
+            closed.sort(key=lambda i: i.closed or 0.0)
+            for old in closed[:-_INCIDENT_TAIL]:
+                self._incidents.pop(old.id, None)
+
+    # -- correlated engine fan-out -------------------------------------------
+    def _schedule_fanout(self, inc: Incident) -> None:
+        if not inc.implicated or self.session_provider is None:
+            return
+        try:
+            task = asyncio.get_running_loop().create_task(
+                self._fanout(inc))
+        except RuntimeError:
+            return  # no loop (sync tests): snapshot-only incident
+        self._fanout_tasks.add(task)
+        task.add_done_callback(self._fanout_tasks.discard)
+
+    async def _fanout(self, inc: Incident) -> None:
+        import aiohttp
+
+        session = self.session_provider()
+        payload = {"trigger": f"incident_{inc.trigger}",
+                   "incident": inc.id,
+                   "detail": {"key": inc.key, "window": inc.window}}
+
+        async def capture(url: str) -> None:
+            try:
+                async with session.post(
+                        f"{url}/debug/diagnostics/capture", json=payload,
+                        timeout=aiohttp.ClientTimeout(total=30.0)) as resp:
+                    body = await resp.json()
+                    if resp.status == 200 and body.get("bundle"):
+                        inc.engine_bundles[url] = body["bundle"]
+                    else:
+                        inc.engine_bundles[url] = (
+                            f"error: HTTP {resp.status} "
+                            f"{body.get('reason', '')}".strip())
+            except Exception as e:
+                inc.engine_bundles[url] = f"error: {type(e).__name__}: {e}"
+
+        await asyncio.gather(*(capture(u) for u in inc.implicated))
+        logger.info("incident %s: engine capture fan-out done (%s)",
+                    inc.id, inc.engine_bundles)
+
+    # -- signal subscriptions ------------------------------------------------
+    def on_breaker_state(self, url: str, state: int) -> None:
+        """resilience.py state hook: 0 CLOSED / 1 HALF_OPEN / 2 OPEN."""
+        key = f"breaker:{url}"
+        if state == 2:
+            self.open_incident("breaker_open", key,
+                               window={"url": url}, implicated=[url])
+        elif state == 0:
+            self.close_incident(key, "breaker closed")
+
+    def on_stream_resume_failure(self, outcome: str, url: Optional[str],
+                                 model: Optional[str]) -> None:
+        """request_service.py: a mid-stream death could not be resumed
+        (outcome "failed" / "budget_exhausted") — the client saw it."""
+        key = f"stream_resume:{url or 'unknown'}"
+        inc = self.open_incident(
+            "stream_resume_failure", key,
+            window={"outcome": outcome, "url": url, "model": model},
+            implicated=[url] if url else [])
+        # no signal ever "clears" a lost stream: auto-close so the
+        # incident records the event without staying open forever
+        self.close_incident(key, "stream loss recorded")
+        return inc
+
+    def check_slo(self) -> None:
+        """Poll the SLO tracker's page flags and open/close incidents on
+        the transitions (the tracker itself is stateless per snapshot)."""
+        from production_stack_tpu.router.slo import current_slo_tracker
+
+        tracker = current_slo_tracker()
+        if tracker is None:
+            return
+        for series in tracker.snapshot().get("series", []):
+            skey = (series["model"], series["slo"])
+            paged = bool(series.get("page"))
+            was = self._page_state.get(skey, False)
+            self._page_state[skey] = paged
+            key = f"slo_page:{series['model']}:{series['slo']}"
+            if paged and not was:
+                self.open_incident(
+                    "burn_rate_page", key,
+                    window={"model": series["model"], "slo": series["slo"],
+                            "burn_rate": series.get("burn_rate", {})},
+                    implicated=_urls_for_model(series["model"]))
+            elif was and not paged:
+                self.close_incident(key, "burn rate back under page "
+                                         "threshold")
+
+    async def worker(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.interval)
+            try:
+                self.check_slo()
+            except Exception:
+                logger.exception("incident SLO poll failed")
+
+    # -- index ---------------------------------------------------------------
+    def snapshot(self) -> dict:
+        rows = sorted((i.row() for i in self._incidents.values()),
+                      key=lambda r: r["opened"], reverse=True)
+        return {"open": len(self._open_by_key), "incidents": rows}
+
+    def open_incidents_for(self, url: str) -> List[str]:
+        return [i.id for i in self._incidents.values()
+                if i.status == "open" and url in i.implicated]
+
+
+# -- router-bundle collectors (module accessors, never None-unsafe) ----------
+def _collect_slo():
+    from production_stack_tpu.router.slo import current_slo_tracker
+
+    tracker = current_slo_tracker()
+    return tracker.snapshot() if tracker is not None else {"enabled": False}
+
+
+def _collect_scale():
+    from production_stack_tpu.router.scale_advisor import (
+        current_scale_advisor,
+    )
+
+    advisor = current_scale_advisor()
+    return advisor.snapshot() if advisor is not None else {"enabled": False}
+
+
+def _collect_engine_stats():
+    import dataclasses
+
+    from production_stack_tpu.router.stats import get_engine_stats_scraper
+
+    try:
+        scraper = get_engine_stats_scraper()
+    except AssertionError:
+        return {}
+    return {url: dataclasses.asdict(stats)
+            for url, stats in scraper.get_engine_stats().items()}
+
+
+def _collect_endpoints():
+    from production_stack_tpu.router.service_discovery import (
+        get_service_discovery,
+    )
+
+    try:
+        discovery = get_service_discovery()
+    except AssertionError:
+        return []
+    reasons = getattr(discovery, "not_ready_reason", {})
+    return [{"url": ep.url, "models": ep.model_names,
+             "label": ep.model_label, "draining": ep.draining,
+             "sleep": ep.sleep, "not_ready_reason": reasons.get(ep.url)}
+            for ep in discovery.get_endpoint_info()]
+
+
+def _urls_for_model(model: str) -> List[str]:
+    from production_stack_tpu.router.service_discovery import (
+        get_service_discovery,
+    )
+
+    try:
+        discovery = get_service_discovery()
+    except AssertionError:
+        return []
+    return [ep.url for ep in discovery.get_endpoint_info()
+            if model in ep.model_names]
+
+
+_manager: Optional[IncidentManager] = None
+
+
+def initialize_incident_manager(
+        config: IncidentConfig,
+        session_provider: Optional[Callable[[], object]] = None,
+) -> IncidentManager:
+    global _manager
+    _manager = IncidentManager(config, session_provider=session_provider)
+    return _manager
+
+
+def current_incident_manager() -> Optional[IncidentManager]:
+    return _manager

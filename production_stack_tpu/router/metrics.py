@@ -128,6 +128,28 @@ replica_warmup_seconds = Histogram(
     "each scale-up",
     buckets=(1, 5, 15, 30, 60, 120, 300, 600, float("inf")),
 )
+# diagnostics & incidents (router/incidents.py): anomaly-triggered
+# evidence capture. The engine tier exports the same families from its
+# private registry (engine/metrics.py DiagnosticsCollector), so a
+# fleet-wide sum over {tier} is meaningful.
+diagnostic_bundles_total = Counter(
+    "vllm:diagnostic_bundles",
+    "Diagnostic bundles captured on an anomaly trigger "
+    "(GET /debug/diagnostics indexes them)",
+    ["trigger", "tier"],
+)
+diagnostic_capture_seconds = Histogram(
+    "vllm:diagnostic_capture_seconds",
+    "Wall time spent capturing diagnostic bundles (off the serving "
+    "path: capture runs on its own thread)",
+    ["tier"],
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, float("inf")),
+)
+incidents_open = Gauge(
+    "vllm:incidents_open",
+    "Router incidents currently open (burn-rate page, breaker open, "
+    "stream-resume failure) — each carries a correlated bundle set",
+)
 # router self-metrics (reference: routers/metrics_router.py:43-57)
 router_cpu_percent = Gauge("router:cpu_usage_perc", "Router CPU usage percent")
 router_mem_percent = Gauge("router:memory_usage_perc", "Router memory usage percent")

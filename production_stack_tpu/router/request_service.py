@@ -460,7 +460,8 @@ class RequestService:
             # terminate in-band like the engine's deadline path does
             outcome = "failed" if give_up == "deadline" else give_up
             return await self._fail_resumed_stream(resume, last_error,
-                                                   outcome)
+                                                   outcome, url=url,
+                                                   model=resolved)
         if give_up == "deadline":
             return web.json_response(
                 {"error": {"message": last_error}}, status=504)
@@ -470,12 +471,23 @@ class RequestService:
 
     async def _fail_resumed_stream(self, resume: "_ResumeState",
                                    last_error: Optional[str],
-                                   outcome: str) -> web.StreamResponse:
+                                   outcome: str,
+                                   url: Optional[str] = None,
+                                   model: Optional[str] = None,
+                                   ) -> web.StreamResponse:
         """Every replay avenue is gone (no surviving backend, deadline,
         or retry budget) with the client mid-stream: send an in-band
         error event and a clean [DONE] instead of a raw connection
         reset, and record the loss."""
         m.stream_resumes_total.labels(outcome=outcome).inc()
+        from production_stack_tpu.router.incidents import (
+            current_incident_manager,
+        )
+
+        im = current_incident_manager()
+        if im is not None:
+            # the client saw a lost stream: open (and record) an incident
+            im.on_stream_resume_failure(outcome, url, model)
         err = {"error": {"message": "stream interrupted and could not be "
                          f"resumed: {last_error}",
                          "type": "stream_resume_error"}}

@@ -21,6 +21,10 @@ Processes
                instantaneous rate swings between ``trough * rate`` and
                ``rate`` over ``period`` seconds (peak at mid-period).
                Sampled by Lewis-Shedler thinning against the peak rate.
+``trace``      replay of a recorded workload (``TraceReplay``): the
+               JSONL trace ``benchmarks/multi_round_qa.py --trace-out``
+               writes, looped past its horizon — a production traffic
+               shape drives the simulator verbatim.
 
 Everything is seeded and self-contained (``random.Random``; no numpy),
 so arrival sequences are reproducible across processes and platforms.
@@ -28,9 +32,11 @@ so arrival sequences are reproducible across processes and platforms.
 
 from __future__ import annotations
 
+import bisect
+import json
 import math
 import random
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
 
 ARRIVAL_KINDS = ("constant", "poisson", "bursty", "diurnal")
 
@@ -169,6 +175,103 @@ class ArrivalProcess:
         return _poisson_draw(lam, self._rng)
 
 
+class TraceReplay:
+    """Deterministic replay of a recorded arrival trace — the
+    duck-typed sibling of ``ArrivalProcess`` (``next_after`` /
+    ``iter_arrivals`` / ``sample_count`` / ``rate_at`` / ``peak_rate``),
+    so the bench's pacing loop and the simulator's tick loop consume a
+    recorded workload exactly like a synthetic one.
+
+    The trace is a sequence of non-negative arrival offsets (seconds
+    from measurement start). Past the last offset the trace loops with
+    period ``last offset + mean gap`` (the mean gap stands in for the
+    unrecorded gap between the last arrival and the next "day"), so a
+    10-minute capture can drive an hour-long drill. ``rate_scale``
+    compresses or amplifies the recorded rate without changing the
+    shape (offsets divide by it).
+    """
+
+    kind = "trace"
+
+    def __init__(self, offsets: List[float], *, loop: bool = True,
+                 rate_scale: float = 1.0):
+        if not offsets:
+            raise ValueError("trace has no arrivals")
+        if rate_scale <= 0:
+            raise ValueError("rate_scale must be > 0")
+        self.offsets = sorted(max(0.0, float(x)) / rate_scale
+                              for x in offsets)
+        self.loop = loop
+        span = self.offsets[-1]
+        mean_gap = span / max(len(self.offsets) - 1, 1) or 1.0
+        self.period = span + mean_gap
+        self.rate = len(self.offsets) / self.period
+        self.seed = 0  # determinism parity with ArrivalProcess
+
+    @classmethod
+    def from_jsonl(cls, path: str, *, loop: bool = True,
+                   rate_scale: float = 1.0,
+                   model: Optional[str] = None) -> "TraceReplay":
+        """Load a ``--trace-out`` JSONL file. Every recorded request is
+        an arrival regardless of outcome (the load hit the fleet either
+        way); ``model`` filters to one model's rows."""
+        offsets = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                if model is not None and row.get("model") != model:
+                    continue
+                offsets.append(float(row["offset"]))
+        return cls(offsets, loop=loop, rate_scale=rate_scale)
+
+    # -- rate function ------------------------------------------------------
+    def rate_at(self, t: float) -> float:
+        return self.rate
+
+    def peak_rate(self) -> float:
+        return self.rate
+
+    # -- per-arrival sampling ----------------------------------------------
+    def next_after(self, t: float) -> float:
+        """First arrival strictly after ``t`` (ArrivalProcess parity; an
+        arrival recorded at offset exactly ``t`` is considered fired)."""
+        t = max(t, 0.0)
+        cycle, within = divmod(t, self.period) if self.loop else (0, t)
+        i = bisect.bisect_right(self.offsets, within)
+        if i < len(self.offsets):
+            return cycle * self.period + self.offsets[i]
+        if not self.loop:
+            return math.inf
+        return (cycle + 1) * self.period + self.offsets[0]
+
+    def iter_arrivals(self, horizon: float,
+                      limit: Optional[int] = None) -> Iterator[float]:
+        t, n = 0.0, 0
+        while True:
+            t = self.next_after(t)
+            if t > horizon or (limit is not None and n >= limit):
+                return
+            n += 1
+            yield t
+
+    # -- tick-based sampling (the simulator) --------------------------------
+    def _count_before(self, t: float) -> int:
+        """Arrivals in [0, t) including loop wraps."""
+        if t <= 0:
+            return 0
+        if not self.loop:
+            return bisect.bisect_left(self.offsets, t)
+        cycles, within = divmod(t, self.period)
+        return int(cycles) * len(self.offsets) \
+            + bisect.bisect_left(self.offsets, within)
+
+    def sample_count(self, t: float, dt: float) -> int:
+        return self._count_before(t + dt) - self._count_before(t)
+
+
 def add_arrival_args(parser, default_rate_flag: str = "--qps") -> None:
     """The shared CLI surface: ``benchmarks/multi_round_qa.py`` and
     ``testing/traffic_sim.py`` register identical flags so one workload
@@ -192,9 +295,23 @@ def add_arrival_args(parser, default_rate_flag: str = "--qps") -> None:
                              "days make short drills)")
     parser.add_argument("--arrival-trough", type=float, default=0.2,
                         help="diurnal: trough rate as a fraction of peak")
+    parser.add_argument("--arrival-trace", default=None, metavar="FILE",
+                        help="replay a recorded JSONL request trace "
+                             "(benchmarks/multi_round_qa.py --trace-out) "
+                             "instead of a synthetic process; overrides "
+                             "--arrival-process, loops past its horizon")
+    parser.add_argument("--arrival-trace-scale", type=float, default=1.0,
+                        help="trace replay rate multiplier (2.0 = replay "
+                             "the recorded shape at twice the rate)")
 
 
-def process_from_args(args, rate: float) -> ArrivalProcess:
+def process_from_args(args, rate: float):
+    """The shared decision point: a recorded trace (``--arrival-trace``)
+    wins over the synthetic ``--arrival-process`` family."""
+    trace = getattr(args, "arrival_trace", None)
+    if trace:
+        return TraceReplay.from_jsonl(
+            trace, rate_scale=getattr(args, "arrival_trace_scale", 1.0))
     return ArrivalProcess(
         args.arrival_process, rate, seed=args.arrival_seed,
         burst_factor=args.arrival_burst_factor,

@@ -20,6 +20,10 @@ from typing import Optional
 
 from aiohttp import web
 
+from production_stack_tpu.engine.diagnostics import (
+    DiagnosticsConfig,
+    DiagnosticsManager,
+)
 from production_stack_tpu.testing.faults import (
     FaultSpec,
     FaultState,
@@ -77,6 +81,26 @@ class FakeEngine:
         # shape vllm:num_requests_waiting (the scale advisor's primary
         # signal) without generating real traffic
         self.waiting = 0
+        # fleet-view knobs (GET /debug/perf) — set by tests to shape the
+        # /debug/fleet rows without a real accelerator
+        self.mfu = 0.42
+        self.hbm_used = 12 * 1024 ** 3
+        self.hbm_total = 16 * 1024 ** 3
+        # a REAL engine-tier diagnostics archive (same DiagnosticsManager
+        # the real server embeds), so router incident fan-out e2e tests
+        # exercise the genuine capture/index/tar path end to end; each
+        # fake engine gets its own dir — the pid-based default would be
+        # shared by every instance in a multi-engine test process
+        import tempfile
+
+        self.diagnostics = DiagnosticsManager(
+            DiagnosticsConfig(
+                cooldown=0.0,
+                dir=tempfile.mkdtemp(prefix="fake-engine-diag-")),
+            tier="engine",
+            collectors={"perf.json": self._perf_snapshot,
+                        "state.json": self._state_snapshot},
+        )
 
     def build_app(self) -> web.Application:
         app = web.Application(
@@ -96,7 +120,53 @@ class FakeEngine:
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
+        app.router.add_get("/debug/perf", self.debug_perf)
+        app.router.add_get("/debug/diagnostics", self.debug_diagnostics)
+        app.router.add_post("/debug/diagnostics/capture",
+                            self.debug_diagnostics_capture)
         return app
+
+    # -- diagnostics / fleet surface (mirrors the real engine server) --------
+    def _perf_snapshot(self) -> dict:
+        return {
+            "model_flops_utilization": self.mfu,
+            "hbm_bytes": {"used": self.hbm_used, "total": self.hbm_total,
+                          "peak": self.hbm_used},
+            "tokens_per_second": {"decode": self.tps},
+            "compile": {"unexpected_recompiles": 0, "recent": []},
+        }
+
+    def _state_snapshot(self) -> dict:
+        return {"running": self.running, "waiting": self.waiting,
+                "draining": self.draining, "total": self.total_requests}
+
+    async def debug_perf(self, request):
+        return web.json_response(self._perf_snapshot())
+
+    async def debug_diagnostics(self, request):
+        return web.json_response(self.diagnostics.index())
+
+    async def debug_diagnostics_capture(self, request):
+        """Same contract as the real engine's capture endpoint: the
+        response returns only after the bundle is on disk, carrying its
+        id — what the router's incident fan-out correlates on."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        trigger = str(body.get("trigger") or "manual")
+        detail = dict(body.get("detail") or {})
+        if body.get("incident"):
+            detail["incident"] = body["incident"]
+        loop = asyncio.get_running_loop()
+        bundle_id = await loop.run_in_executor(
+            None, lambda: self.diagnostics.trigger(
+                trigger, detail, force=True, sync=True))
+        if bundle_id is None:
+            return web.json_response(
+                {"captured": False, "reason": "capture already in flight"},
+                status=409)
+        return web.json_response({"captured": True, "bundle": bundle_id})
 
     async def debug_faults(self, request):
         """Flip fault injection live — same contract as the real engine's

@@ -916,6 +916,11 @@ def test_perf_slo_dashboard():
         "vllm:slo_error_budget_remaining",
         "vllm:time_to_first_token_seconds_bucket",
         "vllm:inter_token_latency_seconds_bucket",
+        # diagnostics & incidents row
+        "vllm:diagnostic_bundles_total",
+        "vllm:diagnostic_bundles_dropped_total",
+        "vllm:incidents_open",
+        "vllm:diagnostic_capture_seconds_bucket",
     ):
         assert metric in text, f"perf-slo dashboard missing {metric}"
     assert dash["uid"] == "tpu-perf-slo"
@@ -924,3 +929,115 @@ def test_perf_slo_dashboard():
     with open(os.path.join(repo_root, "observability",
                            "perf-slo-dashboard.json")) as f:
         assert json.load(f) == dash
+
+
+def test_keda_advisor_trigger_renders_metrics_api():
+    """autoscaling.advisorTrigger.enabled adds a KEDA metrics-api trigger
+    following the router's fused /debug/scale recommendation (the KEDA
+    mode of docs/autoscaling.md); off by default."""
+    so = by_kind(render_objects(HELM, {"autoscaling": {"enabled": True}}),
+                 "ScaledObject")[0]
+    assert all(t["type"] == "prometheus" for t in so["spec"]["triggers"])
+
+    objs = render_objects(HELM, {
+        "autoscaling": {"enabled": True,
+                        "advisorTrigger": {"enabled": True,
+                                           "targetValue": "2"}},
+        "routerSpec": {"scaleAdvisor": {"enabled": True}},
+    })
+    so = by_kind(objs, "ScaledObject")[0]
+    (api,) = [t for t in so["spec"]["triggers"]
+              if t["type"] == "metrics-api"]
+    meta = api["metadata"]
+    assert meta["url"].endswith("/debug/scale")
+    assert "-router:" in meta["url"]
+    model = meta["valueLocation"].split(".")[1]
+    assert meta["valueLocation"] == f"models.{model}.desired_replicas"
+    assert meta["targetValue"] == "2"
+    # the prometheus queue-depth triggers still render alongside
+    assert any(t["type"] == "prometheus" for t in so["spec"]["triggers"])
+
+
+def test_diagnostics_values_render_flags():
+    """routerSpec.diagnostics.* and engineConfig.diagnostics* map onto
+    the --diagnostics-* surface on each tier; defaults keep the
+    subsystem on with no --no-diagnostics rendered."""
+    args = router_args(render_objects(HELM))
+    assert "--no-diagnostics" not in args
+    assert "--diagnostics-dir" not in args       # "" → per-process tmpdir
+    for flag, value in (("--diagnostics-max-bundles", "16"),
+                        ("--diagnostics-max-bytes", "67108864"),
+                        ("--diagnostics-cooldown", "60"),
+                        ("--diagnostics-interval", "5")):
+        assert args[args.index(flag) + 1] == value
+
+    objs = render_objects(HELM, {
+        "routerSpec": {"diagnostics": {
+            "enabled": False, "dir": "/var/diag", "maxBundles": 4,
+            "maxBytes": 1048576, "cooldown": 10, "interval": 2,
+        }},
+        "servingEngineSpec": {"modelSpec": [{
+            "name": "diag", "modelRef": "llama-3-8b",
+            "engineConfig": {
+                "maxModelLen": 2048, "maxNumSeqs": 8, "dtype": "bfloat16",
+                "tensorParallelSize": 1,
+                "diagnostics": False, "diagnosticsDir": "/data/diag",
+                "diagnosticsMaxBundles": 8,
+                "diagnosticsMaxBytes": 134217728,
+                "diagnosticsCooldown": 30,
+                "diagnosticsProfileSeconds": 0,
+                "diagnosticsHbmThreshold": 0.8,
+            },
+        }]},
+    })
+    args = router_args(objs)
+    assert "--no-diagnostics" in args
+    for flag, value in (("--diagnostics-dir", "/var/diag"),
+                        ("--diagnostics-max-bundles", "4"),
+                        ("--diagnostics-max-bytes", "1048576"),
+                        ("--diagnostics-cooldown", "10"),
+                        ("--diagnostics-interval", "2")):
+        assert args[args.index(flag) + 1] == value
+    eargs = container_args(engine_deployments(objs)[0])
+    assert "--no-diagnostics" in eargs
+    for flag, value in (("--diagnostics-dir", "/data/diag"),
+                        ("--diagnostics-max-bundles", "8"),
+                        ("--diagnostics-max-bytes", "134217728"),
+                        ("--diagnostics-cooldown", "30"),
+                        # 0 is meaningful (no trace), so it must render
+                        ("--diagnostics-profile-seconds", "0"),
+                        ("--diagnostics-hbm-threshold", "0.8")):
+        assert flag in eargs, f"engine missing {flag}"
+        assert eargs[eargs.index(flag) + 1] == value
+
+    # defaults: the subsystem stays on, the stock retention knobs render
+    # (like the perf* keys), the empty dir renders no --diagnostics-dir
+    eargs = container_args(engine_deployments(render_objects(HELM))[0])
+    assert "--no-diagnostics" not in eargs
+    assert "--diagnostics-dir" not in eargs
+    for flag, value in (("--diagnostics-max-bundles", "16"),
+                        ("--diagnostics-max-bytes", "268435456"),
+                        ("--diagnostics-cooldown", "60"),
+                        ("--diagnostics-profile-seconds", "2"),
+                        ("--diagnostics-hbm-threshold", "0.92")):
+        assert eargs[eargs.index(flag) + 1] == value
+
+
+def test_alert_rules_carry_runbooks():
+    """Every alert in the catalog pages a human at 3am — each must carry
+    a runbook_url annotation pointing into docs/ (same in both synced
+    copies, which test_alert_rules_configmap_renders keeps identical)."""
+    repo_root = os.path.dirname(HELM)
+    with open(os.path.join(repo_root, "observability",
+                           "alert-rules.yaml")) as f:
+        rules = yaml.safe_load(f)
+    alerts = [r for g in rules["groups"] for r in g["rules"]
+              if "alert" in r]
+    assert len(alerts) >= 7
+    for rule in alerts:
+        runbook = rule["annotations"].get("runbook_url")
+        assert runbook, f"{rule['alert']} has no runbook_url"
+        assert runbook.startswith("docs/"), rule["alert"]
+        anchor = os.path.join(repo_root, runbook.split("#")[0])
+        assert os.path.isfile(anchor), \
+            f"{rule['alert']} runbook {runbook} points at a missing doc"

@@ -168,3 +168,42 @@ def test_open_loop_time_mode_keeps_firing():
     assert summary["requests"] >= 8  # more than one closed cohort's worth
     assert summary["wall_s"] <= 3.0
     loop.call_soon_threadsafe(loop.stop)
+
+
+def test_trace_out_writes_replayable_jsonl(tmp_path):
+    """--trace-out records the run as a JSONL arrival trace that
+    testing/arrivals.TraceReplay (and therefore the traffic simulator's
+    --arrival-trace) can replay — the capture half of ROADMAP item 5's
+    capture→replay loop."""
+    import json
+
+    from production_stack_tpu.testing.arrivals import TraceReplay
+
+    fe = FakeEngine(model="fake-model", tokens_per_second=5000, ttft=0.001)
+    port, loop = start_fake_engine_thread(fe)
+
+    from benchmarks.multi_round_qa import main
+
+    trace = tmp_path / "trace.jsonl"
+    summary = main([
+        "--base-url", f"http://127.0.0.1:{port}",
+        "--model", "fake-model", "--num-users", "3", "--num-rounds", "2",
+        "--qps", "50", "--answer-len", "4",
+        "--trace-out", str(trace),
+    ])
+    rows = [json.loads(ln) for ln in trace.read_text().splitlines()]
+    assert len(rows) == summary["requests"] == 6
+    offsets = [r["offset"] for r in rows]
+    assert offsets == sorted(offsets)
+    assert all(o >= 0 for o in offsets)
+    for row in rows:
+        assert row["model"] == "fake-model"
+        assert row["outcome"] == "ok"
+        assert row["prompt_tokens"] > 0 and row["output_tokens"] > 0
+        assert row["round"] in (1, 2)
+    assert {r["user"] for r in rows} == {0, 1, 2}
+
+    proc = TraceReplay.from_jsonl(str(trace), model="fake-model")
+    assert proc.kind == "trace"
+    assert len(list(proc.iter_arrivals(horizon=proc.period))) == 6
+    loop.call_soon_threadsafe(loop.stop)

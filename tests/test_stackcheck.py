@@ -276,6 +276,49 @@ def test_autoscaler_surface_is_inside_the_gates():
     assert "autoscaling.mode" in scaled
 
 
+def test_diagnostics_surface_is_inside_the_gates():
+    """The diagnostics/incidents surface (PR: anomaly-triggered bundles
+    + fleet plane) is covered by the gates, not grandfathered:
+    config-drift sees both tiers' --diagnostics-* flags as declared CLI
+    flags (a routerSpec.diagnostics / engineConfig.diagnostics* template
+    typo would be an active finding), and metric-hygiene tracks the
+    diagnostic metric families as both defined in code and documented —
+    so renaming one, or deleting its docs row or dashboard panel, fails
+    test_repo_has_no_active_findings."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    shared = {"--no-diagnostics", "--diagnostics-dir",
+              "--diagnostics-max-bundles", "--diagnostics-max-bytes",
+              "--diagnostics-cooldown"}
+    engine_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "engine" / "server.py")
+    assert shared | {"--diagnostics-profile-seconds",
+                     "--diagnostics-hbm-threshold"} <= engine_flags
+    router_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "router" / "app.py")
+    assert shared | {"--diagnostics-interval"} <= router_flags
+
+    families = {"vllm:diagnostic_bundles",
+                "vllm:diagnostic_bundles_dropped",
+                "vllm:diagnostic_capture_seconds",
+                "vllm:incidents_open"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert families <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert families <= documented
+
+    # both the routerSpec.diagnostics block and the per-model
+    # engineConfig keys must stay consumed by the deployment templates
+    # (the values-consumed gate keys off their presence in values.yaml)
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert "diagnostics:" in values and "diagnosticsMaxBundles:" in values
+    assert "advisorTrigger:" in values
+    router_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-router.yaml").read_text()
+    assert "routerSpec.diagnostics" in router_tmpl
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

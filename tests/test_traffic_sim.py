@@ -131,3 +131,59 @@ def test_diurnal_ramps_between_trough_and_peak():
     low = min(proc.rate_at(t) for t in range(0, 1800, 30))
     assert peak == pytest.approx(10.0, rel=0.05)
     assert low == pytest.approx(2.0, rel=0.05)
+
+
+def test_trace_replay_process_is_deterministic(tmp_path):
+    """TraceReplay loops a recorded trace: event iteration and tick-based
+    sample_count see exactly the same arrivals (the property the virtual
+    -time loop depends on), and rate_scale compresses time."""
+    import json as _json
+
+    from production_stack_tpu.testing.arrivals import TraceReplay
+
+    trace = tmp_path / "trace.jsonl"
+    rows = [{"offset": o, "model": "sim-chat", "prompt_tokens": 64,
+             "output_tokens": 32, "outcome": "ok"}
+            for o in (0.5, 1.0, 1.5, 3.0)]
+    trace.write_text("".join(_json.dumps(r) + "\n" for r in rows))
+
+    proc = TraceReplay.from_jsonl(str(trace))
+    assert proc.kind == "trace"
+    # span 3.0 + mean gap 1.0 → one replay cycle every 4 virtual seconds
+    assert proc.period == pytest.approx(4.0)
+    events = list(proc.iter_arrivals(horizon=8.0))
+    assert events == pytest.approx([0.5, 1.0, 1.5, 3.0, 4.5, 5.0, 5.5, 7.0])
+    ticked = sum(proc.sample_count(t, 0.5) for t in
+                 [x * 0.5 for x in range(16)])
+    assert ticked == len(events)
+
+    fast = TraceReplay.from_jsonl(str(trace), rate_scale=2.0)
+    assert list(fast.iter_arrivals(horizon=4.0)) == \
+        pytest.approx([0.25, 0.5, 0.75, 1.5, 2.25, 2.5, 2.75, 3.5])
+
+    once = TraceReplay.from_jsonl(str(trace), loop=False)
+    assert list(once.iter_arrivals(horizon=100.0)) == \
+        pytest.approx([0.5, 1.0, 1.5, 3.0])
+
+
+def test_sim_replays_recorded_trace(tmp_path):
+    """--arrival-trace swaps the synthetic process for the recorded one:
+    the drill runs end-to-end on replayed arrivals (ROADMAP item 5's
+    capture→replay loop)."""
+    import json as _json
+
+    trace = tmp_path / "prod.jsonl"
+    rows = [{"offset": round(i * 0.8, 2), "model": "prod-model",
+             "prompt_tokens": 128, "output_tokens": 64,
+             "outcome": "ok" if i % 7 else "error"}
+            for i in range(64)]
+    trace.write_text("".join(_json.dumps(r) + "\n" for r in rows))
+
+    artifact = run_sim(["--users", "500", "--horizon", "900",
+                        "--arrival-trace", str(trace)])
+    m = artifact["models"]["sim-chat"]
+    assert m["arrival_kind"] == "trace"
+    # ~64 arrivals per ~51.8s cycle, replayed over the horizon
+    assert m["arrivals"] > 500
+    assert m["completed"] > 0
+    assert_clean(artifact)

@@ -9,7 +9,7 @@ Three checks, composing into the full chain
    source. A typo'd flag here is a CrashLoopBackOff at pod start.
 2. *values.yaml keys are consumed.* Every key under ``engineConfig``,
    ``routerSpec.resilience``, ``routerSpec.observability``,
-   ``routerSpec.slo`` and every
+   ``routerSpec.slo``, ``routerSpec.diagnostics`` and every
    scalar key of ``routerSpec``/``cacheserverSpec`` must be referenced by
    some template. An unconsumed key is dead config — the operator sets
    it, nothing changes, nobody notices.
@@ -147,7 +147,7 @@ def _check_values_consumed(ctx: Context) -> List[Finding]:
             check_key("engineConfig", key)
     router = data.get("routerSpec") or {}
     check_map("routerSpec", router)
-    for sub in ("resilience", "observability", "slo"):
+    for sub in ("resilience", "observability", "slo", "diagnostics"):
         for key in (router.get(sub) or {}):
             check_key(f"routerSpec.{sub}", key)
     check_map("cacheserverSpec", data.get("cacheserverSpec") or {})

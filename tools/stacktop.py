@@ -1,0 +1,153 @@
+"""stacktop — a terminal fleet view over the router's ``/debug/fleet``.
+
+One row per engine (status, MFU, HBM, KV free, queue depth, QPS, TTFT,
+open incidents) plus the router's SLO / scale / incident summary — the
+``top``-alike for a serving fleet.  Pure stdlib so it runs from any
+operator box with nothing installed::
+
+    python -m tools.stacktop --router http://localhost:8001
+    python -m tools.stacktop --router http://localhost:8001 --watch 5
+
+``render_table`` is a pure snapshot→string function so tests (and other
+tools) can feed it a recorded /debug/fleet document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+COLUMNS = (
+    ("ENGINE", 28), ("MODEL", 14), ("STATUS", 10), ("MFU", 6),
+    ("HBM", 12), ("KVFREE", 7), ("WAIT", 5), ("RUN", 5),
+    ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
+)
+
+
+def _fmt_pct(x) -> str:
+    return "-" if x is None else f"{x * 100:.1f}%"
+
+
+def _fmt_num(x, spec: str = ".2f") -> str:
+    if x is None:
+        return "-"
+    # engines scrape counts out of prometheus gauges, so "waiting: 0.0"
+    # is the wire format even for integral quantities
+    return format(int(x) if spec == "d" else x, spec)
+
+
+def _fmt_hbm(used, total) -> str:
+    if used is None or total is None or not total:
+        return "-"
+    gib = 1024 ** 3
+    return f"{used / gib:.1f}/{total / gib:.1f}G"
+
+
+def _clip(s: str, width: int) -> str:
+    s = str(s)
+    return s if len(s) <= width else s[: width - 1] + "…"
+
+
+def engine_row_cells(row: dict) -> list:
+    return [
+        row.get("url", "-"),
+        ",".join(row.get("models") or []) or "-",
+        row.get("status", "-"),
+        _fmt_pct(row.get("mfu")),
+        _fmt_hbm(row.get("hbm_used_bytes"), row.get("hbm_total_bytes")),
+        _fmt_pct(row.get("kv_free")),
+        _fmt_num(row.get("waiting"), "d"),
+        _fmt_num(row.get("running"), "d"),
+        _fmt_num(row.get("qps")),
+        _fmt_num(row.get("ttft"), ".3f"),
+        ",".join(row.get("incidents") or []) or "-",
+    ]
+
+
+def render_table(snapshot: dict) -> str:
+    """Pure /debug/fleet document → multi-line table string."""
+    lines = []
+    header = "  ".join(name.ljust(width) for name, width in COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for row in snapshot.get("engines", []):
+        cells = engine_row_cells(row)
+        lines.append("  ".join(
+            _clip(cell, width).ljust(width)
+            for cell, (_, width) in zip(cells, COLUMNS)))
+    if not snapshot.get("engines"):
+        lines.append("(no engines discovered)")
+
+    router = snapshot.get("router") or {}
+    incidents = router.get("incidents") or {}
+    open_count = incidents.get("open", 0)
+    lines.append("")
+    lines.append(f"incidents open: {open_count}")
+    for inc in incidents.get("incidents", []):
+        if inc.get("status") != "open":
+            continue
+        lines.append(
+            f"  {inc['id']}  {inc['trigger']}  key={inc['key']}  "
+            f"engines={','.join(inc.get('implicated') or []) or '-'}")
+    slo = router.get("slo") or {}
+    paging = [s for s in slo.get("series", []) if s.get("page")]
+    if paging:
+        lines.append("slo pages: " + ", ".join(
+            f"{s['model']}/{s['slo']}" for s in paging))
+    scale = router.get("scale") or {}
+    models = scale.get("models") or {}
+    if models:
+        lines.append("scale: " + ", ".join(
+            f"{name}→{rec.get('desired_replicas')}"
+            for name, rec in sorted(models.items())))
+    return "\n".join(lines)
+
+
+def fetch_fleet(router: str, timeout: float = 10.0) -> dict:
+    url = router.rstrip("/") + "/debug/fleet"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "stacktop", description="terminal fleet view over /debug/fleet")
+    p.add_argument("--router", default="http://localhost:8001",
+                   help="router base URL")
+    p.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                   help="refresh every N seconds (0 = one shot)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /debug/fleet document instead")
+    args = p.parse_args(argv)
+
+    while True:
+        try:
+            snap = fetch_fleet(args.router)
+        except Exception as e:
+            print(f"stacktop: cannot reach {args.router}: {e}",
+                  file=sys.stderr)
+            if not args.watch:
+                return 1
+            time.sleep(args.watch)
+            continue
+        if args.json:
+            out = json.dumps(snap, indent=2, default=str)
+        else:
+            stamp = time.strftime("%H:%M:%S", time.localtime(
+                snap.get("ts", time.time())))
+            out = f"stacktop @ {stamp}  ({args.router})\n" + \
+                render_table(snap)
+        if args.watch:
+            # clear + home, like watch(1), so the table repaints in place
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(out)
+        if not args.watch:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
