@@ -473,14 +473,16 @@ class SchedulerConfig:
     # before enabling (docs/roofline.md).
     chain_decode: bool = False
     # n-gram (prompt-lookup) speculative decoding: propose up to this many
-    # draft tokens per step from the sequence's own token history and verify
-    # them in ONE forward over the paged cache (vLLM's ngram
-    # --speculative-config equivalent). 0 = off. Greedy requests only —
-    # sequences with temperature > 0, penalties or token controls fall back
-    # to the plain decode path for that step. Decode is weight-bandwidth
-    # bound at moderate batch, so accepting n drafts multiplies tokens per
-    # weight read by (n+1); the verify forward's extra FLOPs ride the MXU
-    # headroom (docs/roofline.md).
+    # draft tokens per step from the sequence's own token history and
+    # verify them inside the ragged unified dispatch (vLLM's ngram
+    # --speculative-config equivalent). 0 = off; requires
+    # attention_impl=ragged. Eligibility is per sequence — greedy rows
+    # speculate while sampled/penalised/controlled rows in the SAME batch
+    # decode normally — and a per-sequence acceptance EWMA adapts the
+    # width downward on cold sequences (spec.SpecController). Decode is
+    # weight-bandwidth bound at moderate batch, so accepting n drafts
+    # multiplies tokens per weight read by (n+1); the verify span's extra
+    # FLOPs ride the MXU headroom (docs/roofline.md).
     spec_ngram_k: int = 0
     # longest/shortest n-gram to match against the history (longest first)
     spec_ngram_max: int = 3
@@ -491,13 +493,10 @@ class SchedulerConfig:
     @property
     def decode_horizon(self) -> int:
         """Tokens of block capacity a decode dispatch may consume past
-        ``num_computed_tokens`` (multi-step iterations, or the spec-decode
-        verify span)."""
-        if self.spec_ngram_k > 0:
-            # non-spec-eligible batches (sampled/penalised/controlled
-            # requests) fall back to the multi-step path, which needs its
-            # own capacity
-            return max(self.multi_step, 1, self.spec_ngram_k + 1)
+        ``num_computed_tokens`` (multi-step iterations). Speculative
+        spans reserve their own capacity per granted draft width in
+        ``Scheduler._grant_spec_drafts`` — they are NOT part of this
+        blanket horizon."""
         return max(self.multi_step, 1)
 
     def bucket_for(self, n: int, max_model_len: Optional[int] = None) -> int:

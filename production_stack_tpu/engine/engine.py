@@ -171,35 +171,38 @@ class LLMEngine:
         # the blocks are released executes later in device program order —
         # so deferred stops can't corrupt reused or cached blocks.
         self._pending_decode = None
-        # n-gram speculative decoding (engine/spec.py): verify-chunk width,
-        # padded to a sublane multiple for the Pallas prefill kernel. The
-        # staged PP runner relays activations host-side per stage and has
-        # no verify program — spec stays off there.
+        # n-gram speculative decoding (engine/spec.py): drafts ride the
+        # ragged stream as short prefill-shaped spans and verification is
+        # fused into the one ragged program (no standalone verify) — so
+        # speculation requires the ragged attention impl. Eligibility is
+        # per sequence and the draft width adapts via acceptance EWMA.
         k = config.scheduler.spec_ngram_k
-        if k > 0 and not hasattr(self.runner, "verify"):
-            # zeroing the config also resets decode_horizon, so the block
-            # capacity for the verify span isn't paid for nothing
+        if k > 0 and self.attention_impl != "ragged":
             import logging
 
             logging.getLogger(__name__).warning(
-                "speculative decoding disabled: the staged pipeline runner "
-                "has no verify program (spec_ngram_k=%d ignored)", k
+                "speculative decoding disabled: verification is fused into "
+                "the ragged unified dispatch and attention_impl=%s has none "
+                "(spec_ngram_k=%d ignored)", self.attention_impl, k
             )
             config.scheduler.spec_ngram_k = k = 0
-        self._spec_S = -(-(k + 1) // 8) * 8 if k > 0 else 0
-        if self._spec_S:
-            S = self._spec_S
-            self._sp_tokens = np.zeros((B, S), np.int32)
-            self._sp_positions = np.full((B, S), -1, np.int32)
-            self._sp_slots = np.full((B, S), -1, np.int32)
-            self._sp_tables = np.zeros((B, M), np.int32)
-            self._sp_ctx = np.zeros(B, np.int32)
-            self._sp_adapters = np.zeros(B, np.int32)
+        self._spec = None
+        if k > 0:
+            from production_stack_tpu.engine.spec import SpecController
+
+            self._spec = SpecController(k_max=k)
+            self.scheduler.spec_grant_fn = self._spec_grant_fn
+            # stream indices of each slot's draft positions, rides EVERY
+            # ragged dispatch so verify-bearing steps share the one
+            # steady-state compile signature with plain ones
+            self._r_verify_idx = np.zeros((B, k), np.int32)
         # metrics
         self.total_prompt_tokens = 0
         self.total_output_tokens = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        self.spec_steps = 0  # spec row-steps (one per verified span)
+        self.spec_step_tokens = 0  # tokens those row-steps emitted
         self.aborted_seqs = 0  # cancelled/expired, KV freed early
         # unified ragged dispatch accounting (attention_impl == "ragged"):
         # live packed tokens vs the always-budget-wide stream is the
@@ -386,114 +389,70 @@ class LLMEngine:
         decodes = [s for s in out.decodes
                    if s.status is SequenceStatus.RUNNING]
         if decodes:
-            if self._spec_S and self._spec_eligible(decodes):
-                outputs.extend(self._run_decode_spec(decodes))
+            if self._spec is not None and self._propose_spec_drafts(decodes):
+                # drafts ride the packed stream as prefill-shaped spans;
+                # verification is fused in the same ragged dispatch
+                outputs.extend(self._run_ragged(out, proposed=True))
             else:
                 outputs.extend(self._run_decode(decodes))
         else:
             outputs.extend(self._resolve_pending_decode())
         return outputs
 
+    # -- speculative decoding (engine/spec.py) -------------------------------
     @staticmethod
-    def _spec_eligible(decodes: list[Sequence]) -> bool:
-        """Speculation verifies against the greedy argmax, so the whole
-        batch must be greedy with plain logits — temperature, penalties or
-        token controls anywhere fall the step back to normal decode."""
-        return all(
-            s.sampling.temperature <= 0.0
-            and not s.sampling.presence_penalty
-            and not s.sampling.frequency_penalty
-            and s.token_ctrl is None
-            and s.sampling.logprobs is None  # verify emits argmax only
-            and s.grammar_slot < 0  # verify has no FSM mask
-            for s in decodes
+    def _spec_seq_eligible(seq: Sequence) -> bool:
+        """Per-sequence: speculation verifies against the raw-logits
+        argmax, so only greedy rows with plain logits are eligible —
+        sampled/penalised/controlled/grammar/logprobs rows decode
+        normally in the SAME dispatch."""
+        return (
+            seq.sampling.temperature <= 0.0
+            and not seq.sampling.presence_penalty
+            and not seq.sampling.frequency_penalty
+            and seq.token_ctrl is None
+            and seq.sampling.logprobs is None  # verify emits argmax only
+            and seq.grammar_slot < 0  # verify has no FSM mask
         )
 
-    def _run_decode_spec(self, decodes: list[Sequence]) -> list[RequestOutput]:
-        """One speculative step: propose drafts from each sequence's own
-        history (n-gram prompt lookup), verify all of them in ONE forward
-        over the paged cache, accept the longest model-confirmed prefix.
-        Every emitted token is the model's own argmax — greedy output is
-        unchanged by speculation; steps without matches degenerate to a
-        plain one-token decode inside the same program."""
-        from production_stack_tpu.engine.spec import accept_drafts, propose_ngram
+    def _spec_grant_fn(self, seq: Sequence) -> int:
+        """Scheduler hook: draft width to charge against the stream budget
+        for this decode row (0 = ineligible or EWMA-cold)."""
+        if not self._spec_seq_eligible(seq):
+            return 0
+        bound = min(
+            seq.num_prompt_tokens + seq.sampling.max_tokens,
+            self.config.model.max_model_len,
+        )
+        # drafting past the completion bound can never emit tokens
+        return min(self._spec.grant(seq),
+                   max(bound - 1 - seq.num_computed_tokens, 0))
 
-        outputs = self._resolve_pending_decode()
-        decodes = [s for s in decodes if s.status is SequenceStatus.RUNNING]
-        if not decodes:
-            return outputs
+    def _propose_spec_drafts(self, decodes: list[Sequence]) -> bool:
+        """Consume each row's scheduler grant into actual drafts (n-gram
+        prompt lookup over the NOW-complete token history — pendings must
+        be resolved first). Returns True if any row has drafts; a granted
+        row with no match decays its EWMA (the reserved budget was
+        wasted) so cold sequences stop being charged."""
+        from production_stack_tpu.engine.spec import propose_ngram
+
         sched = self.config.scheduler
-        bs = self.config.cache.block_size
-        row_drafts: list[tuple[Sequence, list[int]]] = []
         any_drafts = False
         for seq in decodes:
-            pos = seq.num_computed_tokens
-            # drafts may not run past the allocated blocks or the model's
-            # length cap (their K/V land in real slots)
-            max_d = min(
-                sched.spec_ngram_k,
-                self.config.model.max_model_len - 1 - pos,
-                len(seq.block_ids) * bs - pos - 1,
+            k, seq.spec_grant = seq.spec_grant, 0  # consumed
+            seq.spec_drafts = []
+            if k <= 0:
+                continue
+            drafts = propose_ngram(
+                seq.token_ids, k, sched.spec_ngram_max,
+                sched.spec_ngram_min, sched.spec_window,
             )
-            drafts = (
-                propose_ngram(
-                    seq.token_ids, max_d, sched.spec_ngram_max,
-                    sched.spec_ngram_min, sched.spec_window,
-                )
-                if max_d > 0 else []
-            )
-            any_drafts = any_drafts or bool(drafts)
-            row_drafts.append((seq, drafts))
-        if not any_drafts:
-            # nothing to verify: the plain (multi-step) decode program is
-            # strictly cheaper than an S-wide verify carrying one token
-            outputs.extend(self._run_decode(decodes))
-            return outputs
-        # persistent host buffers (rewritten in place each step); stale
-        # token/table data in inactive rows is masked by ctx 0 / pos -1
-        self._sp_ctx[:] = 0
-        self._sp_positions[:] = -1
-        self._sp_slots[:] = -1
-        for seq, drafts in row_drafts:
-            i = seq.slot
-            pos = seq.num_computed_tokens
-            n = 1 + len(drafts)
-            self._sp_tokens[i, :n] = [seq.token_ids[pos]] + drafts
-            self._sp_positions[i, :n] = np.arange(pos, pos + n)
-            self._sp_slots[i, :n] = slot_mapping_for(seq.block_ids, pos, n, bs)
-            self._sp_tables[i, : len(seq.block_ids)] = seq.block_ids
-            self._sp_ctx[i] = pos + n
-            self._sp_adapters[i] = seq.adapter_slot
-        use_lora = any(s.adapter_slot for s in decodes)
-        verified = self.runner.verify(
-            self._sp_tokens, self._sp_positions, self._sp_tables,
-            self._sp_ctx, self._sp_slots.reshape(-1),
-            adapter_ids=self._sp_adapters if use_lora else None,
-        )
-        if self.perf is not None:
-            self.perf.record_decode(len(decodes), 1,
-                                    int(self._sp_ctx.sum()))
-        live, token_lists = [], []
-        for seq, drafts in row_drafts:
-            if seq.status.is_finished:
-                continue  # aborted while the dispatch was in flight
-            new_tokens, n_acc = accept_drafts(drafts, verified[seq.slot])
-            self.spec_drafted += len(drafts)
-            self.spec_accepted += n_acc
-            new_toks = []
-            for t in new_tokens:
-                seq.num_computed_tokens += 1
-                seq.output_token_ids.append(t)
-                new_toks.append(t)
-                self.total_output_tokens += 1
-                if seq.first_token_time is None:
-                    seq.first_token_time = time.monotonic()
-                if self._check_stop(seq, t) is not None:
-                    break
-            live.append(seq)
-            token_lists.append(new_toks)
-        outputs.extend(self._postprocess(live, token_lists))
-        return outputs
+            if drafts:
+                seq.spec_drafts = drafts
+                any_drafts = True
+            else:
+                self._spec.update(seq, k, 0)
+        return any_drafts
 
     def _resolve_pending_prefill(self) -> list[RequestOutput]:
         """Fetch + postprocess the previous prefill dispatch (if any)."""
@@ -759,13 +718,14 @@ class LLMEngine:
         return self._postprocess(finished_prompts, first_tokens, lp_lists)
 
     # -- unified ragged step (attention_impl == "ragged") --------------------
-    def _run_ragged(self, out) -> list[RequestOutput]:
+    def _run_ragged(self, out, proposed: bool = False) -> list[RequestOutput]:
         """ONE dispatch for a mixed step: every decode row contributes one
-        token and FCFS prefill chunks fill the rest of the token budget,
-        packed in slot order into a single (1, T) stream (T is always
-        max_num_batched_tokens — one steady-state compile signature).
-        Decode-only steps still take _run_decode (multi-step fusion,
-        chaining, speculation)."""
+        token (or a 1 + drafts speculative span), FCFS prefill chunks fill
+        the rest of the token budget, packed in slot order into a single
+        (1, T) stream (T is always max_num_batched_tokens — one
+        steady-state compile signature, verify included). Draft-free
+        decode-only steps still take _run_decode (multi-step fusion,
+        chaining)."""
         bs = self.config.cache.block_size
         outputs = self._resolve_pending_ragged()
         outputs.extend(self._resolve_pending_decode())
@@ -776,6 +736,10 @@ class LLMEngine:
                     if not sp.seq.status.is_finished]
         if not decodes and not prefills:
             return outputs
+        if self._spec is not None and not proposed:
+            # pendings are resolved: token histories are complete, so the
+            # scheduler's budget grants can become concrete drafts now
+            self._propose_spec_drafts(decodes)
         B = self.config.scheduler.max_num_seqs
         T = self.config.scheduler.max_num_batched_tokens
         rows: dict[int, tuple] = {s.slot: ("d", s) for s in decodes}
@@ -796,10 +760,16 @@ class LLMEngine:
         self._ctrl_ids[:] = -1
         self._ctrl_vals[:] = 0.0
         self._ctrl_mode[:] = 0
+        if self._spec is not None:
+            # index 0 always points at a live stream token, so the fused
+            # verify computes harmless argmaxes for draft-free rows
+            self._r_verify_idx[:] = 0
 
         cu = 0
         seqs_in_step: list[Sequence] = []
+        spec_rows: list[tuple[int, Sequence, list[int]]] = []
         p_tokens = p_ctx = p_rows = d_ctx = 0
+        sp_tokens = sp_ctx = 0
         for slot in range(B):
             ent = rows.get(slot)
             if ent is None:
@@ -809,13 +779,15 @@ class LLMEngine:
             if kind == "d":
                 seq = obj
                 pos = seq.num_computed_tokens  # index of the incoming token
-                self._r_tokens[0, cu] = seq.token_ids[pos]
-                self._r_positions[0, cu] = pos
-                self._r_slot_mapping[cu] = (
-                    seq.block_ids[pos // bs] * bs + pos % bs
+                drafts = seq.spec_drafts if self._spec is not None else []
+                n = 1 + len(drafts)
+                self._r_tokens[0, cu : cu + n] = [seq.token_ids[pos]] + drafts
+                self._r_positions[0, cu : cu + n] = np.arange(pos, pos + n)
+                self._r_slot_mapping[cu : cu + n] = slot_mapping_for(
+                    seq.block_ids, pos, n, bs
                 )
-                self._r_adapter_ids[cu] = seq.adapter_slot
-                self._context_lens[slot] = pos + 1
+                self._r_adapter_ids[cu : cu + n] = seq.adapter_slot
+                self._context_lens[slot] = pos + n
                 self._steps[slot] = pos - seq.num_prompt_tokens + 1
                 self._r_sample_mask[slot] = 1.0
                 s = seq.sampling
@@ -823,7 +795,18 @@ class LLMEngine:
                 self._frequency[slot] = s.frequency_penalty
                 self._g_ids[slot] = seq.grammar_slot
                 self._g_states[slot] = max(seq.fsm_state, 0)
-                cu += 1
+                if drafts:
+                    # the span's j-th token predicts position pos+j+1: the
+                    # verify columns cover the drafts, the span's LAST
+                    # token is last_idx — the normal sampling path provides
+                    # the bonus token
+                    self._r_verify_idx[slot, : len(drafts)] = np.arange(
+                        cu, cu + len(drafts)
+                    )
+                    spec_rows.append((slot, seq, list(drafts)))
+                    sp_tokens += len(drafts)
+                    sp_ctx += pos + n
+                cu += n
                 d_ctx += pos + 1
             else:
                 sp = obj
@@ -899,20 +882,32 @@ class LLMEngine:
                   if use_controls else None),
             g_ids=self._g_ids if use_grammar else None,
             g_states=self._g_states if use_grammar else None,
+            verify_idx=(self._r_verify_idx
+                        if self._spec is not None else None),
             fetch=False,
         )
         if self.perf is not None:
+            # draft/verify spans are prefill-shaped work with zero goodput;
+            # accepted tokens land as decode goodput at resolve time
             self.perf.record_ragged(p_tokens, p_ctx, p_rows,
-                                    len(decodes), d_ctx)
+                                    len(decodes), d_ctx,
+                                    spec_tokens=sp_tokens, spec_ctx=sp_ctx,
+                                    spec_rows=len(spec_rows))
         self.ragged_dispatches += 1
         self.ragged_live_tokens += cu
 
         # scheduler-visible state advances NOW; results land next step
-        # (same deferral contract as _run_prefill / chained decode)
+        # (same deferral contract as _run_prefill / chained decode). A spec
+        # row advances only its guaranteed token here — position pos holds
+        # the last ACCEPTED token's KV regardless of draft outcome; the
+        # accepted-draft advance happens at resolve, which for spec steps
+        # is synchronous below.
+        spec_slots = {slot for slot, _, _ in spec_rows}
         decode_rows = []
         for seq in decodes:
             seq.num_computed_tokens += 1
-            decode_rows.append((seq.slot, seq))
+            if seq.slot not in spec_slots:
+                decode_rows.append((seq.slot, seq))
         prefill_rows = []
         for sp in prefills:
             seq = sp.seq
@@ -932,8 +927,15 @@ class LLMEngine:
         self._pending_ragged = {
             "prefill_rows": prefill_rows,
             "decode_rows": decode_rows,
+            "spec_rows": spec_rows,
             "result": result_dev,
         }
+        if spec_rows:
+            # acceptance decides how far each spec row really advanced —
+            # the scheduler must see that before its next decision, so
+            # verify-bearing dispatches resolve synchronously (the draft
+            # speedup dwarfs the lost one-step overlap)
+            outputs.extend(self._resolve_pending_ragged())
         return outputs
 
     def _resolve_pending_ragged(self) -> list[RequestOutput]:
@@ -949,10 +951,52 @@ class LLMEngine:
     def _finish_ragged(self, pending, fetched) -> list[RequestOutput]:
         """Append one sampled token per resolved row: first tokens for the
         prompts that completed in that dispatch, next tokens for its decode
-        rows (num_computed already advanced at dispatch)."""
+        rows (num_computed already advanced at dispatch) — and for spec
+        rows, the longest model-confirmed draft prefix plus the bonus
+        token, with rejected-draft KV rolled back exactly by NOT advancing
+        num_computed past the accepted prefix (Scheduler.finish commits
+        only positions below it; the garbage slots are rewritten when the
+        real tokens for those positions are dispatched)."""
         sampled = fetched[0]
-        lp = fetched[1:] if len(fetched) > 1 else None
+        if self._spec is not None:
+            verify, lp = fetched[1], fetched[2:] or None
+        else:
+            verify, lp = None, (fetched[1:] if len(fetched) > 1 else None)
         live, token_lists, lp_lists = [], [], []
+        for slot, seq, drafts in pending.get("spec_rows", ()):
+            if seq.status.is_finished:
+                continue  # aborted while the dispatch was in flight
+            d = len(drafts)
+            verified = [int(verify[slot, j]) for j in range(d)]
+            verified.append(int(sampled[slot]))  # span's last_idx = bonus
+            from production_stack_tpu.engine.spec import accept_drafts
+
+            new_tokens, n_acc = accept_drafts(drafts, np.asarray(verified))
+            self._spec.update(seq, d, n_acc)
+            self.spec_drafted += d
+            self.spec_accepted += n_acc
+            self.spec_steps += 1
+            new_toks = []
+            for j, t in enumerate(new_tokens):
+                if j:
+                    # position pos+j's KV (input: accepted draft j-1) just
+                    # became valid; the dispatch advanced position pos only
+                    seq.num_computed_tokens += 1
+                seq.output_token_ids.append(t)
+                new_toks.append(t)
+                self.total_output_tokens += 1
+                if seq.first_token_time is None:
+                    seq.first_token_time = time.monotonic()
+                if self._check_stop(seq, t) is not None:
+                    break
+            self.spec_step_tokens += len(new_toks)
+            if self.perf is not None and len(new_toks) > 1:
+                # the guaranteed token was already counted as decode
+                # goodput at dispatch; accepted drafts land here
+                self.perf.record_spec_accepted(len(new_toks) - 1)
+            live.append(seq)
+            token_lists.append(new_toks)
+            lp_lists.append(None)  # spec rows never request logprobs
         for slot, seq in pending["prefill_rows"]:
             if seq.status.is_finished:
                 continue  # aborted while the dispatch was in flight
@@ -1280,6 +1324,17 @@ class LLMEngine:
             "cpu_prefix_cache_queries_total": 0,
             "spec_decode_num_draft_tokens_total": self.spec_drafted,
             "spec_decode_num_accepted_tokens_total": self.spec_accepted,
+            # cumulative acceptance ratio + mean tokens emitted per
+            # verified span (1 guaranteed + accepted drafts); both 0 until
+            # the first verify so dashboards read "off" as flatline
+            "spec_decode_acceptance_rate": (
+                self.spec_accepted / self.spec_drafted
+                if self.spec_drafted else 0.0
+            ),
+            "spec_decode_tokens_per_step": (
+                self.spec_step_tokens / self.spec_steps
+                if self.spec_steps else 0.0
+            ),
             "aborted_seqs_total": self.aborted_seqs,
             # per-step occupancy / KV-pool utilization (observability layer)
             "batch_occupancy": (self.scheduler.num_running
@@ -1470,21 +1525,20 @@ class LLMEngine:
                              for _ in range(p)]
                     run(batch, 0.0)
                     run(batch, 0.7)
-        # speculative verify program: compile the one static (B, S) shape
-        # directly with an all-inactive batch (ctx 0, slots -1 — no KV is
-        # touched); whether live traffic's drafts ever match is dynamic, so
-        # generation-driven warmup can't be relied on to reach this program
-        if self._spec_S:
-            B = self.config.scheduler.max_num_seqs
-            S = self._spec_S
-            M = self.runner.max_blocks_per_seq
-            self.runner.verify(
-                np.zeros((B, S), np.int32),
-                np.full((B, S), -1, np.int32),
-                np.zeros((B, M), np.int32),
-                np.zeros(B, np.int32),
-                np.full(B * S, -1, np.int32),
-            )
+        # speculative decoding needs no dedicated warmup program: verify is
+        # fused into the ragged step and verify_idx rides EVERY dispatch,
+        # so the runs above already compiled the verify-bearing signature.
+        # Still run one repetitive greedy prompt so a draft-carrying span
+        # (propose → pack → verify → accept) executes end-to-end before
+        # live traffic does.
+        if self._spec is not None:
+            motif = rng.integers(1, vocab, 8).tolist()
+            sp = SamplingParams(temperature=0.0, max_tokens=8,
+                                ignore_eos=True)
+            self.add_request(f"warmup-spec-{time.monotonic_ns()}",
+                             prompt_token_ids=motif * 4, sampling=sp)
+            while self.has_unfinished():
+                self.step()
         # logprob decode variants (static want_logprobs flag), greedy and
         # sampled; the prefill program carries logprobs unconditionally so
         # no per-bucket variant exists. Combinations with penalties/

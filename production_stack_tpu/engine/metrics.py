@@ -98,6 +98,17 @@ class EngineStatsCollector:
             "Speculative draft tokens accepted",
             s.get("spec_decode_num_accepted_tokens_total", 0),
         )
+        yield gauge(
+            "vllm:spec_decode_acceptance_rate",
+            "Draft acceptance rate (accepted / proposed, cumulative)",
+            s.get("spec_decode_acceptance_rate", 0.0),
+        )
+        yield gauge(
+            "vllm:spec_decode_tokens_per_step",
+            "Mean tokens emitted per verified speculative span "
+            "(1 guaranteed + accepted drafts)",
+            s.get("spec_decode_tokens_per_step", 0.0),
+        )
         yield counter(
             "vllm:aborted_seqs",
             "Sequences aborted (client disconnect / deadline expiry); "

@@ -170,21 +170,24 @@ class ModelRunner:
             **self._mh_gate,
         )
         if self.attention_impl == "ragged":
+            # speculative verify is FUSED into the ragged program: the
+            # draft width is baked in as a compile-time constant, so the
+            # one steady-state signature covers plain decode, mixed
+            # prefill+decode and verify-bearing steps alike (no separate
+            # _verify program, no lazy verify compile after warmup)
+            self.spec_width = max(config.scheduler.spec_ngram_k, 0)
             self._ragged = jax.jit(
                 functools.partial(_ragged_step, self.cfg,
-                                  self._attend_ragged, self._eos_id),
+                                  self._attend_ragged, self._eos_id,
+                                  self.spec_width),
                 donate_argnums=(1,),
                 static_argnames=("greedy_only", "use_penalties",
                                  "use_controls", "use_grammar"),
                 **self._mh_gate,
             )
+        else:
+            self.spec_width = 0
         self._sample = jax.jit(sample_tokens)
-        if config.scheduler.spec_ngram_k > 0:
-            self._verify = jax.jit(
-                functools.partial(_verify_step, self.cfg, self._attend_prefill),
-                donate_argnums=(1,),
-                **self._mh_gate,
-            )
         from production_stack_tpu.parallel.mesh import AXIS_SEQ
 
         self.seq_parallel = mesh.shape[AXIS_SEQ] > 1
@@ -546,28 +549,6 @@ class ModelRunner:
             )
         return tuple(np.asarray(x) for x in jax.device_get(result))
 
-    def verify(self, tokens: np.ndarray, positions: np.ndarray,
-               block_tables: np.ndarray, context_lens: np.ndarray,
-               slot_mapping: np.ndarray,
-               adapter_ids: Optional[np.ndarray] = None) -> np.ndarray:
-        """Speculative-decode verification: one forward over short
-        prefill-shaped chunks (tokens (B, S): last accepted token + drafts,
-        -1-padded positions/slots past each row's live span), returning the
-        greedy argmax at EVERY position (B, S). The host accepts the longest
-        draft prefix the model reproduces (engine/spec.py)."""
-        use_lora = adapter_ids is not None and self.lora_bank is not None
-        with set_mesh(self.mesh):
-            self.kv, out = self._verify(
-                self.params, self.kv,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(block_tables), jnp.asarray(context_lens),
-                jnp.asarray(slot_mapping),
-                lora_bank=self.lora_bank if use_lora else None,
-                adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
-                             if use_lora else None),
-            )
-        return np.asarray(jax.device_get(out))
-
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                block_tables: np.ndarray, context_lens: np.ndarray,
                slot_mapping: np.ndarray):
@@ -707,6 +688,7 @@ class ModelRunner:
                     presence=None, frequency=None,
                     adapter_ids=None, ctrl=None,
                     g_ids=None, g_states=None,
+                    verify_idx=None,
                     fetch: bool = True):
         """ONE unified dispatch over the packed mixed prefill+decode stream.
 
@@ -720,13 +702,25 @@ class ModelRunner:
         adapter_ids is PER-TOKEN (T,) — spans of different slots can carry
         different adapters in the same stream.
 
-        Returns (sampled (S,), tok_lp (S,), top_ids (S, N), top_lps (S, N))
-        on host — or the un-fetched device tuple with ``fetch=False`` so
-        the dispatch overlaps the host's next-step work. T and S never
-        change between dispatches: ONE steady-state compile signature per
-        static-flag variant (CompileTracker treats any post-warmup fresh
-        signature here as a bug signal)."""
+        With speculation compiled in (``spec_width > 0``) ``verify_idx``
+        (S, spec_width) carries the stream indices of each slot's draft
+        positions (clamped/zero for rows with fewer or no drafts) and the
+        result tuple gains the greedy argmax at those positions,
+        (S, spec_width), right after ``sampled``. verify_idx rides EVERY
+        dispatch so verify-bearing steps share the one steady-state
+        signature with plain ones.
+
+        Returns (sampled (S,)[, verify (S, W)], tok_lp (S,),
+        top_ids (S, N), top_lps (S, N)) on host — or the un-fetched
+        device tuple with ``fetch=False`` so the dispatch overlaps the
+        host's next-step work. T and S never change between dispatches:
+        ONE steady-state compile signature per static-flag variant
+        (CompileTracker treats any post-warmup fresh signature here as a
+        bug signal)."""
         use_penalties = presence is not None
+        if self.spec_width > 0 and verify_idx is None:
+            verify_idx = np.zeros(
+                (context_lens.shape[0], self.spec_width), np.int32)
         if not fetch:
             # the engine rewrites these host buffers in place each step;
             # snapshot every mutable input (see decode_multi)
@@ -746,6 +740,7 @@ class ModelRunner:
                     else tuple(np.array(c) for c in ctrl))
             g_ids = None if g_ids is None else np.array(g_ids)
             g_states = None if g_states is None else np.array(g_states)
+            verify_idx = None if verify_idx is None else np.array(verify_idx)
         S = context_lens.shape[0]
         if use_penalties:
             self._ensure_counts()
@@ -768,6 +763,8 @@ class ModelRunner:
                 jnp.asarray(temps), jnp.asarray(top_ps),
                 jnp.asarray(top_ks), jnp.asarray(seeds),
                 jnp.asarray(steps), counts, pres, freq,
+                verify_idx=(jnp.asarray(verify_idx, jnp.int32)
+                            if self.spec_width > 0 else None),
                 lora_bank=self.lora_bank if use_lora else None,
                 adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)
                              if use_lora else None),
@@ -1250,40 +1247,6 @@ def _prefill_ring_step(cfg: ModelConfig, mesh, head_axis, tp, params, kv,
     return new_kv, (sampled, *lp)
 
 
-def _verify_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
-                 block_tables, context_lens, slot_mapping,
-                 lora_bank=None, adapter_ids=None):
-    """Speculative verification: greedy argmax at ALL chunk positions.
-
-    Reuses the batched-prefill attention path (causal within the chunk +
-    paged context), so drafts' K/V land in their deterministic slots; a
-    rejected draft's slot is rewritten when the real token for that
-    position is fed on a later step. The per-position LM head runs under
-    ``lax.map`` so the (B, S, V) logits cube is never materialised —
-    only one (B, V) slice lives at a time."""
-    from production_stack_tpu.models.registry import get_model
-
-    model = get_model(cfg)
-
-    def attend(q, k, v, caches, layer_idx):
-        return attend_impl(
-            q, k, v, caches, layer_idx, block_tables, context_lens, positions,
-            slot_mapping,
-        )
-
-    hidden, new_kv = model.forward_tokens(
-        cfg, params, tokens, positions, attend, kv,
-        lora=_make_lora(lora_bank, adapter_ids, tokens.shape[1]),
-    )
-
-    def one_pos(h_s):  # (B, E) hidden at one chunk position
-        logits = model.logits_from_hidden(cfg, params, h_s[:, None])[:, 0]
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    out = jax.lax.map(one_pos, hidden.transpose(1, 0, 2))  # (S, B)
-    return new_kv, out.transpose(1, 0)  # (B, S)
-
-
 def _decode_step(cfg: ModelConfig, attend_impl, params, kv, tokens, positions,
                  block_tables, context_lens, slot_mapping):
     from production_stack_tpu.models.registry import get_model
@@ -1419,11 +1382,12 @@ def _decode_multi_step(cfg: ModelConfig, attend_impl, num_steps: int, eos_id,
     return (kv, counts), (sampled, next_tok, *lp)
 
 
-def _ragged_step(cfg: ModelConfig, attend_impl, eos_id, params, kv,
+def _ragged_step(cfg: ModelConfig, attend_impl, eos_id, spec_width, params, kv,
                  tokens, positions, block_tables, context_lens, cu_q_lens,
                  slot_mapping, last_idx, sample_mask,
                  temps, top_ps, top_ks, seeds, steps,
                  token_counts, presence, frequency,
+                 verify_idx=None,
                  lora_bank=None, adapter_ids=None, ctrl=None, grammar=None,
                  *, greedy_only: bool = False,
                  use_penalties: bool = False,
@@ -1433,13 +1397,28 @@ def _ragged_step(cfg: ModelConfig, attend_impl, eos_id, params, kv,
     token stream, then one sample per slot at its span's last token.
 
     tokens/positions: (1, T); cu_q_lens (S+1,) span offsets in slot order
-    (decode rows span 1 token, prefilling slots their chunk, inactive 0);
-    last_idx (S,) stream index of each slot's final token; sample_mask
-    (S,) gates the on-device penalty-count update to rows whose sample is
-    actually consumed. Logprobs ride every dispatch (like _prefill_step):
-    one (S, V) top-k next to the stream forward is noise, and it keeps the
-    want_logprobs compile variant from existing on the unified path.
-    Returns ((new_kv, new_counts), (sampled (S,), tok_lp, ids, lps))."""
+    (decode rows span 1 token — or 1 + drafts when speculating, prefilling
+    slots their chunk, inactive 0); last_idx (S,) stream index of each
+    slot's final token; sample_mask (S,) gates the on-device penalty-count
+    update to rows whose sample is actually consumed. Logprobs ride every
+    dispatch (like _prefill_step): one (S, V) top-k next to the stream
+    forward is noise, and it keeps the want_logprobs compile variant from
+    existing on the unified path.
+
+    Speculative verification is fused here (spec_width is a compile-time
+    constant from SchedulerConfig.spec_ngram_k, partial-bound at jit
+    construction): verify_idx (S, spec_width) indexes the stream at each
+    slot's draft positions, and the greedy argmax of the RAW logits there
+    joins the result. Raw is correct because only rows without penalties/
+    controls/grammar are spec-eligible, and for those sampling is argmax
+    of the same raw logits — which is what makes greedy output with
+    speculation bit-identical to without. Rows with fewer (or no) drafts
+    point verify_idx at harmless in-span indices and the host ignores the
+    extra columns. The per-position LM head runs under ``lax.map`` so the
+    (S, spec_width, V) logits cube is never materialised.
+
+    Returns ((new_kv, new_counts),
+    (sampled (S,)[, verify (S, spec_width)], tok_lp, ids, lps))."""
     from production_stack_tpu.engine.sampling import (
         compute_logprobs,
         sample_tokens,
@@ -1491,4 +1470,12 @@ def _ragged_step(cfg: ModelConfig, attend_impl, eos_id, params, kv,
             sample_mask.astype(token_counts.dtype)
         )
     lp = compute_logprobs(raw_logits, sampled)
+    if spec_width > 0:
+        def one_col(idx):  # (S,) stream indices of draft column j
+            h = jnp.take(hidden[0], idx, axis=0)  # (S, E)
+            col = model.logits_from_hidden(cfg, params, h[:, None])[:, 0]
+            return jnp.argmax(col, axis=-1).astype(jnp.int32)
+
+        verify = jax.lax.map(one_col, verify_idx.T).T  # (S, spec_width)
+        return (new_kv, token_counts), (sampled, verify, *lp)
     return (new_kv, token_counts), (sampled, *lp)

@@ -105,9 +105,10 @@ class CompileTracker:
 
 def wrap_runner_programs(runner, observer: Callable) -> None:
     """Install ``CompileTracker`` proxies over a runner's jitted programs
-    (the per-bucket prefill variants and every decode/verify variant)."""
+    (the per-bucket prefill variants and every decode variant; speculative
+    verify has no program of its own — it is fused into ``_ragged``)."""
     for attr in ("_prefill", "_prefill_ring", "_decode", "_decode_multi",
-                 "_verify", "_sample", "_ragged"):
+                 "_sample", "_ragged"):
         fn = getattr(runner, attr, None)
         if fn is None or isinstance(fn, CompileTracker):
             continue
@@ -232,7 +233,9 @@ class PerfAccountant:
 
     def record_ragged(self, prefill_tokens: int, prefill_ctx: int,
                       prefill_rows: int, decode_seqs: int, decode_ctx: int,
-                      ts: Optional[float] = None) -> None:
+                      ts: Optional[float] = None, *,
+                      spec_tokens: int = 0, spec_ctx: int = 0,
+                      spec_rows: int = 0) -> None:
         """One unified ragged dispatch: ``prefill_tokens`` prompt tokens
         over ``prefill_rows`` chunks (post-chunk contexts summing to
         ``prefill_ctx``) packed together with ``decode_seqs`` single-token
@@ -245,23 +248,51 @@ class PerfAccountant:
         dispatch, attributed to whichever phase is present), the decode
         share adds its attention context FLOPs and KV traffic on top —
         one fused dispatch never double-counts the weight read the way
-        separate record_prefill + record_decode calls would."""
-        if prefill_tokens <= 0 and decode_seqs <= 0:
+        separate record_prefill + record_decode calls would.
+
+        Speculative draft/verify spans (``spec_tokens`` draft tokens over
+        ``spec_rows`` rows, post-span contexts summing to ``spec_ctx``)
+        are prefill-SHAPED work and their FLOPs/KV traffic are costed
+        into the prefill event — but with ZERO goodput tokens there:
+        drafts only become goodput if accepted, and accepted tokens land
+        as decode goodput via ``record_spec_accepted`` (each spec row's
+        one guaranteed token is already in ``decode_seqs``)."""
+        if prefill_tokens <= 0 and decode_seqs <= 0 and spec_tokens <= 0:
             return
-        if prefill_tokens > 0:
+        if prefill_tokens > 0 or spec_tokens > 0:
             ctx_mean = prefill_ctx / max(prefill_rows, 1)
             flops = (2.0 * self.param_count * prefill_tokens
                      + self._attn_per_tok_ctx * prefill_tokens * ctx_mean)
             hbm = (self.param_bytes
                    + (prefill_tokens + prefill_ctx) * self._kv_bytes_per_tok)
+            if spec_tokens > 0:
+                spec_ctx_mean = spec_ctx / max(spec_rows, 1)
+                flops += (2.0 * self.param_count * spec_tokens
+                          + self._attn_per_tok_ctx * spec_tokens
+                          * spec_ctx_mean)
+                hbm += ((spec_tokens + spec_ctx) * self._kv_bytes_per_tok)
             self._record(ts, "prefill", flops, hbm, prefill_tokens)
         if decode_seqs > 0:
             flops = (2.0 * self.param_count * decode_seqs
                      + self._attn_per_tok_ctx * decode_ctx)
             hbm = (decode_ctx + decode_seqs) * self._kv_bytes_per_tok
-            if prefill_tokens <= 0:  # decode-only dispatch pays the weights
-                hbm += self.param_bytes
+            if prefill_tokens <= 0 and spec_tokens <= 0:
+                hbm += self.param_bytes  # decode-only pays the weights
             self._record(ts, "decode", flops, hbm, decode_seqs)
+
+    def record_spec_accepted(self, tokens: int,
+                             ts: Optional[float] = None) -> None:
+        """Accepted speculative tokens: pure decode goodput on top of the
+        one-per-row the dispatch already counted. Zero FLOPs/HBM here —
+        the verification work that produced them was costed as
+        prefill-phase span work in ``record_ragged``. Not a dispatch."""
+        if tokens <= 0:
+            return
+        now = ts if ts is not None else time.monotonic()
+        with self._lock:
+            self._events.append((now, "decode", 0.0, 0.0, tokens))
+            self._totals["decode_tokens"] += tokens
+            self._trim(now)
 
     def _record(self, ts, phase, flops, hbm_bytes, tokens) -> None:
         now = ts if ts is not None else time.monotonic()

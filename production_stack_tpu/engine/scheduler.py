@@ -87,6 +87,11 @@ class Scheduler:
         # max_num_batched_tokens is the only shape knob (no prefill
         # buckets, no prefill/decode phase barrier)
         self.unified = False
+        # set by the engine when speculative decoding is on: returns the
+        # draft width to reserve for a decode row (0 = ineligible or cold;
+        # see spec.SpecController). The scheduler charges 1 + grant stream
+        # tokens for the row and reserves KV blocks for the whole span.
+        self.spec_grant_fn = None
 
     # -- queue management ---------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -269,9 +274,15 @@ class Scheduler:
         whatever budget is left — one mixed batch per step, no
         prefill/decode phase barrier, and ``max_num_batched_tokens`` as
         the ONLY shape knob (no bucket truncation: the ragged dispatch
-        has no padded chunk dimension to round up to)."""
+        has no padded chunk dimension to round up to).
+
+        With speculation on, each spec-eligible decode row is charged
+        ``1 + grant`` stream tokens so drafts compete fairly with prefill
+        chunks for the same budget."""
         out.decodes = self._grow_decodes(out)
         budget = self.config.max_num_batched_tokens - len(out.decodes)
+        if self.spec_grant_fn is not None:
+            budget = self._grant_spec_drafts(out, budget)
         for seq in sorted(self.seqs.values(), key=lambda s: s.arrival_time):
             if seq.status is not SequenceStatus.PREFILLING:
                 continue
@@ -289,6 +300,40 @@ class Scheduler:
             )
             budget -= chunk
         return out
+
+    def _grant_spec_drafts(self, out: SchedulerOutput, budget: int) -> int:
+        """Reserve stream budget and KV blocks for speculative drafts.
+
+        FCFS over the decode rows: each eligible row asks ``spec_grant_fn``
+        for its adaptive width, gets it clamped to the remaining budget,
+        and has blocks appended so positions ``num_computed .. num_computed
+        + grant`` all have KV slots — drafts are no longer silently
+        truncated at a block boundary the way the old batch-wide path
+        clamped them. Draft capacity never preempts anyone (drafts are
+        optional work); if the pool is dry the grant shrinks to whatever
+        the current table holds. The final grant lands on ``seq.spec_grant``
+        for the engine to propose against at pack time."""
+        bs = self.cache_config.block_size
+        for seq in sorted(out.decodes, key=lambda s: s.arrival_time):
+            seq.spec_grant = 0
+            if budget <= 0:
+                continue
+            k = min(self.spec_grant_fn(seq), budget,
+                    self.max_model_len - 1 - seq.num_computed_tokens)
+            if k <= 0:
+                continue
+            target = seq.num_computed_tokens + 1 + k
+            while len(seq.block_ids) * bs < target:
+                bid = self.allocator.append_block()
+                if bid is None:
+                    break
+                seq.block_ids.append(bid)
+            k = min(k, len(seq.block_ids) * bs - seq.num_computed_tokens - 1)
+            if k <= 0:
+                continue
+            seq.spec_grant = k
+            budget -= k
+        return budget
 
     def _grow_decodes(self, out: SchedulerOutput) -> list[Sequence]:
         """Collect every decodable sequence, growing block tables first so

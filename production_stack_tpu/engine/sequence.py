@@ -59,6 +59,15 @@ class Sequence:
     # allocator until evicted — the handle for P→D KV export)
     released_block_ids: list[int] = dataclasses.field(default_factory=list)
 
+    # speculative decoding (engine/spec.py): acceptance EWMA + cold-probe
+    # counter driving the adaptive draft width, the stream-token grant the
+    # scheduler charged this step, and the drafts actually proposed at
+    # pack time (consumed by the next ragged dispatch)
+    spec_ewma: float = 1.0
+    spec_cold_steps: int = 0
+    spec_grant: int = 0
+    spec_drafts: list[int] = dataclasses.field(default_factory=list)
+
     @property
     def token_ids(self) -> list[int]:
         return self.prompt_token_ids + self.output_token_ids

@@ -1437,12 +1437,28 @@ class EngineServer:
     async def debug_perf(self, request: web.Request) -> web.Response:
         """Goodput-accounting snapshot (engine/perf_accounting.py): live
         MFU / HBM-bandwidth utilization, phase throughput, HBM occupancy,
-        and the compile-event log — the always-on counterpart to the
-        profiler endpoints above."""
+        the compile-event log, and the speculative-decoding acceptance
+        picture — the always-on counterpart to the profiler endpoints
+        above."""
         perf = getattr(self.engine, "perf", None)
         if perf is None:
             return web.json_response({"enabled": False})
-        return web.json_response(perf.snapshot())
+        snap = perf.snapshot()
+        eng = self.engine
+        drafted = getattr(eng, "spec_drafted", 0)
+        steps = getattr(eng, "spec_steps", 0)
+        snap["speculative"] = {
+            "enabled": getattr(eng, "_spec", None) is not None,
+            "draft_tokens": drafted,
+            "accepted_tokens": getattr(eng, "spec_accepted", 0),
+            "acceptance_rate": (
+                getattr(eng, "spec_accepted", 0) / drafted if drafted else 0.0
+            ),
+            "tokens_per_step": (
+                getattr(eng, "spec_step_tokens", 0) / steps if steps else 0.0
+            ),
+        }
+        return web.json_response(snap)
 
     async def memory_profile(self, request: web.Request) -> web.Response:
         """Device memory profile (pprof proto) — what holds HBM right now."""
@@ -2497,11 +2513,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculative-ngram", type=int, default=0,
                    help="n-gram (prompt-lookup) speculative decoding: "
                         "propose up to this many draft tokens per step from "
-                        "the sequence's own history and verify them in one "
-                        "forward (vLLM --speculative-config ngram "
-                        "equivalent; greedy requests only). 0 = off")
+                        "the sequence's own history and verify them inside "
+                        "the ragged unified dispatch (vLLM "
+                        "--speculative-config ngram equivalent). Per-"
+                        "sequence: greedy rows speculate, sampled/penalised "
+                        "rows in the same batch decode normally; an "
+                        "acceptance EWMA adapts the width per sequence. "
+                        "0 = off; needs --attention-impl ragged")
     p.add_argument("--speculative-ngram-max", type=int, default=3,
                    help="longest tail n-gram matched against the history")
+    p.add_argument("--speculative-ngram-min", type=int, default=1,
+                   help="shortest tail n-gram matched against the history "
+                        "(the proposer tries max..min, longest first)")
+    p.add_argument("--speculative-window", type=int, default=4096,
+                   help="trailing history tokens the n-gram proposer "
+                        "searches for a recurrence")
     p.add_argument("--fault-injection", default=None,
                    help="inject faults on the OpenAI surface for "
                         "resilience drills, e.g. error_rate=0.3,"
@@ -2651,6 +2677,8 @@ def config_from_args(args) -> EngineConfig:
     if args.speculative_ngram:
         cfg.scheduler.spec_ngram_k = args.speculative_ngram
         cfg.scheduler.spec_ngram_max = args.speculative_ngram_max
+        cfg.scheduler.spec_ngram_min = args.speculative_ngram_min
+        cfg.scheduler.spec_window = args.speculative_window
     if args.max_queue_len is not None:
         cfg.scheduler.max_queue_len = args.max_queue_len
     if args.host_offload_blocks:

@@ -8,15 +8,22 @@ occurrence of the current tail n-gram and proposes the tokens that followed
 it. Multi-round QA and agentic workloads repeat long spans verbatim, so
 acceptance rates are high exactly where decode throughput matters.
 
-Verification happens on device in ONE forward over the paged cache
-(ModelRunner.verify): the drafts enter as a short prefill-shaped chunk and
-the model's greedy output at every position either confirms or replaces
-them — output tokens are always the model's own argmax, so greedy output
-is identical with speculation on or off (up to XLA reduction-order
-numerics across batch shapes).
+Verification is fused into the ragged unified dispatch (there is no
+standalone verify program): the drafts ride the packed token stream as a
+short prefill-shaped span and the model's greedy output at every span
+position either confirms or replaces them — output tokens are always the
+model's own argmax, so greedy output is identical with speculation on or
+off (up to XLA reduction-order numerics across batch shapes).
+
+:class:`SpecController` adapts the per-sequence draft width with an
+acceptance EWMA: sequences that keep rejecting drafts shrink to k=0 (their
+stream-budget charge drops to the plain-decode 1 token), and a periodic
+probe lets a sequence that went cold rediscover a repeating phase.
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 import numpy as np
 
@@ -76,3 +83,52 @@ def accept_drafts(drafts: list[int], verified: np.ndarray) -> tuple[list[int], i
             break
     new_tokens = [int(verified[j]) for j in range(n_acc + 1)]
     return new_tokens, n_acc
+
+
+@dataclasses.dataclass
+class SpecController:
+    """Per-sequence acceptance-EWMA adaptation of the draft width k.
+
+    The grant is what the scheduler charges against the stream token
+    budget (1 + grant per spec row), so a cold sequence must converge to
+    grant 0 quickly — otherwise every step taxes prefill chunks for
+    drafts that never get accepted. ``ewma`` starts optimistic (1.0: new
+    sequences get the full k_max) and tracks accepted/drafted per verify;
+    a grant whose proposal found no recurring n-gram decays it too (the
+    budget was reserved and wasted). Once the grant rounds to 0 the
+    sequence stops being charged, and every ``probe_interval`` scheduled
+    steps it gets one full-width probe so a workload that re-enters a
+    repetitive phase (multi-round chat re-feeding context verbatim) can
+    recover without any global reset.
+
+    Adaptation only changes WHICH drafts are proposed, never the emitted
+    tokens — those are always the model's own argmax.
+    """
+
+    k_max: int
+    alpha: float = 0.5  # EWMA step toward the newest acceptance ratio
+    probe_interval: int = 8  # cold-sequence full-width probe cadence
+
+    def grant(self, seq) -> int:
+        """Draft width to reserve budget for this step (may exceed what
+        the proposer actually finds; unused grant is idle stream slack)."""
+        if self.k_max <= 0:
+            return 0
+        k = int(round(self.k_max * seq.spec_ewma))
+        if k > 0:
+            return min(k, self.k_max)
+        seq.spec_cold_steps += 1
+        if seq.spec_cold_steps >= self.probe_interval:
+            seq.spec_cold_steps = 0
+            return self.k_max
+        return 0
+
+    def update(self, seq, drafted: int, accepted: int) -> None:
+        """Fold one verify result (or a granted-but-matchless step, with
+        drafted = grant and accepted = 0) into the sequence's EWMA."""
+        if drafted <= 0:
+            return
+        ratio = accepted / drafted
+        seq.spec_ewma = (1.0 - self.alpha) * seq.spec_ewma + self.alpha * ratio
+        if accepted > 0:
+            seq.spec_cold_steps = 0

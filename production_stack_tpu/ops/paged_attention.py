@@ -125,9 +125,11 @@ def ragged_paged_attention(
     soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """XLA reference for the ragged kernel: the packed mixed
-    prefill+decode stream attended per token against its owning slot's
-    paged context (ops/ragged_paged_attention_pallas.py is the TPU hot
-    path; this is the CPU/fallback path and the parity oracle).
+    prefill+decode stream — including speculative verify spans, which are
+    just short prefill-shaped spans of ``1 + k`` tokens ending at the
+    slot's context — attended per token against its owning slot's paged
+    context (ops/ragged_paged_attention_pallas.py is the TPU hot path;
+    this is the CPU/fallback path and the parity oracle).
 
     Padding tokens (q_positions < 0) produce finite garbage, exactly like
     ``paged_attention``'s inactive rows — their logits are discarded

@@ -7,7 +7,10 @@ packed token stream directly ("Ragged Paged Attention", PAPERS.md):
 
 - queries arrive as one ``(T, H, D)`` stream — the concatenation of every
   scheduled sequence's span (a prefill chunk of any length, a decode row
-  of one token, or an empty span for an inactive slot), described by
+  of one token, a speculative verify span of ``1 + k`` tokens — the last
+  accepted token followed by ``k`` n-gram drafts, attended causally so
+  position ``j`` scores every draft against the model's own prediction in
+  one pass — or an empty span for an inactive slot), described by
   ``cu_q_lens (S+1,)`` cumulative span offsets;
 - the grid is tiled over fixed ``q_tile`` windows of the stream, NOT over
   sequences: a tile that straddles sequence boundaries walks each
@@ -23,7 +26,9 @@ packed token stream directly ("Ragged Paged Attention", PAPERS.md):
 There are no padding lanes between spans and no shape buckets: the only
 compile-relevant shape is the budget-padded ``T`` (tokens the scheduler
 may batch) and the fixed ``S`` slot count, so the steady-state engine
-compiles this program exactly once. Tail padding past ``cu_q_lens[-1]``
+compiles this program exactly once — speculative verification included,
+since a verify span is just a short prefill-shaped span and the kernel
+never distinguishes the two. Tail padding past ``cu_q_lens[-1]``
 belongs to no sequence and computes to zeros.
 
 The matching ragged KV write is ``kv_cache_write_pallas`` (paged_

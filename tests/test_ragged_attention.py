@@ -128,6 +128,12 @@ FUZZ_CASES = [
     ([20, 0, 2], [20, 0, 6], 8),
     # all-empty except one decode row
     ([0, 1, 0], [0, 30, 0], 8),
+    # speculative verify spans (1 + k drafts ending at the slot's context)
+    # packed beside plain decode rows and a prefill chunk — the fused-
+    # verify dispatch shape (engine/model_runner._ragged_step)
+    ([5, 1, 3, 1], [9, 17, 11, 1], 8),
+    # verify span crossing a block boundary next to an empty slot
+    ([6, 0, 1], [10, 0, 3], 8),
 ]
 
 

@@ -82,7 +82,8 @@ def make_engine(setup, spec_k=0, **sched_overrides):
     cfg, mesh, params = setup
     sched = dataclasses.replace(cfg.scheduler, spec_ngram_k=spec_k,
                                 **sched_overrides)
-    cfg = dataclasses.replace(cfg, scheduler=sched)
+    # speculation is ragged-only (verify spans ride the unified dispatch)
+    cfg = dataclasses.replace(cfg, scheduler=sched, attention_impl="ragged")
     return LLMEngine(cfg, mesh=mesh, params=params,
                      num_blocks=cfg.cache.num_blocks)
 
@@ -127,8 +128,9 @@ def test_spec_max_tokens_exact(setup):
 
 
 def test_spec_mixed_batch_falls_back(setup):
-    """A sampled request in the batch forces plain decode for those steps;
-    the greedy request's output must still match the spec-free engine."""
+    """Eligibility is per sequence: a sampled request in the batch decodes
+    normally while the greedy row keeps speculating in the SAME dispatch —
+    and the greedy output must still match the spec-free engine."""
     spec = make_engine(setup, spec_k=4)
     greedy_long = SamplingParams(temperature=0.0, max_tokens=16,
                                  ignore_eos=True)
@@ -151,7 +153,8 @@ def test_spec_near_model_len_cap(setup):
     model = dataclasses.replace(cfg.model, max_model_len=32)
     sched = dataclasses.replace(cfg.scheduler, spec_ngram_k=4)
     eng = LLMEngine(
-        dataclasses.replace(cfg, model=model, scheduler=sched),
+        dataclasses.replace(cfg, model=model, scheduler=sched,
+                            attention_impl="ragged"),
         mesh=mesh, params=params, num_blocks=cfg.cache.num_blocks,
     )
     sp = SamplingParams(temperature=0.0, max_tokens=64, ignore_eos=True)
