@@ -12,6 +12,10 @@ independent):
      conversations — prefix-cache hit rate and the TTFT improvement the
      KV reuse buys (the reference's multi-round-qa win, its README's
      headline scenario).
+  4. mixed steady-state chat, 5. speculative decoding, and
+  6. multi-chip TP: the ragged dispatch sharded across the named mesh at
+     TP=4/8 — tok/s/chip, greedy bit-identity vs single-chip, zero
+     post-warmup recompiles, and the ICI roofline utilization.
 
 Prints ONE JSON line (driver contract): the headline metric/value/unit/
 vs_baseline plus the scenario numbers as extra keys.
@@ -46,6 +50,15 @@ def pctl(xs, p):
 
 
 def run_bench() -> None:
+    # the multichip scenario (6) needs a multi-device mesh; on CPU that is
+    # XLA's forced host platform (same lever as tests/conftest.py) and the
+    # flag must land before jax initializes. Harmless on TPU: it only
+    # sizes the host platform, and the TPU mesh is built from jax.devices()
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
     import jax
 
     # honor the env platform in-config: the TPU tunnel's interpreter hook
@@ -278,6 +291,79 @@ def run_bench() -> None:
     spec_off_tok_s, spec_off_out, _ = spec_run(0)
     spec_on_tok_s, spec_on_out, spec_stats = spec_run(spec_k)
 
+    # 6) multi-chip TP: the ragged unified dispatch sharded across the
+    # named mesh (docs/roofline.md "Multi-chip") — the SAME greedy
+    # prompts at TP=1 then TP=4/8, reporting tok/s/chip (the honest
+    # multi-chip number), greedy bit-identity vs the single-chip run,
+    # the post-warmup unexpected-recompile count (must stay 0: the
+    # sharded signature is warmed exactly like the unsharded one), and
+    # the ICI roofline utilization the accountant prices from the
+    # sharding spec. KV heads must divide the tensor axis for the paged
+    # KV pool to actually shard (llama-3b-class KH=8 covers TP=4/8 on
+    # TPU; a shardable small geometry stands in on the CPU host-device
+    # mesh — tiny-llama's KH=4 would replicate KV at TP=8). bf16: int8
+    # cross-program rounding would mis-read as a sharding identity
+    # failure, same argmax-near-tie caveat as scenario 5.
+    mc_n = 32 if on_tpu else 4
+    mc_out = 64 if on_tpu else 8
+    mc_prompt = 128 if on_tpu else 32
+    if on_tpu:
+        mc_model = dataclasses.replace(cfg.model, quant=None)
+    else:
+        mc_model = dataclasses.replace(
+            ModelConfig.from_pretrained("tiny-llama"),
+            hidden_size=256, intermediate_size=512, num_layers=4,
+            num_heads=8, num_kv_heads=8, head_dim=32)
+    mc_sched = dataclasses.replace(
+        cfg.scheduler, max_num_seqs=max(mc_n, 4),
+        max_num_batched_tokens=256 if on_tpu else 128,
+        prefill_buckets=(128,) if on_tpu else (32,),
+    )
+    mc_prompts = [prompt(mc_prompt) for _ in range(mc_n)]
+    ndev = len(jax.devices())
+
+    def mc_run(tp: int):
+        nonlocal engine
+        engine = LLMEngine(
+            dataclasses.replace(cfg, model=mc_model, scheduler=mc_sched,
+                                attention_impl="ragged",
+                                mesh=MeshConfig(data=1, tensor=tp)),
+            mesh=build_mesh(MeshConfig(data=1, tensor=tp),
+                            devices=jax.devices()[:tp]),
+            num_blocks=num_blocks,
+        )
+        engine.warmup()  # covers the sharded signature + marks steady
+        if engine.perf is not None:
+            engine.perf._events.clear()  # scope the window to the run
+        elapsed, produced, _, _, outs, _ = run_batch(
+            f"mc{tp}", [list(p) for p in mc_prompts], mc_out)
+        snap = engine.perf.snapshot() if engine.perf is not None else {}
+        del engine
+        gc.collect()
+        engine = None
+        toks = [outs[f"mc{tp}-{i}"] for i in range(mc_n)]
+        coll = snap.get("collective_bytes_total") or {}
+        return {
+            "tp": tp,
+            "tok_s": round(produced / elapsed, 1),
+            "tok_s_chip": round(produced / elapsed / tp, 1),
+            "ici_bandwidth_utilization": round(
+                snap.get("ici_bandwidth_utilization", 0.0), 6),
+            "collective_bytes_total": {k: round(v, 1)
+                                       for k, v in sorted(coll.items())},
+            "unexpected_recompiles": (snap.get("compile") or {}).get(
+                "unexpected_recompiles", 0),
+        }, toks
+
+    mc_base, mc_base_out = mc_run(1)
+    mc_runs = [mc_base]
+    for mc_tp in (4, 8):
+        if mc_tp > ndev:
+            continue
+        row, out_tp = mc_run(mc_tp)
+        row["greedy_identical"] = out_tp == mc_base_out
+        mc_runs.append(row)
+
     target = 2000.0
     print(json.dumps({
         "metric": f"output throughput ({model}, {quant or 'bf16'}, "
@@ -326,6 +412,15 @@ def run_bench() -> None:
             "tokens_per_step": round(
                 spec_stats.get("spec_decode_tokens_per_step", 0.0), 3),
             "greedy_identical": spec_on_out == spec_off_out,
+        },
+        "multichip": {
+            "attention_impl": "ragged",
+            "model": mc_model.name,
+            "devices_available": ndev,
+            "seqs": mc_n,
+            "prompt_len": mc_prompt,
+            "out_len": mc_out,
+            "runs": mc_runs,
         },
     }))
 

@@ -518,9 +518,13 @@ class PerfConfig:
     # sliding window the utilization gauges are computed over, seconds
     window: float = 60.0
     # 0 = use the v5e rooflines from docs/roofline.md (197 TFLOP/s bf16,
-    # 819 GB/s HBM); set explicitly on other generations
+    # 819 GB/s HBM, 200 GB/s per-chip ICI); set explicitly on other
+    # generations. The FLOP/HBM peaks are per chip — the accountant
+    # scales them by the mesh size; the ICI peak stays per chip (the
+    # collective cost model counts per-chip wire bytes).
     peak_tflops: float = 0.0
     peak_hbm_gbps: float = 0.0
+    peak_ici_gbps: float = 0.0
     # how often device.memory_stats() is sampled for the HBM gauges
     hbm_poll_interval: float = 5.0
 

@@ -23,7 +23,25 @@ def _mesh_is_context(mesh):
     return mesh
 
 
-set_mesh = getattr(jax, "set_mesh", _mesh_is_context)
+def _resolve_mesh_context():
+    """Pick the newest available mesh-context API, oldest-CI-safe.
+
+    Newest jax spells it ``jax.set_mesh``; the intermediate releases
+    shipped ``jax.sharding.use_mesh`` (scoped context manager) first; on
+    anything older the ``Mesh`` object itself is the context. All three
+    are entered identically, so callers never branch on version."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh
+    use = getattr(jax.sharding, "use_mesh", None)
+    if use is not None:
+        return use
+    return _mesh_is_context
+
+
+set_mesh = _resolve_mesh_context()
+# scoped alias: some call sites read better as "use this mesh here";
+# identical resolution, kept as one object so tests pin the fallback once
+use_mesh = set_mesh
 
 # jax.shard_map graduated from jax.experimental.shard_map (where the
 # replication-check kwarg was still called check_rep, not check_vma)

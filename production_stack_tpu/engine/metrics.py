@@ -192,6 +192,28 @@ class EngineStatsCollector:
             yield gauge("vllm:hbm_bytes_peak",
                         "Peak device HBM bytes observed",
                         perf["hbm_bytes_peak"])
+            # multi-chip ICI roofline (zero series on a 1-chip mesh):
+            # collective bytes are per-chip wire traffic derived from the
+            # sharding degree + model geometry, costed against the
+            # per-chip ICI link bandwidth
+            yield gauge(
+                "vllm:ici_bandwidth_utilization",
+                "Estimated per-chip ICI bandwidth utilization over the "
+                "window (collective bytes from the sharding spec + model "
+                "geometry vs the per-chip link peak)",
+                perf.get("ici_bw_util", 0.0),
+            )
+            coll = CounterMetricFamily(
+                "vllm:collective_bytes",
+                "Estimated per-chip collective bytes on the ICI by op "
+                "(all_reduce: row-parallel matmul outputs; all_gather: "
+                "vocab-sharded logits at consumed stream positions)",
+                labels=["model_name", "op"],
+            )
+            for op, n in sorted(
+                    (perf.get("collective_bytes") or {}).items()):
+                coll.add_metric([self.model_name, op], n)
+            yield coll
             compiles = CounterMetricFamily(
                 "vllm:compile_events",
                 "jit compile events per program kind and shape bucket",

@@ -129,20 +129,27 @@ class ModelRunner:
         # EOS lives (allowed exactly in accepting FSM states)
         self._eos_id = get_tokenizer(config.model.tokenizer).eos_id
 
-        # multihost gate (engine/multihost.py contract): with more than one
-        # controller process, every result the leader fetches must come out
-        # fully REPLICATED so jax.device_get is a local host copy on each
-        # process — a partially-sharded output is not addressable from one
-        # controller. The (None, repl) prefix keeps the donated KV pool on
-        # its own sharding (auto) and replicates only the small result
-        # leaves (sampled tokens, logprobs). Single-process: no gate.
+        # result-replication gate: on ANY multi-device mesh — one process
+        # driving TP over ICI or many controller processes (multihost,
+        # engine/multihost.py contract) — every result the controller
+        # fetches must come out fully REPLICATED so jax.device_get is one
+        # local host copy: a partially-sharded output would either not be
+        # addressable (multihost) or force a cross-chip gather on the
+        # host path every step (single-process TP). The (None, repl)
+        # prefix keeps the donated KV pool on its own sharding (auto —
+        # KV heads stay partitioned over the tensor axis) and replicates
+        # only the small result leaves (sampled tokens, verify columns,
+        # logprobs). Single chip: no gate.
+        from production_stack_tpu.parallel.shardings import replicated
+
         self._replicate_results = jax.process_count() > 1
-        if self._replicate_results:
-            _repl = NamedSharding(mesh, P())
-            self._mh_gate = {"out_shardings": (None, _repl)}
-            self._mh_gate_all = {"out_shardings": _repl}
+        self._multi_device = mesh.devices.size > 1
+        if self._multi_device:
+            self._repl = replicated(mesh)
+            self._mh_gate = {"out_shardings": (None, self._repl)}
+            self._mh_gate_all = {"out_shardings": self._repl}
         else:
-            _repl = None
+            self._repl = None
             self._mh_gate = {}
             self._mh_gate_all = {}
 
@@ -334,6 +341,18 @@ class ModelRunner:
             inner, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )
+
+    def _commit(self, x):
+        """Host step input → device, committed fully replicated on a
+        multi-device mesh (single chip: plain asarray). An uncommitted
+        host array leaves the placement decision to GSPMD per program;
+        committing up front pins the sharded steady-state signature —
+        stream replicated, KV/weights partitioned — so TP=4/8 dispatches
+        retrace exactly as often as single-chip ones (never, after
+        warmup)."""
+        if self._repl is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._repl)
 
     def _xla_attend(self, q, caches, layer_idx, block_tables, context_lens,
                     q_positions):
@@ -716,7 +735,21 @@ class ModelRunner:
         host's next-step work. T and S never change between dispatches:
         ONE steady-state compile signature per static-flag variant
         (CompileTracker treats any post-warmup fresh signature here as a
-        bug signal)."""
+        bug signal).
+
+        Sharded-signature contract (multi-chip mesh): this one program IS
+        the multi-chip serving path. Weights and the paged KV pool are
+        partitioned over the ``tensor`` axis (KV pages by KV head —
+        kv_cache.py); the packed token stream, span offsets, verify
+        columns and every other host-built input here are committed
+        fully REPLICATED (``_commit``), and the result leaves come back
+        replicated (``out_shardings`` gate in ``__init__``) so the fetch
+        is a local host copy — no per-step cross-chip sync on the host
+        path, and the fused KV-write + verify columns run inside the
+        same ``shard_map`` as single-chip. Warmup exercises exactly this
+        signature, so steady state must tick zero
+        ``vllm:unexpected_recompiles_total`` at TP=4/8 just as at TP=1
+        (regression-tested in tests/test_multichip_ragged.py)."""
         use_penalties = presence is not None
         if self.spec_width > 0 and verify_idx is None:
             verify_idx = np.zeros(
@@ -756,14 +789,14 @@ class ModelRunner:
         with set_mesh(self.mesh):
             (self.kv, new_counts), result = self._ragged(
                 self.params, self.kv,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(block_tables), jnp.asarray(context_lens),
-                jnp.asarray(cu_q_lens), jnp.asarray(slot_mapping),
-                jnp.asarray(last_idx), jnp.asarray(sample_mask),
-                jnp.asarray(temps), jnp.asarray(top_ps),
-                jnp.asarray(top_ks), jnp.asarray(seeds),
-                jnp.asarray(steps), counts, pres, freq,
-                verify_idx=(jnp.asarray(verify_idx, jnp.int32)
+                self._commit(tokens), self._commit(positions),
+                self._commit(block_tables), self._commit(context_lens),
+                self._commit(cu_q_lens), self._commit(slot_mapping),
+                self._commit(last_idx), self._commit(sample_mask),
+                self._commit(temps), self._commit(top_ps),
+                self._commit(top_ks), self._commit(seeds),
+                self._commit(steps), counts, pres, freq,
+                verify_idx=(self._commit(np.asarray(verify_idx, np.int32))
                             if self.spec_width > 0 else None),
                 lora_bank=self.lora_bank if use_lora else None,
                 adapter_ids=(jnp.asarray(adapter_ids, jnp.int32)

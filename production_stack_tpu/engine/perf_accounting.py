@@ -12,6 +12,17 @@ gauges instead of one-off measurements:
   ``vllm:hbm_bandwidth_utilization`` and
   ``vllm:tokens_per_second{phase}`` at scrape time, plus periodic HBM
   occupancy snapshots from ``device.memory_stats()``.
+
+  On a multi-chip mesh the same window also carries an ICI axis:
+  per-dispatch collective bytes (all-reduce of the two row-parallel
+  matmul outputs per layer, all-gather of the vocab-sharded logits at
+  every consumed stream position) derived from the sharding degree +
+  model geometry — no collective is instrumented, the bytes are
+  arithmetic, exactly like the FLOP/HBM estimates. Reduced to
+  ``vllm:ici_bandwidth_utilization`` and
+  ``vllm:collective_bytes_total{op}``; the FLOP/HBM ceilings scale by
+  the chip count so MFU is fleet-honest (a TP=4 engine reading the
+  single-chip peak would report 4x the truth).
 * ``CompileTracker`` — wraps each jitted program; a never-seen argument
   signature (shapes/dtypes + static kwargs) is exactly what makes XLA
   compile a new executable, so the first call per signature is counted
@@ -33,6 +44,11 @@ from typing import Callable, Optional
 # docs/roofline.md ("Rooflines (v5e: 197 TFLOP/s bf16, 819 GB/s HBM)")
 V5E_PEAK_TFLOPS = 197.0
 V5E_PEAK_HBM_GBPS = 819.0
+# v5e ICI: 4 links/chip x 400 Gbps = 1600 Gbit/s = 200 GB/s per chip,
+# per direction (docs/roofline.md "Multi-chip"). The collective cost
+# model below counts per-chip bytes-on-the-wire, so this is the
+# matching per-chip ceiling.
+V5E_PEAK_ICI_GBPS = 200.0
 
 _EVENT_TAIL = 64  # compile events kept verbatim for /debug/perf
 
@@ -123,10 +139,20 @@ class PerfAccountant:
 
     def __init__(self, model_cfg, *, param_count: int, param_bytes: int,
                  window: float = 60.0, peak_tflops: float = 0.0,
-                 peak_hbm_gbps: float = 0.0, hbm_poll_interval: float = 5.0):
+                 peak_hbm_gbps: float = 0.0, hbm_poll_interval: float = 5.0,
+                 n_chips: int = 1, tensor_parallel: int = 1,
+                 peak_ici_gbps: float = 0.0):
         self.window = max(window, 1.0)
-        self.peak_flops = (peak_tflops or V5E_PEAK_TFLOPS) * 1e12
-        self.peak_hbm = (peak_hbm_gbps or V5E_PEAK_HBM_GBPS) * 1e9
+        self.n_chips = max(int(n_chips), 1)
+        self.tp = max(int(tensor_parallel), 1)
+        # FLOP and weight-stream costs below are GLOBAL (whole model), so
+        # the matching ceilings are the mesh's aggregate peaks
+        self.peak_flops = (peak_tflops or V5E_PEAK_TFLOPS) * 1e12 * self.n_chips
+        self.peak_hbm = (peak_hbm_gbps or V5E_PEAK_HBM_GBPS) * 1e9 * self.n_chips
+        # collective bytes are counted PER CHIP on the wire (every ring
+        # participant moves the same bytes), so the ICI ceiling stays the
+        # per-chip link bandwidth
+        self.peak_ici = (peak_ici_gbps or V5E_PEAK_ICI_GBPS) * 1e9
         self.param_count = max(int(param_count), 1)
         self.param_bytes = max(int(param_bytes), 1)
         self.hbm_poll_interval = hbm_poll_interval
@@ -135,11 +161,25 @@ class PerfAccountant:
                                   * cfg.head_dim)
         self._kv_bytes_per_tok = (2 * cfg.num_layers * cfg.num_kv_heads
                                   * cfg.head_dim * _dtype_bytes(cfg.dtype))
+        # ICI cost model (docs/roofline.md "Multi-chip"), zero at tp=1:
+        # each layer's two row-parallel matmuls (attention out-proj, MLP
+        # down-proj) end in an all-reduce of the (tokens, hidden)
+        # activation; a ring all-reduce moves 2(tp-1)/tp x payload per
+        # chip. The vocab axis shards the logits, so every stream position
+        # whose logits are consumed (sampled rows + speculative verify
+        # columns) pays an all-gather of (tp-1)/tp x vocab f32 per chip.
+        ar_fac = 2.0 * (self.tp - 1) / self.tp
+        ag_fac = (self.tp - 1) / self.tp
+        self._ar_bytes_per_tok = (2 * cfg.num_layers * cfg.hidden_size
+                                  * _dtype_bytes(cfg.dtype) * ar_fac)
+        self._ag_bytes_per_row = cfg.vocab_size * 4 * ag_fac
         self._lock = threading.Lock()
-        # (ts, phase, flops, hbm_bytes, live_tokens)
+        # (ts, phase, flops, hbm_bytes, live_tokens, ici_bytes)
         self._events: deque = deque()
         self._totals = {"prefill_tokens": 0, "decode_tokens": 0,
-                        "flops": 0.0, "hbm_bytes": 0.0, "dispatches": 0}
+                        "flops": 0.0, "hbm_bytes": 0.0, "ici_bytes": 0.0,
+                        "dispatches": 0}
+        self._collective = {"all_reduce": 0.0, "all_gather": 0.0}
         # compile tracking
         self._compile_counts: dict = {}
         self._compile_events: deque = deque(maxlen=_EVENT_TAIL)
@@ -175,11 +215,27 @@ class PerfAccountant:
             param_count = estimate_param_count(config.model)
             param_bytes = param_count * _dtype_bytes(config.model.dtype)
         perf = config.perf
+        # chip count from the runner's mesh; collective degree from the
+        # resolved sharding rules — when the head axes fell back to
+        # replication (geometry not divisible) the matmuls run locally
+        # and there is nothing to all-reduce, whatever the mesh shape
+        mesh = getattr(runner, "mesh", None)
+        n_chips = int(mesh.devices.size) if mesh is not None else 1
+        tensor_parallel = 1
+        rules = getattr(runner, "rules", None)
+        if mesh is not None and rules is not None:
+            from production_stack_tpu.parallel import shardings as ln
+            from production_stack_tpu.parallel.mesh import AXIS_TENSOR
+
+            if rules.rules.get(ln.HEADS) is not None:
+                tensor_parallel = int(mesh.shape[AXIS_TENSOR])
         return cls(config.model, param_count=param_count,
                    param_bytes=param_bytes, window=perf.window,
                    peak_tflops=perf.peak_tflops,
                    peak_hbm_gbps=perf.peak_hbm_gbps,
-                   hbm_poll_interval=perf.hbm_poll_interval)
+                   hbm_poll_interval=perf.hbm_poll_interval,
+                   n_chips=n_chips, tensor_parallel=tensor_parallel,
+                   peak_ici_gbps=perf.peak_ici_gbps)
 
     # -- compile events ------------------------------------------------------
     def on_compile(self, kind: str, bucket: str, seconds: float) -> None:
@@ -216,7 +272,9 @@ class PerfAccountant:
                  + self._attn_per_tok_ctx * live_tokens * ctx_mean)
         hbm = (self.param_bytes
                + (live_tokens + ctx_tokens) * self._kv_bytes_per_tok)
-        self._record(ts, "prefill", flops, hbm, live_tokens)
+        self._record(ts, "prefill", flops, hbm, live_tokens,
+                     ar_bytes=live_tokens * self._ar_bytes_per_tok,
+                     ag_bytes=rows * self._ag_bytes_per_row)
 
     def record_decode(self, live_seqs: int, steps: int, ctx_tokens: int,
                       ts: Optional[float] = None) -> None:
@@ -229,7 +287,9 @@ class PerfAccountant:
                  + self._attn_per_tok_ctx * ctx_tokens * steps)
         hbm = steps * (self.param_bytes
                        + (ctx_tokens + live_seqs) * self._kv_bytes_per_tok)
-        self._record(ts, "decode", flops, hbm, tokens)
+        self._record(ts, "decode", flops, hbm, tokens,
+                     ar_bytes=tokens * self._ar_bytes_per_tok,
+                     ag_bytes=tokens * self._ag_bytes_per_row)
 
     def record_ragged(self, prefill_tokens: int, prefill_ctx: int,
                       prefill_rows: int, decode_seqs: int, decode_ctx: int,
@@ -256,7 +316,13 @@ class PerfAccountant:
         into the prefill event — but with ZERO goodput tokens there:
         drafts only become goodput if accepted, and accepted tokens land
         as decode goodput via ``record_spec_accepted`` (each spec row's
-        one guaranteed token is already in ``decode_seqs``)."""
+        one guaranteed token is already in ``decode_seqs``).
+
+        Collective (ICI) bytes ride the same split: every live token
+        all-reduces its two row-parallel matmul outputs per layer, and
+        every consumed-logits stream position (prefill samples, decode
+        rows, verify columns) all-gathers its vocab-sharded logits row.
+        Zero at tp=1 — the arithmetic, not a flag, turns it off."""
         if prefill_tokens <= 0 and decode_seqs <= 0 and spec_tokens <= 0:
             return
         if prefill_tokens > 0 or spec_tokens > 0:
@@ -271,14 +337,22 @@ class PerfAccountant:
                           + self._attn_per_tok_ctx * spec_tokens
                           * spec_ctx_mean)
                 hbm += ((spec_tokens + spec_ctx) * self._kv_bytes_per_tok)
-            self._record(ts, "prefill", flops, hbm, prefill_tokens)
+            self._record(
+                ts, "prefill", flops, hbm, prefill_tokens,
+                ar_bytes=((prefill_tokens + spec_tokens)
+                          * self._ar_bytes_per_tok),
+                ag_bytes=((prefill_rows + spec_tokens)
+                          * self._ag_bytes_per_row),
+            )
         if decode_seqs > 0:
             flops = (2.0 * self.param_count * decode_seqs
                      + self._attn_per_tok_ctx * decode_ctx)
             hbm = (decode_ctx + decode_seqs) * self._kv_bytes_per_tok
             if prefill_tokens <= 0 and spec_tokens <= 0:
                 hbm += self.param_bytes  # decode-only pays the weights
-            self._record(ts, "decode", flops, hbm, decode_seqs)
+            self._record(ts, "decode", flops, hbm, decode_seqs,
+                         ar_bytes=decode_seqs * self._ar_bytes_per_tok,
+                         ag_bytes=decode_seqs * self._ag_bytes_per_row)
 
     def record_spec_accepted(self, tokens: int,
                              ts: Optional[float] = None) -> None:
@@ -290,17 +364,22 @@ class PerfAccountant:
             return
         now = ts if ts is not None else time.monotonic()
         with self._lock:
-            self._events.append((now, "decode", 0.0, 0.0, tokens))
+            self._events.append((now, "decode", 0.0, 0.0, tokens, 0.0))
             self._totals["decode_tokens"] += tokens
             self._trim(now)
 
-    def _record(self, ts, phase, flops, hbm_bytes, tokens) -> None:
+    def _record(self, ts, phase, flops, hbm_bytes, tokens,
+                ar_bytes: float = 0.0, ag_bytes: float = 0.0) -> None:
         now = ts if ts is not None else time.monotonic()
+        ici = ar_bytes + ag_bytes
         with self._lock:
-            self._events.append((now, phase, flops, hbm_bytes, tokens))
+            self._events.append((now, phase, flops, hbm_bytes, tokens, ici))
             self._totals[f"{phase}_tokens"] += tokens
             self._totals["flops"] += flops
             self._totals["hbm_bytes"] += hbm_bytes
+            self._totals["ici_bytes"] += ici
+            self._collective["all_reduce"] += ar_bytes
+            self._collective["all_gather"] += ag_bytes
             self._totals["dispatches"] += 1
             self._trim(now)
 
@@ -340,16 +419,18 @@ class PerfAccountant:
     def _window_rates(self, now: float) -> dict:
         self._trim(now)
         if not self._events:
-            return {"mfu": 0.0, "hbm_bw_util": 0.0,
+            return {"mfu": 0.0, "hbm_bw_util": 0.0, "ici_bw_util": 0.0,
                     "prefill_tps": 0.0, "decode_tps": 0.0}
         span = max(now - self._events[0][0], 1e-3)
         flops = sum(e[2] for e in self._events)
         hbm = sum(e[3] for e in self._events)
         ptok = sum(e[4] for e in self._events if e[1] == "prefill")
         dtok = sum(e[4] for e in self._events if e[1] == "decode")
+        ici = sum(e[5] for e in self._events)
         return {
             "mfu": flops / (span * self.peak_flops),
             "hbm_bw_util": hbm / (span * self.peak_hbm),
+            "ici_bw_util": ici / (span * self.peak_ici),
             "prefill_tps": ptok / span,
             "decode_tps": dtok / span,
         }
@@ -363,6 +444,8 @@ class PerfAccountant:
             rates = self._window_rates(now)
             return {
                 **rates,
+                "chips": self.n_chips,
+                "collective_bytes": dict(self._collective),
                 "hbm_bytes_used": self._hbm["used"],
                 "hbm_bytes_total": self._hbm["total"],
                 "hbm_bytes_peak": self._hbm["peak"],
@@ -377,15 +460,38 @@ class PerfAccountant:
         now = time.monotonic()
         with self._lock:
             rates = self._window_rates(now)
+            # per-axis roofline breakdown: achieved window rate against
+            # each ceiling, side by side, so /debug/perf shows WHICH wall
+            # a multi-chip engine is against (flop/hbm aggregate over the
+            # mesh; ici per chip — see __init__)
+            rooflines = {
+                "flop": {"peak_per_s": self.peak_flops,
+                         "achieved_per_s": rates["mfu"] * self.peak_flops,
+                         "utilization": rates["mfu"]},
+                "hbm": {"peak_per_s": self.peak_hbm,
+                        "achieved_per_s": (rates["hbm_bw_util"]
+                                           * self.peak_hbm),
+                        "utilization": rates["hbm_bw_util"]},
+                "ici": {"peak_per_s": self.peak_ici,
+                        "achieved_per_s": (rates["ici_bw_util"]
+                                           * self.peak_ici),
+                        "utilization": rates["ici_bw_util"]},
+            }
             return {
                 "enabled": True,
                 "window_seconds": self.window,
+                "chips": self.n_chips,
+                "tensor_parallel": self.tp,
                 "peaks": {"flops": self.peak_flops,
-                          "hbm_bytes_per_s": self.peak_hbm},
+                          "hbm_bytes_per_s": self.peak_hbm,
+                          "ici_bytes_per_s": self.peak_ici},
                 "model": {"param_count": self.param_count,
                           "param_bytes": self.param_bytes},
                 "model_flops_utilization": rates["mfu"],
                 "hbm_bandwidth_utilization": rates["hbm_bw_util"],
+                "ici_bandwidth_utilization": rates["ici_bw_util"],
+                "rooflines": rooflines,
+                "collective_bytes_total": dict(self._collective),
                 "tokens_per_second": {"prefill": rates["prefill_tps"],
                                       "decode": rates["decode_tps"]},
                 "hbm_bytes": dict(self._hbm),

@@ -2606,6 +2606,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "bf16 roofline from docs/roofline.md (197)")
     p.add_argument("--perf-peak-hbm-gbps", type=float, default=0.0,
                    help="accelerator peak HBM GB/s; 0 = v5e (819)")
+    p.add_argument("--perf-peak-ici-gbps", type=float, default=0.0,
+                   help="per-chip ICI GB/s for the collective roofline "
+                        "(multi-chip meshes); 0 = v5e (200)")
     p.add_argument("--platform", default=None,
                    help="force the JAX platform (e.g. 'cpu' for a "
                         "no-TPU dev/CI engine; env PSTPU_PLATFORM). Must be "
@@ -2698,6 +2701,8 @@ def config_from_args(args) -> EngineConfig:
         cfg.perf.peak_tflops = args.perf_peak_tflops
     if getattr(args, "perf_peak_hbm_gbps", 0.0):
         cfg.perf.peak_hbm_gbps = args.perf_peak_hbm_gbps
+    if getattr(args, "perf_peak_ici_gbps", 0.0):
+        cfg.perf.peak_ici_gbps = args.perf_peak_ici_gbps
     cfg.seed = args.seed
     return cfg
 

@@ -84,6 +84,18 @@ def rules_for_model(cfg, mesh: Mesh) -> ShardingRules:
     return ShardingRules(rules=base)
 
 
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement — the multi-chip contract for the ragged
+    dispatch's small host-built step inputs (packed token stream, span
+    offsets, block tables, verify columns, sampling params) and for its
+    fetched result leaves. Only the weights and the paged KV pool are
+    partitioned (KV heads over the ``tensor`` axis); everything the
+    controller writes or reads each step is whole on every chip, so
+    ``jax.device_get`` is a local host copy and no per-step cross-chip
+    gather rides the host path (see engine/model_runner.py)."""
+    return NamedSharding(mesh, P())
+
+
 def logical_to_sharding(
     logical_axes: Tuple[Optional[str], ...],
     mesh: Mesh,

@@ -68,6 +68,8 @@ def _engine_row(ep, probe: dict, estats, rstats, reasons: dict,
         "warming": status == "warming",
         "watchdog_stalled": status == "stalled",
         "mfu": perf.get("model_flops_utilization"),
+        "ici": perf.get("ici_bandwidth_utilization"),
+        "chips": perf.get("chips"),
         "hbm_used_bytes": hbm.get("used"),
         "hbm_total_bytes": hbm.get("total"),
         "kv_usage": kv_usage,

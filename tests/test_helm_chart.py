@@ -539,8 +539,11 @@ def test_multihost_spec_gets_no_keda_scaledobject():
     vals["autoscaling"] = {"enabled": True}
     objs = render_objects(HELM, vals)
     assert not [o for o in objs if o.get("kind") == "ScaledObject"]
-    # a normal (non-multihost) spec still gets one
-    vals["servingEngineSpec"]["modelSpec"][0]["multihost"]["enabled"] = False
+    # a normal (non-multihost) spec still gets one (TP drops back to the
+    # single-pod chips so the render-time divisibility check passes)
+    spec = vals["servingEngineSpec"]["modelSpec"][0]
+    spec["multihost"]["enabled"] = False
+    spec["engineConfig"]["tensorParallelSize"] = 8
     objs = render_objects(HELM, vals)
     assert [o for o in objs if o.get("kind") == "ScaledObject"]
 
@@ -779,6 +782,7 @@ def test_perf_slo_values_render_flags():
                 "tensorParallelSize": 1,
                 "perfAccounting": False, "perfAccountingWindow": 120,
                 "perfPeakTflops": 275, "perfPeakHbmGbps": 1200,
+                "perfPeakIciGbps": 250,
             },
         }]},
     })
@@ -794,7 +798,8 @@ def test_perf_slo_values_render_flags():
     assert "--no-perf-accounting" in eargs
     for flag, value in (("--perf-window", "120"),
                         ("--perf-peak-tflops", "275"),
-                        ("--perf-peak-hbm-gbps", "1200")):
+                        ("--perf-peak-hbm-gbps", "1200"),
+                        ("--perf-peak-ici-gbps", "250")):
         assert eargs[eargs.index(flag) + 1] == value
 
     # defaults: objectives of 0 render no SLO flags (tracker off) and
@@ -809,6 +814,46 @@ def test_perf_slo_values_render_flags():
     assert eargs[eargs.index("--perf-window") + 1] == "60"
     assert "--perf-peak-tflops" not in eargs
     assert "--perf-peak-hbm-gbps" not in eargs
+    assert "--perf-peak-ici-gbps" not in eargs
+
+
+def test_tensor_parallel_must_divide_tpu_chips():
+    """The engine builds its tensor mesh axis over the pod's own chips,
+    so tensorParallelSize must divide the per-pod google.com/tpu request
+    (docs/roofline.md "Multi-chip"); the chart fails the RENDER instead
+    of shipping a pod that crashes at mesh construction."""
+    import copy
+
+    import pytest
+
+    vals = {"servingEngineSpec": {"modelSpec": [{
+        "name": "tp4", "modelRef": "llama-3-8b",
+        "engineConfig": {"maxModelLen": 2048, "maxNumSeqs": 8,
+                         "dtype": "bfloat16", "tensorParallelSize": 4},
+        "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x4",
+                "chips": 8},
+    }]}}
+    # 4 | 8: renders, and the flag pin survives alongside the TPU request
+    eng = engine_deployments(render_objects(HELM, vals))[0]
+    args = container_args(eng)
+    assert args[args.index("--tensor-parallel-size") + 1] == "4"
+    c = eng["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"] == "8"
+
+    for bad_tp in (3, 16):  # non-divisor, and TP wider than the pod
+        bad = copy.deepcopy(vals)
+        bad["servingEngineSpec"]["modelSpec"][0]["engineConfig"][
+            "tensorParallelSize"] = bad_tp
+        with pytest.raises(Exception, match="tensorParallelSize"):
+            render_objects(HELM, bad)
+
+    # a CPU/CI spec (no tpu block) skips the check — there is no chips
+    # request for TP to divide (the kind tier runs TP=1 on host devices)
+    cpu = copy.deepcopy(vals)
+    del cpu["servingEngineSpec"]["modelSpec"][0]["tpu"]
+    cpu["servingEngineSpec"]["modelSpec"][0]["engineConfig"][
+        "tensorParallelSize"] = 3
+    assert engine_deployments(render_objects(HELM, cpu))
 
 
 def test_drain_lifecycle_contract():
@@ -921,6 +966,9 @@ def test_perf_slo_dashboard():
         "vllm:diagnostic_bundles_dropped_total",
         "vllm:incidents_open",
         "vllm:diagnostic_capture_seconds_bucket",
+        # multi-chip / ICI row
+        "vllm:ici_bandwidth_utilization",
+        "vllm:collective_bytes_total",
     ):
         assert metric in text, f"perf-slo dashboard missing {metric}"
     assert dash["uid"] == "tpu-perf-slo"

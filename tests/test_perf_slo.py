@@ -97,7 +97,7 @@ def test_perf_accountant_window_trim_keeps_totals():
 def test_perf_accountant_empty_window_rates_are_zero():
     acc = make_accountant()
     assert acc._window_rates(0.0) == {
-        "mfu": 0.0, "hbm_bw_util": 0.0,
+        "mfu": 0.0, "hbm_bw_util": 0.0, "ici_bw_util": 0.0,
         "prefill_tps": 0.0, "decode_tps": 0.0,
     }
 

@@ -320,8 +320,8 @@ def test_record_ragged_mixed_split():
     acc.record_ragged(prefill_tokens=10, prefill_ctx=30, prefill_rows=2,
                       decode_seqs=4, decode_ctx=40, ts=100.0)
     assert len(acc._events) == 2
-    (_, p_phase, p_flops, p_hbm, p_tok), (_, d_phase, d_flops, d_hbm,
-                                          d_tok) = acc._events
+    (_, p_phase, p_flops, p_hbm, p_tok, _), (_, d_phase, d_flops, d_hbm,
+                                             d_tok, _) = acc._events
     assert (p_phase, d_phase) == ("prefill", "decode")
     assert p_flops == pytest.approx(2 * 1000 * 10 + 64 * 10 * 15)
     assert p_hbm == pytest.approx(2000 + (10 + 30) * 32)
@@ -339,7 +339,7 @@ def test_record_ragged_decode_only_pays_weights():
     acc = _accountant()
     acc.record_ragged(0, 0, 0, decode_seqs=4, decode_ctx=40, ts=100.0)
     assert len(acc._events) == 1
-    _, phase, _, hbm, _ = acc._events[0]
+    _, phase, _, hbm, _, _ = acc._events[0]
     assert phase == "decode"
     assert hbm == pytest.approx(2000 + (40 + 4) * 32)
 

@@ -319,6 +319,41 @@ def test_diagnostics_surface_is_inside_the_gates():
     assert "routerSpec.diagnostics" in router_tmpl
 
 
+def test_multichip_surface_is_inside_the_gates():
+    """The multi-chip surface (PR: sharded ragged dispatch + ICI
+    roofline) is covered by the gates, not grandfathered: config-drift
+    sees --tensor-parallel-size / --perf-peak-ici-gbps as declared
+    engine CLI flags (an engineConfig.tensorParallelSize template typo
+    would be an active finding), and metric-hygiene tracks the ICI
+    metric families as both defined in code and documented — so
+    renaming one, or deleting its docs row or dashboard panel, fails
+    test_repo_has_no_active_findings."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    engine_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "engine" / "server.py")
+    assert {"--tensor-parallel-size", "--perf-peak-ici-gbps"} <= engine_flags
+
+    # the counter is exposed as vllm:collective_bytes_total; the gate
+    # pins the base family name (exposition adds the _total suffix)
+    ici = {"vllm:ici_bandwidth_utilization", "vllm:collective_bytes"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert ici <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert ici <= documented
+
+    # the chart's per-model TP knob and ICI peak override must stay
+    # consumed by the engine deployment template (the values-consumed
+    # gate keys off their presence in values.yaml)
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert "tensorParallelSize:" in values and "perfPeakIciGbps:" in values
+    engine_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-engine.yaml").read_text()
+    assert "tensorParallelSize" in engine_tmpl
+    assert "perfPeakIciGbps" in engine_tmpl
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

@@ -444,6 +444,7 @@ FUNCTIONS = {
     "gt": lambda r, d, v, a, b: a > b,
     "int": lambda r, d, v, x: int(x or 0),
     "add": lambda r, d, v, *a: sum(int(x or 0) for x in a),
+    "mod": lambda r, d, v, a, b: int(a or 0) % int(b or 1),
     "toString": lambda r, d, v, x: to_string(x),
     "toJson": lambda r, d, v, x: __import__("json").dumps(x),
     "b64enc": lambda r, d, v, s:

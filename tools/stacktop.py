@@ -1,9 +1,9 @@
 """stacktop — a terminal fleet view over the router's ``/debug/fleet``.
 
-One row per engine (status, MFU, HBM, KV free, queue depth, QPS, TTFT,
-open incidents) plus the router's SLO / scale / incident summary — the
-``top``-alike for a serving fleet.  Pure stdlib so it runs from any
-operator box with nothing installed::
+One row per engine (status, chip count, per-chip MFU/ICI utilization,
+HBM, KV free, queue depth, QPS, TTFT, open incidents) plus the router's
+SLO / scale / incident summary — the ``top``-alike for a serving fleet.
+Pure stdlib so it runs from any operator box with nothing installed::
 
     python -m tools.stacktop --router http://localhost:8001
     python -m tools.stacktop --router http://localhost:8001 --watch 5
@@ -20,10 +20,14 @@ import sys
 import time
 import urllib.request
 
+# MFU/ICI are per-chip-honest utilizations (the engine's accountant
+# scales its FLOP/HBM ceilings by CHIPS and counts per-chip collective
+# bytes against the per-chip ICI link peak), so a TP=8 engine and a
+# single-chip one compare directly in the same table.
 COLUMNS = (
-    ("ENGINE", 28), ("MODEL", 14), ("STATUS", 10), ("MFU", 6),
-    ("HBM", 12), ("KVFREE", 7), ("WAIT", 5), ("RUN", 5),
-    ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
+    ("ENGINE", 28), ("MODEL", 14), ("STATUS", 10), ("CHIPS", 5),
+    ("MFU", 6), ("ICI", 6), ("HBM", 12), ("KVFREE", 7), ("WAIT", 5),
+    ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
 )
 
 
@@ -56,7 +60,9 @@ def engine_row_cells(row: dict) -> list:
         row.get("url", "-"),
         ",".join(row.get("models") or []) or "-",
         row.get("status", "-"),
+        _fmt_num(row.get("chips"), "d"),
         _fmt_pct(row.get("mfu")),
+        _fmt_pct(row.get("ici")),
         _fmt_hbm(row.get("hbm_used_bytes"), row.get("hbm_total_bytes")),
         _fmt_pct(row.get("kv_free")),
         _fmt_num(row.get("waiting"), "d"),
