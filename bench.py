@@ -12,10 +12,14 @@ independent):
      conversations — prefix-cache hit rate and the TTFT improvement the
      KV reuse buys (the reference's multi-round-qa win, its README's
      headline scenario).
-  4. mixed steady-state chat, 5. speculative decoding, and
+  4. mixed steady-state chat, 5. speculative decoding,
   6. multi-chip TP: the ragged dispatch sharded across the named mesh at
      TP=4/8 — tok/s/chip, greedy bit-identity vs single-chip, zero
-     post-warmup recompiles, and the ICI roofline utilization.
+     post-warmup recompiles, and the ICI roofline utilization, and
+  7. disaggregated prefill/decode: the same streamed requests through
+     the orchestrated router over a 1-prefill + 1-decode pool vs one
+     unified engine — TTFT/ITL p50/p95, the P→D transfer cost per
+     request, and greedy bit-identity of every stream pair.
 
 Prints ONE JSON line (driver contract): the headline metric/value/unit/
 vs_baseline plus the scenario numbers as extra keys.
@@ -364,6 +368,163 @@ def run_bench() -> None:
         row["greedy_identical"] = out_tp == mc_base_out
         mc_runs.append(row)
 
+    # 7) disaggregated prefill/decode vs unified: the SAME streamed
+    # greedy requests twice through the real router — once over a
+    # 1-prefill + 1-decode pool (orchestrated two-hop: first token from
+    # the prefill engine, KV pushed to /kv/recv, decode spliced in with
+    # no re-prefill), once over one unified engine (same router in the
+    # path, so the delta is disaggregation, not proxy overhead).
+    # Reports TTFT and ITL p50/p95 per side, the wire cost of the
+    # handoff (seconds and MB per request from the prefill engine's
+    # transfer accounting — the same numbers /debug/perf kv_transfer
+    # serves), the router's per-outcome disagg counters, and greedy
+    # bit-identity of every stream pair. bf16 for the same
+    # argmax-near-tie reason as scenarios 5/6.
+    import asyncio
+
+    dis_n = 8 if on_tpu else 4
+    dis_out = 64 if on_tpu else 8
+    dis_reps = 4 if on_tpu else 3
+    dis_prompts = [f"request {i}: " + "lorem ipsum dolor sit amet " * dis_reps
+                   for i in range(dis_n)]
+
+    async def _sse_events(resp):
+        buf = b""
+        async for chunk in resp.content.iter_any():
+            buf += chunk
+            while b"\n\n" in buf:
+                block, buf = buf.split(b"\n\n", 1)
+                if block.startswith(b"data: "):
+                    data = block[len(b"data: "):]
+                    if data == b"[DONE]":
+                        return
+                    yield json.loads(data), time.perf_counter()
+
+    async def disagg_vs_unified():
+        import aiohttp
+        from aiohttp.test_utils import TestServer
+
+        from production_stack_tpu.engine.server import EngineServer
+        from production_stack_tpu.router.app import RouterApp, build_parser
+        from production_stack_tpu.router.metrics import disagg_snapshot
+
+        def mk_server(role):
+            scfg = EngineConfig(
+                model=dataclasses.replace(cfg.model, quant=None),
+                cache=CacheConfig(block_size=16, num_blocks=512),
+                scheduler=dataclasses.replace(
+                    cfg.scheduler, max_num_seqs=max(dis_n, 4),
+                    max_num_batched_tokens=256, prefill_buckets=(256,)),
+                mesh=MeshConfig(data=1, tensor=1),
+                role=role,
+            )
+            return EngineServer(scfg)
+
+        async def start_stack(roles, extra_router_args):
+            servers = [mk_server(r) for r in roles]
+            sites = []
+            urls = []
+            for es in servers:
+                ts = TestServer(es.build_app())
+                await ts.start_server()
+                sites.append(ts)
+                urls.append(f"http://127.0.0.1:{ts.port}")
+            args = build_parser().parse_args([
+                "--service-discovery", "static",
+                "--static-backends", ",".join(urls),
+                "--static-models", ",".join([model] * len(urls)),
+            ] + extra_router_args)
+            router_ts = TestServer(RouterApp(args).build_app())
+            await router_ts.start_server()
+            return servers, sites, router_ts
+
+        async def one_request(session, base, text, timings=None):
+            payload = {"model": model, "prompt": text,
+                       "max_tokens": dis_out, "temperature": 0,
+                       "ignore_eos": True, "stream": True}
+            t0 = time.perf_counter()
+            out, usage, stamps = "", None, []
+            async with session.post(f"{base}/v1/completions",
+                                    json=payload) as r:
+                assert r.status == 200, await r.text()
+                async for ev, t in _sse_events(r):
+                    if ev.get("choices"):
+                        out += ev["choices"][0]["text"]
+                        stamps.append(t)
+                    if ev.get("usage"):
+                        usage = ev["usage"]
+            if timings is not None and stamps:
+                timings["ttft"].append((stamps[0] - t0) * 1000.0)
+                timings["gaps"].extend(
+                    (b - a) * 1000.0 for a, b in zip(stamps, stamps[1:]))
+            return out, usage
+
+        async def measure(base):
+            async with aiohttp.ClientSession() as session:
+                # out-of-band warmup request compiles both sides' programs
+                await one_request(session, base, "warmup " * dis_reps)
+                timings = {"ttft": [], "gaps": []}
+                results = await asyncio.gather(*[
+                    one_request(session, base, p, timings)
+                    for p in dis_prompts])
+            texts = [r[0] for r in results]
+            usages = [r[1] for r in results]
+            return {
+                "ttft_p50_ms": round(pctl(timings["ttft"], 50), 1),
+                "ttft_p95_ms": round(pctl(timings["ttft"], 95), 1),
+                "itl_p50_ms": round(pctl(timings["gaps"], 50), 2),
+                "itl_p95_ms": round(pctl(timings["gaps"], 95), 2),
+            }, texts, usages
+
+        out0 = disagg_snapshot()
+        servers, sites, router_ts = await start_stack(
+            ["prefill", "decode"],
+            ["--static-backend-roles", "prefill,decode",
+             "--routing-logic", "disaggregated_prefill_orchestrated"])
+        try:
+            d_lat, d_texts, d_usages = await measure(
+                f"http://127.0.0.1:{router_ts.port}")
+            push = dict(servers[0].metrics.transfer_totals.get("push") or {})
+            spliced = servers[1].engine.stats().get("spliced_seqs_total", 0)
+        finally:
+            await router_ts.close()
+            for ts in sites:
+                await ts.close()
+        outcomes = {k: v - out0.get(k, 0)
+                    for k, v in disagg_snapshot().items()
+                    if v - out0.get(k, 0)}
+
+        servers, sites, router_ts = await start_stack(
+            ["unified"], ["--routing-logic", "roundrobin"])
+        try:
+            u_lat, u_texts, u_usages = await measure(
+                f"http://127.0.0.1:{router_ts.port}")
+        finally:
+            await router_ts.close()
+            for ts in sites:
+                await ts.close()
+
+        pushes = max(push.get("count", 0), 1)
+        return {
+            "requests": dis_n,
+            "out_len": dis_out,
+            "disagg": d_lat,
+            "unified": u_lat,
+            "transfer": {
+                "pushes": push.get("count", 0),
+                "seconds_per_request": round(
+                    push.get("seconds", 0.0) / pushes, 4),
+                "mb_per_request": round(
+                    push.get("bytes", 0) / pushes / 1e6, 3),
+            },
+            "spliced_seqs": spliced,
+            "outcomes": outcomes,
+            "greedy_identical": d_texts == u_texts,
+            "usage_identical": d_usages == u_usages,
+        }
+
+    disagg_row = asyncio.run(disagg_vs_unified())
+
     target = 2000.0
     print(json.dumps({
         "metric": f"output throughput ({model}, {quant or 'bf16'}, "
@@ -422,6 +583,7 @@ def run_bench() -> None:
             "out_len": mc_out,
             "runs": mc_runs,
         },
+        "disagg": disagg_row,
     }))
 
 

@@ -28,6 +28,9 @@ model: {{ .spec.name }}
 {{- if .spec.modelLabel }}
 model-label: {{ .spec.modelLabel }}
 {{- end }}
+{{- if .role }}
+stack/role: {{ .role }}
+{{- end }}
 {{- end -}}
 
 {{/* TPU resources: chips request + node selection by accelerator/topology */}}

@@ -224,6 +224,36 @@ class AsyncEngine:
             raise
         return [self._consume(rid, q) for rid, q in qs.items()]
 
+    async def attach_spliced(
+        self,
+        request_id: str,
+        prompt_token_ids: Seq[int],
+        first_token: int,
+        sampling: SamplingParams,
+        blocks: list[int],
+        adapter_slot: int = 0,
+    ) -> AsyncIterator[RequestOutput]:
+        """Splice a pushed P→D transfer in as a decode-ready sequence
+        (engine.splice_request) and return its output stream. Mirrors
+        admit_batch: the stream is registered before the engine-thread
+        splice so no output is dropped, and any failure (no decode slot,
+        bad lengths, cancellation) deregisters the stream and re-raises —
+        block ownership stays with the caller on failure."""
+        q: asyncio.Queue = asyncio.Queue()
+        self.streams[request_id] = q
+
+        def do_splice(eng):
+            eng.splice_request(request_id, list(prompt_token_ids),
+                               first_token, sampling, blocks,
+                               adapter_slot=adapter_slot)
+
+        try:
+            await self.run_on_engine(do_splice)
+        except BaseException:
+            self.streams.pop(request_id, None)
+            raise
+        return self._consume(request_id, q)
+
     async def _consume(
         self, rid: str, q: asyncio.Queue
     ) -> AsyncIterator[RequestOutput]:

@@ -536,6 +536,21 @@ class EngineConfig:
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     perf: PerfConfig = dataclasses.field(default_factory=PerfConfig)
+    # disaggregated serving role (docs/architecture.md "Disaggregated
+    # prefill/decode"): "prefill" engines run requests to first token and
+    # push the paged KV to the chosen decode engine; "decode" engines
+    # accept POST /kv/recv transfers and splice the sequence in
+    # decode-ready; "unified" does both phases locally (the default)
+    role: str = "unified"  # "unified" | "prefill" | "decode"
+    # P→D transfer tuning (engine/kv_transfer.py): layer-group size
+    # (0 = half the stack), producer-side in-flight gather window, and
+    # digest-mismatch/connection retries per push
+    kv_transfer_group_layers: int = 0
+    kv_transfer_window: int = 2
+    kv_transfer_retries: int = 3
+    # seconds an un-attached /kv/recv transfer may hold pool blocks
+    # before the sweep reclaims them (leaked-transfer backstop)
+    kv_transfer_ttl: float = 120.0
     # attention dispatch shape: "ragged" packs prefill chunks and decode
     # rows into ONE token stream per step (token-budget scheduling, a
     # single steady-state compile signature — ops/

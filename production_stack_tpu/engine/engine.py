@@ -204,6 +204,7 @@ class LLMEngine:
         self.spec_steps = 0  # spec row-steps (one per verified span)
         self.spec_step_tokens = 0  # tokens those row-steps emitted
         self.aborted_seqs = 0  # cancelled/expired, KV freed early
+        self.spliced_seqs = 0  # pushed P→D transfers attached decode-ready
         # unified ragged dispatch accounting (attention_impl == "ragged"):
         # live packed tokens vs the always-budget-wide stream is the
         # padding-waste signal the bucketed path hid in bucket geometry
@@ -1296,6 +1297,66 @@ class LLMEngine:
     def abort_kv_import(self, local_blocks: list[int]) -> None:
         self.scheduler.allocator.free_blocks(local_blocks)
 
+    # -- pushed transfers (decode role: POST /kv/recv lands frames here,
+    #    then the request with the matching transfer_id splices in) --------
+    def begin_kv_receive(self, n_blocks: int):
+        """Reserve ``n_blocks`` fresh pool blocks for a pushed transfer —
+        unlike ``begin_kv_import`` this takes the producer's FULL block
+        list (the trailing partial block too): the blocks become a live
+        sequence's table, not content-addressed cache, so the
+        leave-one-token-uncached rule does not apply. Returns block ids
+        or None when the pool can't cover it (producer falls back to
+        leaving pull params)."""
+        if n_blocks <= 0:
+            return None
+        return self.scheduler.allocator.take_free_blocks(n_blocks)
+
+    def splice_request(
+        self,
+        request_id: str,
+        prompt_token_ids: list[int],
+        first_token: int,
+        sampling: "SamplingParams",
+        blocks: list[int],
+        adapter_slot: int = 0,
+    ) -> Sequence:
+        """Engine-thread: turn a completed P→D transfer into a RUNNING
+        decode row. The sequence enters with the prompt fully computed
+        and the prefill-produced first token already in its output, so
+        the ragged scheduler treats it as decode-ready — no re-prefill.
+        ``sampling.max_tokens`` counts the WHOLE completion including the
+        pre-loaded first token (``_check_stop`` compares against
+        ``len(output_token_ids)``). On failure the caller still owns the
+        blocks; on success the normal finish/abort paths release them."""
+        if len(blocks) * self.config.cache.block_size < len(prompt_token_ids):
+            raise ValueError("spliced blocks do not cover the prompt")
+        sampling = sampling.clamped(
+            self.config.model.max_model_len, len(prompt_token_ids)
+        )
+        if sampling.seed is None:
+            sampling = dataclasses.replace(
+                sampling, seed=int.from_bytes(os.urandom(4), "little"),
+            )
+        from production_stack_tpu.engine.sampling import make_token_controls
+
+        seq = Sequence(request_id, list(prompt_token_ids), sampling,
+                       adapter_slot=adapter_slot,
+                       token_ctrl=make_token_controls(
+                           sampling, self.config.model.vocab_size))
+        seq.output_token_ids = [int(first_token)]
+        seq.num_computed_tokens = len(prompt_token_ids)
+        seq.num_cached_tokens = len(prompt_token_ids)
+        seq.block_ids = list(blocks)
+        self.scheduler.splice(seq)
+        self._slot_seq[seq.slot] = seq
+        if sampling.presence_penalty or sampling.frequency_penalty:
+            # the pre-loaded first token must count toward penalties just
+            # as if this engine had prefilled it
+            self._count_reset_slots.append(seq)
+        self.total_prompt_tokens += len(prompt_token_ids)
+        self.spliced_seqs += 1
+        return seq
+
     def _check_stop(self, seq: Sequence, token: int) -> Optional[SequenceStatus]:
         s = seq.sampling
         if not s.ignore_eos and self.tokenizer.eos_id is not None and token == self.tokenizer.eos_id:
@@ -1336,6 +1397,7 @@ class LLMEngine:
                 if self.spec_steps else 0.0
             ),
             "aborted_seqs_total": self.aborted_seqs,
+            "spliced_seqs_total": self.spliced_seqs,
             # per-step occupancy / KV-pool utilization (observability layer)
             "batch_occupancy": (self.scheduler.num_running
                                 / max(1, self.config.scheduler.max_num_seqs)),

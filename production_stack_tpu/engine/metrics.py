@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import TYPE_CHECKING
 
-from prometheus_client import CollectorRegistry, Histogram
+from prometheus_client import CollectorRegistry, Counter, Histogram
 from prometheus_client.core import (
     CounterMetricFamily,
     GaugeMetricFamily,
@@ -114,6 +114,12 @@ class EngineStatsCollector:
             "Sequences aborted (client disconnect / deadline expiry); "
             "KV blocks freed before natural completion",
             s.get("aborted_seqs_total", 0),
+        )
+        yield counter(
+            "vllm:spliced_seqs",
+            "Pushed P→D transfers attached as decode-ready sequences "
+            "(disaggregated serving: each one is a skipped re-prefill)",
+            s.get("spliced_seqs_total", 0),
         )
         yield counter(
             "vllm:prompt_tokens", "Cumulative prompt tokens", s["prompt_tokens_total"]
@@ -413,6 +419,30 @@ class ServerMetrics:
             (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5, 5.0),
         )
+        # disaggregated P→D KV handoff (engine/kv_transfer.py): wire bytes
+        # and wall time per transfer, labelled by which side this engine
+        # played (push = prefill streaming out, recv = decode landing it,
+        # export = pull-served /kv/export, import = pull-side /kv/export
+        # consumption)
+        self.kv_transfer_bytes = Counter(
+            "vllm:kv_transfer_bytes",
+            "KV bytes moved between engines for disaggregated serving, "
+            "by direction (push/recv/export/import)",
+            ["model_name", "direction"],
+            registry=self.registry,
+        )
+        self.kv_transfer_seconds = Histogram(
+            "vllm:kv_transfer_seconds",
+            "Wall time of one KV transfer leg (gather + wire + scatter, "
+            "overlapped), by direction",
+            ["model_name", "direction"],
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0, 60.0, 120.0),
+            registry=self.registry,
+        )
+        #: per-direction {bytes, seconds, count} mirror of the transfer
+        #: counters, for JSON debug surfaces
+        self.transfer_totals: dict = {}
 
     def register_lifecycle(self, source) -> None:
         """Attach the drain/watchdog snapshot source (EngineServer
@@ -463,6 +493,19 @@ class ServerMetrics:
             if out.num_output_tokens > 1:
                 self.itl.labels(lv).observe(decode /
                                             (out.num_output_tokens - 1))
+
+    def observe_transfer(self, direction: str, nbytes: int,
+                         seconds: float) -> None:
+        self.kv_transfer_bytes.labels(self.model_name, direction).inc(nbytes)
+        self.kv_transfer_seconds.labels(self.model_name,
+                                        direction).observe(seconds)
+        # plain-dict mirror for /debug/perf and /debug/fleet (a labeled
+        # Counter can only be read back via a scrape)
+        t = self.transfer_totals.setdefault(
+            direction, {"bytes": 0, "seconds": 0.0, "count": 0})
+        t["bytes"] += nbytes
+        t["seconds"] += seconds
+        t["count"] += 1
 
     def observe_step(self, duration: float) -> None:
         self.step_duration.labels(self.model_name).observe(duration)

@@ -211,6 +211,25 @@ class Scheduler:
             if self.admission_hook is not None:
                 self.admission_hook(seq)
 
+    def splice(self, seq: Sequence) -> None:
+        """Register a decode-ready sequence that was prefilled ELSEWHERE
+        (disagg P→D handoff): its KV blocks were landed by /kv/recv, its
+        first token is already in ``output_token_ids`` and
+        ``num_computed_tokens`` covers the whole prompt, so
+        ``prefill_done`` holds and ``_schedule_unified``/``_grow_decodes``
+        pick it up as a decode row on the next step — no pass through the
+        waiting queue, no re-prefill. The caller owns the blocks until
+        this returns; afterwards the normal finish/abort paths release
+        them. Raises ``SchedulerQueueFull`` when no decode slot is free
+        (the server degrades to the re-prefill path)."""
+        if not self.free_slots:
+            raise SchedulerQueueFull("no decode slot free for spliced seq")
+        seq.slot = self.free_slots.pop()
+        seq.status = SequenceStatus.RUNNING
+        if seq.admit_time is None:
+            seq.admit_time = time.monotonic()
+        self.seqs[seq.request_id] = seq
+
     # -- the per-step decision ----------------------------------------------
     def schedule(self) -> SchedulerOutput:
         out = SchedulerOutput()

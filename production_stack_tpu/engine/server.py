@@ -226,6 +226,11 @@ class EngineServer:
                                     otel_secure)
         self.flight_recorder = FlightRecorder(flight_recorder_size)
         self._inflight: dict = {}  # root rid → open flight record
+        # pushed P→D transfers awaiting their decode hop: transfer id →
+        # {blocks, layers_done, meta, created, ready}. Blocks are owned by
+        # this table until the attach splices them into a sequence (then
+        # the scheduler owns them) or the TTL sweep frees them.
+        self._kv_transfers: dict = {}
         # Retry-After seconds advertised on overload 429s; the router's
         # circuit breaker uses it as the ejection cooldown
         self.overload_retry_after = overload_retry_after
@@ -339,6 +344,7 @@ class EngineServer:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_post("/kv/lookup", self.kv_lookup)
         app.router.add_post("/kv/export", self.kv_export)
+        app.router.add_post("/kv/recv", self.kv_recv)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/score", self.score)
         app.router.add_post("/v1/rerank", self.rerank)
@@ -589,6 +595,7 @@ class EngineServer:
                 "parent": None,
                 "max_model_len": self.config.model.max_model_len,
                 "capabilities": list(ENGINE_CAPABILITIES),
+                "role": getattr(self.config, "role", "unified"),
             }
         ]
         for name in self.lora.list_adapters():
@@ -1327,6 +1334,180 @@ class EngineServer:
                     lambda eng: eng.abort_kv_import(local)
                 )
 
+    # -- streamed P→D handoff, receive side (disaggregated decode) ----------
+    def _sweep_kv_transfers(self) -> None:
+        """Free KV blocks held by transfers whose decode hop never came
+        (router died between push and continuation): past the TTL the
+        blocks go back to the pool — a leaked transfer must never pin
+        pages forever. Runs lazily on every /kv/recv and attach."""
+        ttl = getattr(self.config, "kv_transfer_ttl", 120.0)
+        now = time.monotonic()
+        for tid in list(self._kv_transfers):
+            st = self._kv_transfers.get(tid)
+            if st is None or now - st["created"] <= ttl:
+                continue
+            self._kv_transfers.pop(tid, None)
+            blocks = st["blocks"]
+            _log.warning("kv transfer %s expired unattached; freeing "
+                         "%d blocks", tid, len(blocks))
+            asyncio.ensure_future(self.async_engine.run_on_engine(
+                lambda eng, b=blocks: eng.scheduler.allocator.free_blocks(b)
+            ))
+
+    async def kv_recv(self, request: web.Request) -> web.Response:
+        """Receiver for a PUSHED prefill→decode transfer (the body is the
+        kv_transfer.py frame stream: one JSON meta prologue frame, then
+        CRC-tailed layer-group frames). Blocks land straight into free
+        pages of the paged pool; the later decode hop attaches them via
+        ``kv_transfer_params.transfer_id`` and splices the sequence in
+        decode-ready. A digest mismatch or dropped connection answers 409
+        {"resume_layer": n} so the producer resends only the unlanded
+        groups."""
+        import zlib
+
+        from production_stack_tpu.engine.kv_transfer import (
+            FRAME_CRC,
+            FRAME_HEADER,
+            FrameDigestError,
+            consume_frames,
+        )
+
+        self._sweep_kv_transfers()
+        tid = request.headers.get("X-KV-Transfer-Id") or ""
+        try:
+            shape = tuple(
+                int(x) for x in request.headers["X-KV-Shape"].split(","))
+            dtype = request.headers["X-KV-Dtype"]
+            group = max(1, int(request.headers["X-KV-Group-Layers"]))
+            start_layer = int(request.headers.get("X-KV-Start-Layer", "0"))
+        except (KeyError, ValueError):
+            return web.json_response(
+                {"error": {"message": "missing/invalid X-KV-* headers"}},
+                status=400,
+            )
+        if not tid or len(shape) != 5:
+            return web.json_response(
+                {"error": {"message": "X-KV-Transfer-Id and a 5-dim "
+                           "X-KV-Shape are required"}}, status=400)
+        state = self._kv_transfers.get(tid)
+        resume_at = state["layers_done"] if state else 0
+
+        content = request.content
+        try:  # meta prologue frame (transfer id, prompt ids, first token)
+            head = await content.readexactly(FRAME_HEADER.size)
+            (nbytes,) = FRAME_HEADER.unpack(head)
+            payload = await content.readexactly(nbytes)
+            (crc,) = FRAME_CRC.unpack(
+                await content.readexactly(FRAME_CRC.size))
+            if zlib.crc32(payload) != crc:
+                return web.json_response({"resume_layer": resume_at},
+                                         status=409)
+            meta = json.loads(payload)
+        except (asyncio.IncompleteReadError, ValueError):
+            return web.json_response({"resume_layer": resume_at}, status=409)
+
+        if state is None:
+            if start_layer != 0:
+                # resume for a transfer we never saw (e.g. swept): restart
+                return web.json_response({"resume_layer": 0}, status=409)
+            blocks = await self.async_engine.run_on_engine(
+                lambda eng: eng.begin_kv_receive(int(shape[1]))
+            )
+            if blocks is None:
+                return web.json_response(
+                    {"error": {"message": "KV pool cannot hold the "
+                               "transfer right now"}}, status=503)
+            state = {"blocks": blocks, "layers_done": 0, "meta": meta,
+                     "created": time.monotonic(), "ready": False}
+            self._kv_transfers[tid] = state
+        elif start_layer != state["layers_done"]:
+            # the producer's idea of progress disagrees with ours
+            # (connection-error retry restarts at 0): re-anchor it
+            return web.json_response(
+                {"resume_layer": state["layers_done"]}, status=409)
+
+        def on_group(lo: int, n: int) -> None:
+            state["layers_done"] = lo + n
+
+        t0 = time.monotonic()
+        try:
+            landed = await consume_frames(
+                content, self.async_engine.run_on_engine, state["blocks"],
+                shape, dtype, group, start_layer=start_layer,
+                on_group=on_group,
+            )
+        except FrameDigestError:
+            return web.json_response(
+                {"resume_layer": state["layers_done"]}, status=409)
+        except (asyncio.IncompleteReadError, ValueError,
+                ConnectionResetError):
+            # dropped mid-body / short stream: keep the landed groups for
+            # the retry (the TTL sweep reclaims them if none comes)
+            return web.json_response(
+                {"resume_layer": state["layers_done"]}, status=409)
+        state["ready"] = True
+        import numpy as np
+
+        itemsize = 2 if dtype == "bfloat16" else np.dtype(dtype).itemsize
+        per_layer = itemsize
+        for d in shape[1:]:
+            per_layer *= int(d)
+        self.metrics.observe_transfer("recv", landed * per_layer,
+                                      time.monotonic() - t0)
+        return web.json_response({"ok": True, "transfer_id": tid,
+                                  "layers": int(shape[0])})
+
+    async def _push_kv_blocks(self, push_url: str, transfer_id: str,
+                              blocks: list, prompt_ids: list,
+                              first_token: int) -> bool:
+        """Producer side of the streamed handoff: pin the finished
+        prefill's blocks (serving steps interleave with the gathers — an
+        eviction mid-push would tear the transfer) and stream them to the
+        decode engine's /kv/recv. Best-effort: on failure the decode hop
+        falls back to pulling /kv/export or plain re-prefill."""
+        import aiohttp
+
+        from production_stack_tpu.engine.kv_transfer import push_kv
+
+        cfg = self.config
+        shape = (cfg.model.num_layers, len(blocks), cfg.cache.block_size,
+                 2 * cfg.model.num_kv_heads, cfg.model.head_dim)
+        dtype = str(cfg.model.dtype)
+        meta = {"transfer_id": transfer_id,
+                "prompt_token_ids": [int(t) for t in prompt_ids],
+                "first_token": int(first_token)}
+        t0 = time.monotonic()
+        await self.async_engine.run_on_engine(
+            lambda eng: eng.scheduler.allocator.pin_blocks(blocks)
+        )
+        try:
+            async with aiohttp.ClientSession() as s:
+                await push_kv(
+                    s, push_url, self.async_engine.run_on_engine, blocks,
+                    shape, dtype, meta,
+                    group=getattr(cfg, "kv_transfer_group_layers", 0) or None,
+                    window=getattr(cfg, "kv_transfer_window", 2),
+                    retries=getattr(cfg, "kv_transfer_retries", 3),
+                    timeout=getattr(cfg, "kv_transfer_ttl", 120.0),
+                )
+        except Exception as e:
+            _log.warning("kv push %s -> %s failed: %s",
+                         transfer_id, push_url, e)
+            return False
+        finally:
+            await self.async_engine.run_on_engine(
+                lambda eng: eng.scheduler.allocator.free_blocks(blocks)
+            )
+        import numpy as np
+
+        itemsize = 2 if dtype == "bfloat16" else np.dtype(dtype).itemsize
+        nbytes = itemsize
+        for d in shape:
+            nbytes *= int(d)
+        self.metrics.observe_transfer("push", nbytes,
+                                      time.monotonic() - t0)
+        return True
+
     async def detokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
         return web.json_response({"prompt": self.engine.tokenizer.decode(body.get("tokens") or [])})
@@ -1441,8 +1622,14 @@ class EngineServer:
         picture — the always-on counterpart to the profiler endpoints
         above."""
         perf = getattr(self.engine, "perf", None)
+        kv_block = {
+            "role": getattr(self.config, "role", "unified"),
+            "pending_transfers": len(self._kv_transfers),
+            "transfers": self.metrics.transfer_totals,
+        }
         if perf is None:
-            return web.json_response({"enabled": False})
+            return web.json_response({"enabled": False,
+                                      "kv_transfer": kv_block})
         snap = perf.snapshot()
         eng = self.engine
         drafted = getattr(eng, "spec_drafted", 0)
@@ -1458,6 +1645,7 @@ class EngineServer:
                 getattr(eng, "spec_step_tokens", 0) / steps if steps else 0.0
             ),
         }
+        snap["kv_transfer"] = kv_block
         return web.json_response(snap)
 
     async def memory_profile(self, request: web.Request) -> web.Response:
@@ -1896,6 +2084,19 @@ class EngineServer:
         produce_kv = False
         kv_params = body.get("kv_transfer_params") or {}
         if nchoices == 1:  # disagg handoff is defined per single request
+            if kv_params.get("transfer_id") and not kv_params.get(
+                    "do_remote_decode"):
+                # decode hop of a PUSHED transfer: splice it in
+                # decode-ready (no re-prefill). None → not attachable
+                # (unknown/incomplete/swept id, no slot, guided params):
+                # fall through to the pull import / plain admission of the
+                # continuation body — bit-identical greedy either way.
+                resp = await self._try_attach_spliced(
+                    request, body, kv_params["transfer_id"], sampling,
+                    rid, created, model, chat, stream, t_start, deadline,
+                )
+                if resp is not None:
+                    return resp
             if kv_params.get("remote_block_ids"):
                 await self._maybe_import_kv(body, prompt_ids_list[0])
             produce_kv = bool(kv_params.get("do_remote_decode"))
@@ -1967,9 +2168,16 @@ class EngineServer:
                 continuous_usage=bool(so.get("continuous_usage_stats")),
                 deadline=deadline,
             )
+        kv_push = None
+        if (produce_kv and kv_params.get("push_url")
+                and kv_params.get("transfer_id")):
+            kv_push = {"push_url": kv_params["push_url"],
+                       "transfer_id": kv_params["transfer_id"],
+                       "prompt_ids": prompt_ids_list[0]}
         return await self._full_response(
             gens, rids, rid, created, model, chat, t_start, n_prompt, sampling,
-            produce_kv=produce_kv, echo_info=echo_info, deadline=deadline,
+            produce_kv=produce_kv, kv_push=kv_push, echo_info=echo_info,
+            deadline=deadline,
         )
 
     def _overloaded(self, msg: str) -> web.Response:
@@ -2002,9 +2210,60 @@ class EngineServer:
                 cut = idx
         return None if cut is None else text[:cut]
 
+    async def _try_attach_spliced(self, request, body, tid, sampling, rid,
+                                  created, model, chat, stream, t_start,
+                                  deadline):
+        """Attach a pushed transfer as a decode-ready sequence and serve
+        its stream. The continuation body's max_tokens excludes the first
+        token (the router already relayed it from the prefill stream), but
+        the spliced sequence PRELOADS that token in output_token_ids —
+        the engine's length stop counts it, so the splice runs with
+        max_tokens + 1 to generate the same remaining span the re-prefill
+        fallback would. Returns None when not attachable."""
+        self._sweep_kv_transfers()
+        state = self._kv_transfers.get(tid)
+        if state is None or not state.get("ready"):
+            return None
+        if sampling.guided_regex or sampling.guided_json:
+            # grammar state is built during normal admission; let the
+            # re-prefill fallback carry guided continuations
+            return None
+        from production_stack_tpu.engine.scheduler import SchedulerQueueFull
+
+        meta = state["meta"]
+        splice_sampling = dataclasses.replace(
+            sampling, max_tokens=sampling.max_tokens + 1)
+        try:
+            gen = await self.async_engine.attach_spliced(
+                rid, meta["prompt_token_ids"], meta["first_token"],
+                splice_sampling, state["blocks"],
+            )
+        except (SchedulerQueueFull, ValueError) as e:
+            _log.warning("kv transfer %s attach failed (%s); falling back "
+                         "to re-prefill", tid, e)
+            return None
+        # the scheduler owns the blocks now; drop the registry entry so
+        # the TTL sweep can never free pages under a live sequence
+        self._kv_transfers.pop(tid, None)
+        n_prompt = len(meta["prompt_token_ids"]) + 1
+        if stream:
+            so = body.get("stream_options")
+            so = so if isinstance(so, dict) else {}
+            return await self._stream_response(
+                request, [gen], [rid], rid, created, model, chat, t_start,
+                n_prompt, sampling,
+                include_usage=bool(so.get("include_usage")),
+                continuous_usage=bool(so.get("continuous_usage_stats")),
+                deadline=deadline,
+            )
+        return await self._full_response(
+            [gen], [rid], rid, created, model, chat, t_start, n_prompt,
+            sampling, deadline=deadline,
+        )
+
     async def _full_response(self, gens, rids, rid, created, model, chat,
                              t_start, n_prompt, sampling,
-                             produce_kv=False,
+                             produce_kv=False, kv_push=None,
                              echo_info=None, deadline=None) -> web.Response:
         tk = self.engine.tokenizer
 
@@ -2144,6 +2403,16 @@ class EngineServer:
                 "remote_host": None,
                 "remote_port": None,
             }
+            if kv_push is not None and results[0][6]:
+                # streamed push to the chosen decode engine; the pull
+                # fields above stay as the fallback if the push dies
+                pushed = await self._push_kv_blocks(
+                    kv_push["push_url"], kv_push["transfer_id"],
+                    final_blocks, kv_push["prompt_ids"], results[0][6][0],
+                )
+                payload["kv_transfer_params"]["transfer_id"] = \
+                    kv_push["transfer_id"]
+                payload["kv_transfer_params"]["pushed"] = pushed
         return web.json_response(payload)
 
     async def _echo_score_response(self, prompt_ids_list, sampling, rid,
@@ -2620,6 +2889,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host-DRAM KV tier capacity (0 = off)")
     p.add_argument("--remote-kv-url", default=None,
                    help="shared remote KV server URL (kv_server)")
+    # -- disaggregated prefill/decode (engine/kv_transfer.py) ------------
+    p.add_argument("--role", default="unified",
+                   choices=["unified", "prefill", "decode"],
+                   help="engine role in a disaggregated deployment: "
+                        "'prefill' runs prompts to first token and "
+                        "streams the KV to a decode engine (POST "
+                        "{decode}/kv/recv), 'decode' accepts pushed "
+                        "transfers and splices them in decode-ready, "
+                        "'unified' (default) does both in one pool. "
+                        "Advisory for routing: every role still serves "
+                        "the full OpenAI surface, so a degraded fleet "
+                        "can fall back to unified serving")
+    p.add_argument("--kv-transfer-group-layers", type=int, default=0,
+                   help="layers per KV-transfer frame (pipelined "
+                        "gather/send/scatter granularity); 0 = half the "
+                        "layer stack (kv_transfer.default_group)")
+    p.add_argument("--kv-transfer-window", type=int, default=2,
+                   help="producer-side in-flight device gathers ahead of "
+                        "the frame being sent (bounded pipeline depth)")
+    p.add_argument("--kv-transfer-retries", type=int, default=3,
+                   help="push attempts per transfer; digest-mismatch "
+                        "retries resume from the first unacknowledged "
+                        "layer group instead of resending the transfer")
+    p.add_argument("--kv-transfer-ttl", type=float, default=120.0,
+                   help="seconds a received-but-unattached transfer may "
+                        "hold KV blocks on the decode engine before the "
+                        "sweep frees them (covers a router that died "
+                        "between the push and the decode hop)")
     # -- multi-host serving (replaces the reference's KubeRay + Ray
     # executor: helm/templates/ray-cluster.yaml:332-335,716-717 there).
     # Defaults come from env (PSTPU_COORDINATOR / PSTPU_NUM_PROCESSES /
@@ -2688,6 +2985,12 @@ def config_from_args(args) -> EngineConfig:
         cfg.cache.host_offload_blocks = args.host_offload_blocks
     if args.remote_kv_url:
         cfg.cache.remote_kv_url = args.remote_kv_url
+    cfg.role = getattr(args, "role", "unified") or "unified"
+    cfg.kv_transfer_group_layers = getattr(
+        args, "kv_transfer_group_layers", 0) or 0
+    cfg.kv_transfer_window = getattr(args, "kv_transfer_window", 2) or 2
+    cfg.kv_transfer_retries = getattr(args, "kv_transfer_retries", 3) or 3
+    cfg.kv_transfer_ttl = getattr(args, "kv_transfer_ttl", 120.0) or 120.0
     cfg.mesh = MeshConfig(
         data=args.data_parallel_size, stage=args.pipeline_parallel_size,
         seq=args.sequence_parallel_size, tensor=args.tensor_parallel_size,

@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated model name per backend")
     p.add_argument("--static-model-labels", default="",
                    help="comma-separated label per backend (prefill/decode/...)")
+    p.add_argument("--static-backend-roles", default="",
+                   help="comma-separated disaggregation role per backend "
+                        "(prefill|decode|unified, empty = unified), "
+                        "aligned with --static-backends; the K8s "
+                        "equivalent is the stack/role pod label")
     p.add_argument("--static-model-types", default="",
                    help="comma-separated model type per backend (the "
                         "reference flag: chat|completion|embeddings|rerank|"
@@ -342,6 +347,9 @@ class RouterApp:
                 if args.static_model_types else []
             if types and len(types) == 1 and len(urls) > 1:
                 types = types * len(urls)
+            roles = [r.strip() for r in
+                     (args.static_backend_roles or "").split(",")] \
+                if args.static_backend_roles else None
             initialize_service_discovery(
                 StaticServiceDiscovery(
                     urls, models, labels,
@@ -351,6 +359,7 @@ class RouterApp:
                         args.health_check_failure_threshold),
                     query_models=args.static_query_models,
                     model_types=types or None,
+                    roles=roles,
                 )
             )
         elif args.service_discovery in ("k8s_pod_ip", "k8s_service_name"):

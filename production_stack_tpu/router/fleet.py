@@ -63,6 +63,8 @@ def _engine_row(ep, probe: dict, estats, rstats, reasons: dict,
         "url": ep.url,
         "models": list(ep.model_names),
         "label": ep.model_label,
+        "role": ep.role,
+        "kv_transfer": perf.get("kv_transfer"),
         "status": status,
         "draining": ep.draining,
         "warming": status == "warming",
@@ -124,6 +126,8 @@ async def fleet_snapshot(session) -> dict:
     ]
     tracker = current_slo_tracker()
     advisor = current_scale_advisor()
+    from production_stack_tpu.router import metrics as m
+
     return {
         "ts": time.time(),
         "engines": engines,
@@ -132,6 +136,7 @@ async def fleet_snapshot(session) -> dict:
             "scale": advisor.snapshot() if advisor is not None else None,
             "incidents": (incidents.snapshot() if incidents is not None
                           else {"open": 0, "incidents": []}),
+            "disagg": m.disagg_snapshot(),
         },
     }
 

@@ -94,6 +94,14 @@ stream_resumes_total = Counter(
     "budget_exhausted=retry budget refused the replay)",
     ["outcome"],
 )
+disagg_requests_total = Counter(
+    "vllm:disagg_requests",
+    "Requests through the orchestrated prefill/decode split, by outcome "
+    "(ok=prefilled and decoded on separate engines, replayed=decode "
+    "engine replaced mid-stream, unified_fallback=one engine served the "
+    "whole request, failed=no avenue left, error sent)",
+    ["outcome"],
+)
 # SLO engine (router/slo.py): multi-window burn rates per objective
 slo_burn_rate = Gauge(
     "vllm:slo_burn_rate",
@@ -241,6 +249,18 @@ def refresh_scale_gauges(advisor) -> None:
     if dh > 0:
         autoscaler_replica_hours_total.inc(dh)
         _last_replica_hours = snap["replica_hours"]
+
+
+def disagg_snapshot() -> dict[str, int]:
+    """Current per-outcome totals of vllm:disagg_requests, for the JSON
+    debug surfaces (/debug/fleet, stacktop) — Counters only re-surface
+    through collect()."""
+    out: dict[str, int] = {}
+    for metric in disagg_requests_total.collect():
+        for s in metric.samples:
+            if s.name.endswith("_total"):
+                out[s.labels.get("outcome", "")] = int(s.value)
+    return out
 
 
 def observe_warmup(seconds: float) -> None:

@@ -26,6 +26,11 @@ class EndpointInfo:
     model_names: list[str] = dataclasses.field(default_factory=list)
     model_info: dict[str, ModelInfo] = dataclasses.field(default_factory=dict)
     model_label: Optional[str] = None  # pod label, e.g. "prefill"/"decode"
+    # disaggregation role from the `stack/role` pod label or the static
+    # --static-backend-roles flag: "prefill" | "decode" | None (unified).
+    # Falls back to model_label for pool membership so pre-role
+    # deployments keep working unchanged.
+    role: Optional[str] = None
     pod_name: Optional[str] = None
     namespace: Optional[str] = None
     added_timestamp: float = dataclasses.field(default_factory=time.time)

@@ -33,6 +33,7 @@ from production_stack_tpu.router.resilience import (
 from production_stack_tpu.router.routing import (
     DisaggregatedPrefillOrchestratedRouter,
     breaker_filter,
+    drop_draining,
     get_routing_logic,
 )
 from production_stack_tpu.router.service_discovery import get_service_discovery
@@ -186,11 +187,14 @@ class RequestService:
         eps = [e for e in eps if e.serves(model) and not e.sleep]
         # draining endpoints (engine shutting down, watchdog-stalled, or
         # pod stamped with a deletionTimestamp) keep their live streams
-        # but take no NEW requests — unless EVERY backend is draining
-        # (single-replica rollout): then keep the full list, because a
-        # draining engine still answers an honest 503 + Retry-After that
-        # failover and clients can act on (docs/resilience.md)
-        return [e for e in eps if not e.draining] or eps
+        # but take no NEW requests — unless their whole ROLE pool is
+        # draining (single-replica rollout): then they stay listed,
+        # because a draining engine still answers an honest 503 +
+        # Retry-After that failover and clients can act on
+        # (docs/resilience.md). Role-scoped so a fully-draining decode
+        # pool can't re-enter next to healthy prefill engines
+        # (routing.drop_draining).
+        return drop_draining(eps)
 
     def resolve_model(self, model: str) -> str:
         return self.model_aliases.get(model, model)
@@ -938,15 +942,36 @@ class RequestService:
         """Single client call; router drives prefill then decode. KV moves
         prefill→decode out-of-band, keyed by kv_transfer_params (our engines
         implement the transfer in engine/kv_transfer.py; the reference
-        delegates to NIXL/LMCache)."""
+        delegates to NIXL/LMCache). Two shapes:
+
+        - streamed + resume-capable: the prefill hop runs buffered with
+          max_tokens=1 and a push directive; the prefill engine streams its
+          paged KV blocks straight into the decode engine's /kv/recv while
+          the router relays the first token as synthesized SSE. The decode
+          hop is then a continuation attempt (PR-7 resume machinery) that
+          the decode engine satisfies by splicing the pushed blocks, by
+          pulling from the prefill engine, or by re-prefilling the
+          continuation prompt — bit-identical under greedy sampling either
+          way. A decode death mid-stream replays on another decode backend.
+        - everything else: the buffered pull flow (prefill returns block
+          handles; decode pulls via /kv/export before admission).
+        """
         engine_stats = get_engine_stats_scraper().get_engine_stats()
         request_stats = get_request_stats_monitor().get_request_stats()
+        model = body.get("model", "")
+        resume = self._resume_state(endpoint_path, body, None)
+        if resume is not None:
+            return await self._disagg_streamed(
+                request, endpoint_path, body, endpoints, router, request_id,
+                t_start, resume, engine_stats, request_stats, model)
+
         prefill_url, decode_url = await router.select_pair(
             endpoints, engine_stats, request_stats, dict(request.headers), body
         )
         if prefill_url is None:
+            m.disagg_requests_total.labels(outcome="unified_fallback").inc()
             return await self._proxy_and_stream(
-                request, endpoint_path, body, decode_url, body.get("model", ""),
+                request, endpoint_path, body, decode_url, model,
                 request_id, t_start,
             )
 
@@ -968,7 +993,7 @@ class RequestService:
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
         monitor.on_new_request(prefill_url, request_id, time.time(),
-                               model=body.get("model", ""))
+                               model=model)
         try:
             async with self.session.post(
                 f"{prefill_url}{endpoint_path}", json=prefill_body, headers=headers
@@ -976,6 +1001,18 @@ class RequestService:
                 pre_data = await pre.json()
                 if pre.status != 200:
                     raise BackendError("prefill", f"HTTP {pre.status}: {pre_data}")
+        except (aiohttp.ClientError, asyncio.TimeoutError, BackendError) as e:
+            # the whole prompt is still in hand: serve unified off the
+            # decode engine rather than failing the request
+            logger.warning("prefill hop to %s failed for request %s (%s); "
+                           "serving unified", prefill_url, request_id, e)
+            m.request_errors_total.labels(
+                server=prefill_url, model=model, error_type="prefill").inc()
+            m.disagg_requests_total.labels(outcome="unified_fallback").inc()
+            return await self._proxy_and_stream(
+                request, endpoint_path, body, decode_url, model,
+                request_id, t_start,
+            )
         finally:
             monitor.on_request_complete(prefill_url, request_id, time.time())
 
@@ -988,10 +1025,276 @@ class RequestService:
             "Routing request %s: prefill=%s decode=%s", request_id, prefill_url,
             decode_url,
         )
-        return await self._proxy_and_stream(
+        resp = await self._proxy_and_stream(
             request, endpoint_path, decode_body, decode_url,
-            body.get("model", ""), request_id, t_start,
+            model, request_id, t_start,
         )
+        m.disagg_requests_total.labels(
+            outcome="ok" if resp.status < 400 else "failed").inc()
+        return resp
+
+    async def _disagg_streamed(
+        self, request, endpoint_path, body, endpoints, router, request_id,
+        t_start, resume: "_ResumeState", engine_stats, request_stats,
+        model: str,
+    ) -> web.StreamResponse:
+        """Streamed orchestrated disaggregation with a pushed KV handoff.
+
+        Prefill hop: buffered, max_tokens=1, carrying a push directive
+        {push_url, transfer_id} so the prefill engine streams KV into the
+        chosen decode engine's /kv/recv before responding; fails over
+        across the prefill pool, and degrades to a unified single-engine
+        request when the pool is gone. First token: relayed to the client
+        as synthesized SSE events (stamped with the prefill response's
+        id, folded into the resume accumulator). Decode hop: a
+        continuation attempt against the decode pool — the transfer_id
+        lets the decode engine splice the pushed blocks and skip
+        re-prefill; remote_block_ids/remote_host are the pull fallback;
+        the continuation prompt itself is the re-prefill fallback. All
+        three produce the same greedy completion."""
+        res = self.resilience
+        monitor = get_request_stats_monitor()
+        deadline = self._request_deadline(request, t_start)
+        res.budget.on_request()
+        m.retry_budget_remaining.set(res.budget.remaining())
+        headers = sanitize_headers(request.headers)
+        headers["x-request-id"] = request_id
+        if deadline is not None:
+            headers["x-request-deadline"] = f"{deadline:.3f}"
+        transfer_id = str(uuid.uuid4())
+        attempts = 1 + max(self.max_failover_attempts, 0)
+
+        # ---- prefill hop, with failover across the prefill pool --------
+        pre_data = None
+        prefill_url: Optional[str] = None
+        decode_url: Optional[str] = None
+        p_failed: set[str] = set()
+        last_error: Optional[str] = None
+        for attempt in range(attempts):
+            if attempt > 0:
+                if deadline is not None and time.time() >= deadline:
+                    break
+                if not res.budget.try_acquire():
+                    break
+                m.retry_budget_remaining.set(res.budget.remaining())
+            avail = [e for e in endpoints if e.url not in p_failed]
+            p_url, d_url = await router.select_pair(
+                breaker_filter(avail), engine_stats, request_stats,
+                dict(request.headers), body)
+            decode_url = d_url
+            if p_url is None:
+                break  # no (surviving) prefill pool → serve unified
+            prefill_body = dict(body)
+            prefill_body.update({
+                "max_tokens": 1, "max_completion_tokens": 1, "stream": False,
+                "kv_transfer_params": {
+                    "do_remote_decode": True,
+                    "do_remote_prefill": False,
+                    "push_url": d_url,
+                    "transfer_id": transfer_id,
+                    "remote_engine_id": None,
+                    "remote_block_ids": None,
+                    "remote_host": None,
+                    "remote_port": None,
+                },
+            })
+            res.breaker.on_attempt_start(p_url)
+            monitor.on_new_request(p_url, request_id, time.time(),
+                                   model=model)
+            _record_attempt(request.get("flight_record")
+                            if hasattr(request, "get") else None,
+                            p_url, t_start)
+            try:
+                async with self.session.post(
+                    f"{p_url}{endpoint_path}", json=prefill_body,
+                    headers=headers,
+                ) as pre:
+                    if pre.status != 200:
+                        text = await pre.text()
+                        raise BackendError(
+                            "prefill", f"HTTP {pre.status}: {text[:200]}")
+                    pre_data = await pre.json()
+                res.breaker.record_success(p_url, time.time() - t_start)
+                prefill_url = p_url
+                break
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                last_error = f"{type(e).__name__}: {e}"
+                kind = "connect"
+            except BackendError as e:
+                last_error = str(e)
+                kind = e.kind
+            finally:
+                monitor.on_request_complete(p_url, request_id, time.time())
+            p_failed.add(p_url)
+            res.breaker.record_failure(p_url, kind)
+            m.request_errors_total.labels(
+                server=p_url, model=model, error_type=kind).inc()
+            logger.warning("prefill hop to %s failed for request %s (%s)",
+                           p_url, request_id, last_error)
+
+        if pre_data is None:
+            # prefill pool empty or exhausted: one engine serves the whole
+            # request (resume still armed — mid-stream deaths replay)
+            m.disagg_requests_total.labels(outcome="unified_fallback").inc()
+            url = decode_url or await router.route_request(
+                breaker_filter(endpoints), engine_stats, request_stats,
+                dict(request.headers), body)
+            try:
+                return await self._proxy_and_stream(
+                    request, endpoint_path, body, url, model, request_id,
+                    t_start, deadline=deadline, resume=resume)
+            except StreamInterrupted as e:
+                return await self._fail_resumed_stream(
+                    resume, str(e), "failed", url=url, model=model)
+            except BackendError as e:
+                return web.json_response(
+                    {"error": {"message": f"all backends failed: {e}"}},
+                    status=503)
+
+        # ---- relay the first token from the prefill response ------------
+        kv_params = pre_data.get("kv_transfer_params") or {}
+        if not kv_params.get("remote_host"):
+            kv_params["remote_host"] = prefill_url
+        logger.info(
+            "Routing request %s: prefill=%s decode=%s transfer=%s pushed=%s",
+            request_id, prefill_url, decode_url, transfer_id,
+            kv_params.get("pushed"),
+        )
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "x-request-id": request_id},
+        )
+        await resp.prepare(request)
+        resume.resp = resp
+        usage = pre_data.get("usage") or {}
+        if isinstance(usage.get("prompt_tokens"), int):
+            resume.prompt_tokens = usage["prompt_tokens"]
+        for ev in _synth_first_events(pre_data, resume.chat):
+            resume.observe(ev)
+            await resp.write(ev + b"\n\n")
+
+        finish = (pre_data.get("choices") or [{}])[0].get("finish_reason")
+        requested = next((body[k] for k in ("max_tokens",
+                                            "max_completion_tokens")
+                          if isinstance(body.get(k), int)), None)
+        if finish == "stop" or requested == 1:
+            # the first token finished the completion (EOS, or the client
+            # only asked for one token): no decode hop to run
+            await self._finish_synth_stream(resp, pre_data, resume, body)
+            m.disagg_requests_total.labels(outcome="ok").inc()
+            return resp
+
+        # ---- decode hop: continuation attempts over the decode pool ----
+        decode_body = dict(body)
+        decode_body["kv_transfer_params"] = {
+            "do_remote_prefill": True,
+            "transfer_id": transfer_id,
+            "remote_engine_id": kv_params.get("remote_engine_id"),
+            "remote_block_ids": kv_params.get("remote_block_ids"),
+            "remote_host": kv_params.get("remote_host"),
+            "remote_port": kv_params.get("remote_port"),
+        }
+        d_failed: set[str] = set()
+        give_up = "failed"
+        url: Optional[str] = None
+        for attempt in range(attempts):
+            if attempt > 0:
+                if deadline is not None and time.time() >= deadline:
+                    last_error = ("deadline exceeded during failover: "
+                                  f"{last_error}")
+                    give_up = "deadline"
+                    break
+                if not res.budget.try_acquire():
+                    logger.warning("retry budget exhausted; shedding retry "
+                                   "of request %s", request_id)
+                    give_up = "budget_exhausted"
+                    break
+                m.retry_budget_remaining.set(res.budget.remaining())
+            _, decode_pool = router.find_pools(endpoints)
+            # prefer surviving decode engines; a drained decode pool falls
+            # back to ANY engine (incl. prefill) — the continuation prompt
+            # makes the request servable anywhere
+            avail = [e for e in decode_pool if e.url not in d_failed] \
+                or [e for e in endpoints if e.url not in d_failed]
+            if not avail:
+                break
+            if decode_url is not None and decode_url not in d_failed \
+                    and any(e.url == decode_url for e in avail):
+                # the KV was pushed there — splice affinity beats load
+                # balance (any other pick re-prefills and strands the
+                # transfer until the decode engine's TTL sweep)
+                url = decode_url
+            else:
+                url = await router.route_request(
+                    breaker_filter(avail), engine_stats, request_stats,
+                    dict(request.headers), body)
+            res.breaker.on_attempt_start(url)
+            try:
+                out = await self._proxy_and_stream(
+                    request, endpoint_path, decode_body, url, model,
+                    request_id, t_start, deadline=deadline, resume=resume)
+                m.disagg_requests_total.labels(
+                    outcome="replayed" if resume.resumed > 1 else "ok").inc()
+                if resume.resumed > 1:
+                    # the by-design first continuation isn't a resume; only
+                    # mid-stream replacements count as such
+                    m.stream_resumes_total.labels(outcome="resumed").inc(
+                        resume.resumed - 1)
+                return out
+            except StreamInterrupted as e:
+                last_error = str(e)
+                d_failed.add(url)
+                m.request_errors_total.labels(
+                    server=url, model=model, error_type="stream_abort").inc()
+                logger.warning(
+                    "decode backend %s died mid-stream for request %s after "
+                    "%d token(s) (%s); resuming from generated prefix", url,
+                    request_id, e.state.completion_tokens(), e)
+            except BackendError as e:
+                last_error = str(e)
+                d_failed.add(url)
+                res.breaker.record_failure(url, e.kind,
+                                           retry_after=e.retry_after)
+                m.request_errors_total.labels(
+                    server=url, model=model, error_type=e.kind).inc()
+                logger.warning(
+                    "decode backend %s failed for request %s (%s); "
+                    "rerouting", url, request_id, e)
+        m.disagg_requests_total.labels(outcome="failed").inc()
+        outcome = "failed" if give_up == "deadline" else give_up
+        return await self._fail_resumed_stream(resume, last_error, outcome,
+                                               url=url, model=model)
+
+    async def _finish_synth_stream(self, resp, pre_data: dict,
+                                   resume: "_ResumeState",
+                                   body: dict) -> None:
+        """Close a disagg stream that ended at the first token: finish
+        chunk, the usage chunk if the client asked for one, [DONE]."""
+        rid = pre_data.get("id")
+        created = pre_data.get("created")
+        model = pre_data.get("model")
+        obj = "chat.completion.chunk" if resume.chat else "text_completion"
+        finish = ((pre_data.get("choices") or [{}])[0].get("finish_reason")
+                  or "length")
+        if resume.chat:
+            choice = {"index": 0, "delta": {}, "finish_reason": finish}
+        else:
+            choice = {"index": 0, "text": "", "logprobs": None,
+                      "finish_reason": finish}
+        await resp.write(b"data: " + json.dumps(
+            {"id": rid, "object": obj, "created": created, "model": model,
+             "choices": [choice]}).encode() + b"\n\n")
+        so = body.get("stream_options")
+        if isinstance(so, dict) and so.get("include_usage") \
+                and pre_data.get("usage"):
+            await resp.write(b"data: " + json.dumps(
+                {"id": rid, "object": obj, "created": created,
+                 "model": model, "choices": [],
+                 "usage": pre_data["usage"]}).encode() + b"\n\n")
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
 
     # -- sleep / wake proxying (reference: request.py:1027-1114) -------------
     async def sleep_wake(self, request: web.Request, action: str) -> web.Response:
@@ -1060,6 +1363,12 @@ class _ResumeState:
         #: cumulative completion_tokens reported by the current attempt's
         #: per-chunk usage (continuous_usage_stats), None until seen
         self.attempt_tokens: Optional[int] = None
+        #: ORIGINAL prompt token count, when known up front (disaggregated
+        #: prefill learns it from the prefill hop's usage). A continuation
+        #: backend reports the prompt + relayed prefix as prompt_tokens;
+        #: with this set, rewrite() restores the client-visible count so
+        #: usage is token-exact against an uninterrupted unified run.
+        self.prompt_tokens: Optional[int] = None
 
     def completion_tokens(self) -> int:
         """Completion tokens relayed so far. One SSE event can carry
@@ -1125,7 +1434,10 @@ class _ResumeState:
         if self.created is not None:
             data["created"] = self.created
         usage = data.get("usage")
-        if isinstance(usage, dict) and self.tokens_base:
+        if isinstance(usage, dict) and (self.tokens_base
+                                        or self.prompt_tokens is not None):
+            if self.prompt_tokens is not None:
+                usage["prompt_tokens"] = self.prompt_tokens
             usage["completion_tokens"] = (
                 (usage.get("completion_tokens") or 0) + self.tokens_base)
             usage["total_tokens"] = (
@@ -1164,6 +1476,36 @@ def _continuation_body(body: dict, state: _ResumeState) -> dict:
         if isinstance(body.get(key), int):
             out[key] = max(1, body[key] - state.completion_tokens())
     return out
+
+
+def _synth_first_events(pre_data: dict, chat: bool) -> list[bytes]:
+    """SSE events recreating what a streaming engine would have sent for
+    the prefill hop's single token: the role-delta opener plus a content
+    delta (chat), or one text chunk (completions). Stamped with the
+    prefill response's id/created — the resume accumulator adopts that
+    id and rewrites every decode-hop event to it, so the client sees one
+    coherent stream."""
+    rid = pre_data.get("id")
+    created = pre_data.get("created")
+    model = pre_data.get("model")
+    choice = (pre_data.get("choices") or [{}])[0]
+    base = {"id": rid,
+            "object": "chat.completion.chunk" if chat else "text_completion",
+            "created": created, "model": model}
+    if chat:
+        text = (choice.get("message") or {}).get("content") or ""
+        events = [
+            {**base, "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                  "finish_reason": None}]},
+            {**base, "choices": [{"index": 0, "delta": {"content": text},
+                                  "finish_reason": None}]},
+        ]
+    else:
+        events = [
+            {**base, "choices": [{"index": 0, "text": choice.get("text") or "",
+                                  "logprobs": None, "finish_reason": None}]},
+        ]
+    return [b"data: " + json.dumps(e).encode() for e in events]
 
 
 def _overload_retry_after(backend) -> Optional[float]:

@@ -227,7 +227,7 @@ class DisaggregatedPrefillRouter(Router):
                             headers, request_json) -> str:
         is_prefill = request_json.get("max_tokens") == 1
         label = self.prefill_label if is_prefill else self.decode_label
-        pool = [e for e in endpoints if e.model_label == label]
+        pool = [e for e in endpoints if (e.role or e.model_label) == label]
         if not pool:
             pool = endpoints  # degrade to colocated serving
         return await self.rr.route_request(
@@ -247,8 +247,10 @@ class DisaggregatedPrefillOrchestratedRouter(Router):
         self._rr_d = RoundRobinRouter()
 
     def find_pools(self, endpoints) -> tuple[list[EndpointInfo], list[EndpointInfo]]:
-        prefill = [e for e in endpoints if e.model_label == self.prefill_label]
-        decode = [e for e in endpoints if e.model_label == self.decode_label]
+        prefill = [e for e in endpoints
+                   if (e.role or e.model_label) == self.prefill_label]
+        decode = [e for e in endpoints
+                  if (e.role or e.model_label) == self.decode_label]
         return prefill, decode
 
     async def select_pair(self, endpoints, engine_stats, request_stats,
@@ -276,21 +278,42 @@ class DisaggregatedPrefillOrchestratedRouter(Router):
         return d
 
 
+def drop_draining(endpoints: list[EndpointInfo]) -> list[EndpointInfo]:
+    """Skip draining endpoints for NEW requests — per ROLE, not globally.
+
+    The old all-draining fallback (`[e for e in eps if not e.draining] or
+    eps`) returned the WHOLE list when every endpoint drained; with
+    role-split pools that let a fully-draining decode pool re-enter the
+    candidate set next to healthy prefill engines and steal prefill
+    traffic. Here, draining endpoints come back only when their role
+    (role, else model_label) has no healthy member left — a homogeneous
+    pool degrades exactly as before (degraded beats unreachable), while a
+    role that still has live capacity never routes to its drainers."""
+    kept = [e for e in endpoints if not e.draining]
+    if not kept:
+        return endpoints
+    live_roles = {(e.role or e.model_label) for e in kept}
+    dead_pool = [e for e in endpoints if e.draining
+                 and (e.role or e.model_label) not in live_roles]
+    return kept + dead_pool
+
+
 def breaker_filter(endpoints: list[EndpointInfo]) -> list[EndpointInfo]:
     """Drop endpoints whose circuit breaker is open before the routing
     logic sees them, so ejected backends stop receiving first attempts.
 
     Draining endpoints (engine shutting down or stuck-step watchdog
     tripped) are dropped the same way: they keep serving their live
-    streams but must not receive first attempts. HALF_OPEN backends stay
-    in the pool only while they have probe slots free; if every endpoint
-    is ejected the full list is returned (degraded beats unreachable —
-    a draining engine at least answers an honest 503). No-op when the
-    resilience layer is not initialized (e.g. unit tests driving a
-    Router directly)."""
+    streams but must not receive first attempts (role-scoped — see
+    :func:`drop_draining`). HALF_OPEN backends stay in the pool only
+    while they have probe slots free; if every endpoint is ejected the
+    full list is returned (degraded beats unreachable — a draining
+    engine at least answers an honest 503). No-op when the resilience
+    layer is not initialized (e.g. unit tests driving a Router
+    directly)."""
     from production_stack_tpu.router.resilience import get_resilience
 
-    endpoints = [e for e in endpoints if not e.draining] or endpoints
+    endpoints = drop_draining(endpoints)
     res = get_resilience()
     if res is None or not endpoints:
         return endpoints

@@ -251,9 +251,18 @@ class ScaleAdvisor:
 
 # -- router glue: build signals from the live monitors -----------------------
 
+# which SLO burn rates may scale a role's pool: prefill capacity fixes
+# queueing/TTFT, decode capacity fixes ITL and KV pressure — wiring the
+# other role's burn in would scale the wrong pool on every incident
+_ROLE_SLOS = {
+    "prefill": ("ttft_p95", "availability"),
+    "decode": ("itl_p95", "availability"),
+}
+
+
 def collect_signals(discovery, engine_stats, tracker,
                     now: Optional[float] = None) -> Dict[str, ScaleSignals]:
-    """Fuse the router's live monitors into per-model ScaleSignals.
+    """Fuse the router's live monitors into per-pool ScaleSignals.
 
     ``discovery`` supplies the replica census (ready vs warming vs
     draining — warming is a ``/ready`` 503 with status "warming", which
@@ -261,13 +270,22 @@ def collect_signals(discovery, engine_stats, tracker,
     queue/KV numbers per backend URL, ``tracker`` the burn rates. A model
     with endpoints but no stats yet still gets a (zero-signal) entry so
     the advisor can hold min_replicas for it.
+
+    Endpoints carrying a disaggregation role split into independent
+    pools keyed ``model/role``, each with its own desired-replica
+    signal: the prefill pool scales on queue depth and TTFT burn (its
+    KV usage is transfer scratch, never a capacity signal), the decode
+    pool on KV pressure and ITL burn. Role-less endpoints keep the bare
+    ``model`` key, so pre-disagg deployments are byte-identical.
     """
     now = now if now is not None else time.time()
     reasons = getattr(discovery, "not_ready_reason", {}) or {}
     out: Dict[str, ScaleSignals] = {}
     for ep in discovery.get_endpoint_info():
         model = ep.model_names[0] if ep.model_names else "unknown"
-        sig = out.setdefault(model, ScaleSignals())
+        role = getattr(ep, "role", None)
+        key = f"{model}/{role}" if role else model
+        sig = out.setdefault(key, ScaleSignals())
         status = reasons.get(ep.url)
         if status == "warming":
             sig.warming += 1
@@ -280,11 +298,16 @@ def collect_signals(discovery, engine_stats, tracker,
         if es is not None:
             sig.waiting += es.num_queuing_requests
             sig.running += es.num_running_requests
-            sig.kv_usage = max(sig.kv_usage, es.gpu_cache_usage_perc)
+            if role != "prefill":
+                sig.kv_usage = max(sig.kv_usage, es.gpu_cache_usage_perc)
     if tracker is not None:
-        for model, sig in out.items():
+        for key, sig in out.items():
+            model, _, role = key.partition("/")
+            allowed = _ROLE_SLOS.get(role)
             worst_fast = worst_slow = 0.0
             for slo in tracker.config.objectives(model):
+                if allowed is not None and slo not in allowed:
+                    continue
                 rates = tracker.burn_rates(model, slo, now)
                 worst_fast = max(worst_fast, pair_burn(rates, FAST_PAIR))
                 worst_slow = max(worst_slow, pair_burn(rates, SLOW_PAIR))

@@ -88,11 +88,30 @@ class StaticServiceDiscovery(ServiceDiscovery):
         query_models: bool = False,
         aliases: Optional[dict[str, str]] = None,
         model_types: Optional[list[Optional[str]]] = None,
+        roles: Optional[list[Optional[str]]] = None,
     ):
         super().__init__()
         self.urls = urls
         self.models = models
         self.model_labels = model_labels or [None] * len(urls)
+        # disaggregation roles, one per backend ("prefill"/"decode";
+        # ""/"unified"/None = unified). Static twin of the `stack/role`
+        # pod label the K8s discoveries read.
+        roles = roles or [None] * len(urls)
+        if len(roles) != len(urls):
+            raise ValueError(
+                f"--static-backend-roles has {len(roles)} entries for "
+                f"{len(urls)} backends (give one per backend)"
+            )
+        self.roles = [
+            (r if r not in ("", "unified") else None) for r in roles
+        ]
+        for r in self.roles:
+            if r not in (None, "prefill", "decode"):
+                raise ValueError(
+                    f"unsupported static backend role {r!r}; supported: "
+                    "prefill, decode, unified"
+                )
         self.health_check = health_check
         self.health_check_interval = health_check_interval
         # flap damping: a single dropped probe (GC pause, transient
@@ -153,6 +172,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     model_names=list(models),
                     model_info={m: ModelInfo(m) for m in models},
                     model_label=self.model_labels[i],
+                    role=self.roles[i],
                     sleep=url in self.sleeping,
                     draining=url in self.draining_urls,
                     capabilities=caps,
@@ -425,6 +445,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         url = f"http://{pod_ip}:{self.port}"
         labels = meta.get("labels", {})
         model_label = labels.get("model")
+        role = labels.get("stack/role") or None
         try:
             models, model_info, caps = await self._query_models(session, url)
             sleeping = await self._query_sleep(session, url)
@@ -437,6 +458,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
             model_names=models,
             model_info=model_info,
             model_label=model_label,
+            role=role,
             pod_name=name,
             namespace=self.namespace,
             sleep=sleeping,
@@ -522,7 +544,8 @@ class K8sServiceNameServiceDiscovery(K8sPodIPServiceDiscovery):
         self.known_models.update(models)
         self.endpoints[name] = EndpointInfo(
             url=url, model_names=models, model_info=model_info,
-            model_label=labels.get("model"), pod_name=name,
+            model_label=labels.get("model"),
+            role=labels.get("stack/role") or None, pod_name=name,
             namespace=self.namespace, sleep=sleeping, capabilities=caps,
         )
         logger.info("engine service %s added at %s serving %s", name, url, models)

@@ -82,13 +82,21 @@ class ChaosFleet:
 
     def __init__(self, n: int, model: str = "fake-model",
                  tokens_per_second: float = 200.0, ttft: float = 0.005,
-                 watchdog_stall_seconds: float = 0.0, **engine_kwargs):
+                 watchdog_stall_seconds: float = 0.0,
+                 roles: "Optional[list[str]]" = None, **engine_kwargs):
+        # roles: per-backend disaggregation role (prefill|decode|unified),
+        # one entry per engine — the fleet shape the disagg chaos drills
+        # use (kill the prefill mid-transfer, kill the decode post-splice)
+        if roles is not None and len(roles) != n:
+            raise ValueError(f"roles has {len(roles)} entries for {n} "
+                             "engines")
         self.engines = [
             FakeEngine(model=model, tokens_per_second=tokens_per_second,
                        ttft=ttft,
                        watchdog_stall_seconds=watchdog_stall_seconds,
+                       role=roles[i] if roles else "unified",
                        **engine_kwargs)
-            for _ in range(n)
+            for i in range(n)
         ]
         self.servers: list[TestServer] = []
         self._session: Optional[aiohttp.ClientSession] = None

@@ -39,8 +39,23 @@ class FakeEngine:
                  faults: Optional[FaultSpec] = None,
                  watchdog_stall_seconds: float = 0.0,
                  tokens_per_chunk: int = 1,
-                 warmup_seconds: float = 0.0):
+                 warmup_seconds: float = 0.0,
+                 role: str = "unified"):
         self.model = model
+        # disaggregation role, mirroring the real engine's --role flag: a
+        # "prefill" fake honors push directives in kv_transfer_params by
+        # streaming real CRC-framed bytes to the decode peer's /kv/recv;
+        # a "decode" fake stores those transfers and attaches them when
+        # the continuation carrying the transfer_id arrives. Chaos drills
+        # kill either end mid-handoff and assert nothing hangs or leaks.
+        self.role = role
+        #: transfers received on /kv/recv, keyed by transfer id, awaiting
+        #: their decode continuation (leak check: must drain to empty)
+        self.kv_transfers: dict[str, dict] = {}
+        self.kv_attached: list[str] = []  # transfer ids spliced into decode
+        self.kv_pushed = 0         # successful pushes (prefill side)
+        self.kv_push_failures = 0  # pushes that died (decode peer gone)
+        self.kv_recv_count = 0     # /kv/recv bodies fully consumed
         self.tps = tokens_per_second
         self.ttft = ttft
         self.max_tokens_default = max_tokens_default
@@ -117,6 +132,7 @@ class FakeEngine:
         app.router.add_post("/sleep", self.sleep)
         app.router.add_post("/wake_up", self.wake)
         app.router.add_post("/kv/lookup", self.kv_lookup)
+        app.router.add_post("/kv/recv", self.kv_recv)
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
@@ -134,6 +150,14 @@ class FakeEngine:
                           "peak": self.hbm_used},
             "tokens_per_second": {"decode": self.tps},
             "compile": {"unexpected_recompiles": 0, "recent": []},
+            "kv_transfer": {
+                "role": self.role,
+                "pending_transfers": len(self.kv_transfers),
+                "transfers": {
+                    "push": {"count": self.kv_pushed},
+                    "recv": {"count": self.kv_recv_count},
+                },
+            },
         }
 
     def _state_snapshot(self) -> dict:
@@ -207,7 +231,8 @@ class FakeEngine:
 
     async def models(self, request):
         card = {"id": self.model, "object": "model",
-                "created": int(self.start), "owned_by": "fake"}
+                "created": int(self.start), "owned_by": "fake",
+                "role": self.role}
         if self.capabilities is not None:
             card["capabilities"] = list(self.capabilities)
         return web.json_response({"object": "list", "data": [card]})
@@ -262,6 +287,89 @@ class FakeEngine:
     async def wake(self, request):
         self.sleeping = False
         return web.json_response({"status": "awake"})
+
+    async def kv_recv(self, request):
+        """Receive a prefill peer's pushed KV (fake decode side): the
+        body is the real wire format (length-framed, crc32-per-frame,
+        zero-length END frame — engine/kv_transfer.py), verified here
+        exactly like the real engine so chaos drills exercise genuine
+        framing. Only the meta prologue is kept; the transfer parks in
+        ``kv_transfers`` until its decode continuation attaches it."""
+        import zlib
+
+        from production_stack_tpu.engine import kv_transfer as kvt
+
+        tid = request.headers.get("X-KV-Transfer-Id") or ""
+        if not tid:
+            return web.json_response(
+                {"error": {"message": "missing X-KV-Transfer-Id"}},
+                status=400)
+        data = await request.read()
+        pos, frames = 0, []
+        while True:
+            if pos + kvt.FRAME_HEADER.size > len(data):
+                return web.json_response(
+                    {"error": {"message": "short stream"}}, status=400)
+            (length,) = kvt.FRAME_HEADER.unpack_from(data, pos)
+            pos += kvt.FRAME_HEADER.size
+            if length == 0:
+                break
+            end = pos + length + kvt.FRAME_CRC.size
+            if end > len(data):
+                return web.json_response(
+                    {"error": {"message": "short stream"}}, status=400)
+            payload = data[pos:pos + length]
+            (crc,) = kvt.FRAME_CRC.unpack_from(data, pos + length)
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                return web.json_response(
+                    {"error": {"message": "frame digest mismatch"}},
+                    status=422)
+            frames.append(payload)
+            pos = end
+        try:
+            meta = json.loads(frames[0].decode()) if frames else {}
+        except ValueError:
+            meta = {}
+        self.kv_transfers[tid] = {
+            "meta": meta, "bytes": sum(len(f) for f in frames[1:])}
+        self.kv_recv_count += 1
+        return web.json_response({"status": "ok", "transfer_id": tid,
+                                  "frames": len(frames)})
+
+    async def _push_kv(self, push_url: str, transfer_id: str,
+                       text: str) -> bool:
+        """Prefill-role handoff: meta prologue + one CRC-framed payload
+        + END, the same framing the real engine's push path emits,
+        POSTed to the decode peer's /kv/recv."""
+        import aiohttp
+
+        from production_stack_tpu.engine import kv_transfer as kvt
+
+        meta = {"transfer_id": transfer_id, "engine_id": self.model,
+                "block_ids": [0, 1], "text": text,
+                "prompt_token_ids": list(range(8))}
+        payload = (text or "fake").encode() * 8
+        content = (kvt.frame(json.dumps(meta).encode())
+                   + kvt.frame(payload) + kvt.END_FRAME)
+        headers = {"X-KV-Transfer-Id": transfer_id,
+                   "X-KV-Shape": json.dumps([1, 2, 1, 1, len(payload)]),
+                   "X-KV-Dtype": "uint8",
+                   "X-KV-Group-Layers": "1",
+                   "X-KV-Start-Layer": "0"}
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        push_url.rstrip("/") + "/kv/recv", data=content,
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                    ok = resp.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            ok = False
+        if ok:
+            self.kv_pushed += 1
+        else:
+            self.kv_push_failures += 1
+        return ok
 
     async def kv_lookup(self, request):
         body = await request.json()
@@ -340,6 +448,15 @@ class FakeEngine:
         body = await request.json()
         n = int(body.get("max_tokens") or self.max_tokens_default)
         stream = bool(body.get("stream", False))
+        kv_params = body.get("kv_transfer_params") or {}
+        tid = kv_params.get("transfer_id")
+        if tid and not kv_params.get("do_remote_decode"):
+            # decode side of a disaggregated pair: the continuation
+            # carrying a transfer_id "attaches" the parked push (the
+            # fake's stand-in for splicing blocks into the scheduler);
+            # a tid left in kv_transfers after a drill is a leak
+            if self.kv_transfers.pop(tid, None) is not None:
+                self.kv_attached.append(tid)
         rid = f"fake-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
         self.running += 1
@@ -360,11 +477,27 @@ class FakeEngine:
                     {"index": 0, "text": text, "finish_reason": "length",
                      "logprobs": None}
                 )
-                return web.json_response(
-                    {"id": rid, "object": "chat.completion" if chat else
-                     "text_completion", "created": created,
-                     "model": self.model, "choices": [choice], "usage": usage}
-                )
+                payload = {"id": rid, "object": "chat.completion" if chat
+                           else "text_completion", "created": created,
+                           "model": self.model, "choices": [choice],
+                           "usage": usage}
+                if kv_params.get("do_remote_decode"):
+                    # prefill side: answer with the handoff descriptor
+                    # (same contract as the real engine's produce_kv
+                    # branch) and push the KV to the decode peer when a
+                    # push destination was routed in
+                    out_kv = {"do_remote_prefill": True,
+                              "do_remote_decode": False,
+                              "remote_engine_id": self.model,
+                              "remote_block_ids": [0, 1],
+                              "remote_host": None, "remote_port": None}
+                    push_url = kv_params.get("push_url")
+                    if push_url and tid:
+                        out_kv["transfer_id"] = tid
+                        out_kv["pushed"] = await self._push_kv(
+                            push_url, tid, text)
+                    payload["kv_transfer_params"] = out_kv
+                return web.json_response(payload)
             so = body.get("stream_options")
             so = so if isinstance(so, dict) else {}
             continuous = bool(so.get("continuous_usage_stats"))
@@ -421,6 +554,10 @@ def main(argv=None):
     p.add_argument("--tokens-per-second", type=float, default=500)
     p.add_argument("--ttft", type=float, default=0.02)
     p.add_argument("--kv-hit-tokens", type=int, default=0)
+    p.add_argument("--role", default="unified",
+                   choices=("prefill", "decode", "unified"),
+                   help="disaggregation role, mirroring the real "
+                        "engine's --role flag")
     p.add_argument("--warmup-seconds", type=float, default=0.0,
                    help="emulate the cold-XLA-compile pre-warm: /ready "
                         "answers 503 {\"status\": \"warming\"} for this "
@@ -439,7 +576,8 @@ def main(argv=None):
     faults = FaultSpec.parse(spec_str) if spec_str else None
     engine = FakeEngine(args.model, args.tokens_per_second, args.ttft,
                         kv_hit_tokens=args.kv_hit_tokens, faults=faults,
-                        warmup_seconds=args.warmup_seconds)
+                        warmup_seconds=args.warmup_seconds,
+                        role=args.role)
     web.run_app(engine.build_app(), host=args.host, port=args.port,
                 access_log=None)
 

@@ -1,0 +1,388 @@
+"""Role-aware routing + streamed P→D handoff over a fake-engine fleet.
+
+The real-engine two-hop e2e lives in test_disagg_prefill.py; these drills
+run the ROUTER's orchestration against testing/fake_engine.py roles —
+prefill fakes honor push directives with real CRC-framed /kv/recv bodies,
+decode fakes park transfers until the continuation attaches them — so the
+failure choreography (kill the prefill mid-handoff, kill the decode after
+the splice) is deterministic and runs tier-1 on CPU.
+
+Leak accounting: a transfer id left in a decode fake's ``kv_transfers``
+after a drill is a leaked KV hold (the real engine's TTL sweep is the
+backstop; the router's job is to not need it)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.testing.chaos import (
+    ChaosEvent,
+    ChaosFleet,
+    ChaosScenario,
+)
+
+
+def _router_client(fleet: ChaosFleet, extra_args=()):
+    from production_stack_tpu.router.app import RouterApp, build_parser
+
+    urls = fleet.urls
+    args = build_parser().parse_args([
+        "--service-discovery", "static",
+        "--static-backends", ",".join(urls),
+        "--static-models", ",".join(["fake-model"] * len(urls)),
+        "--static-backend-roles", ",".join(e.role for e in fleet.engines),
+        "--routing-logic", "disaggregated_prefill_orchestrated",
+        "--max-instance-failover-reroute-attempts", "3",
+        *extra_args,
+    ])
+    router = RouterApp(args)
+    return TestClient(TestServer(router.build_app()))
+
+
+async def _collect_stream(client, path, payload, timeout=30.0):
+    async def _go():
+        buf = b""
+        async with client.post(path, json=payload) as r:
+            status = r.status
+            if status != 200:
+                return status, [], False
+            async for chunk in r.content.iter_any():
+                buf += chunk
+        events, done = [], False
+        for block in buf.split(b"\n\n"):
+            if not block.startswith(b"data: "):
+                continue
+            data = block[len(b"data: "):]
+            if data == b"[DONE]":
+                done = True
+            else:
+                events.append(json.loads(data))
+        return status, events, done
+
+    return await asyncio.wait_for(_go(), timeout)
+
+
+def _text_of(events, chat=False):
+    if chat:
+        return "".join(
+            (e["choices"][0].get("delta") or {}).get("content") or ""
+            for e in events if e.get("choices")
+        )
+    return "".join(e["choices"][0]["text"]
+                   for e in events if e.get("choices"))
+
+
+def _tokens(n, first=0):
+    return "".join(f"tok{i} " for i in range(first, first + n))
+
+
+def _pool(fleet, role):
+    return [i for i, e in enumerate(fleet.engines) if e.role == role]
+
+
+def _no_leaks(fleet):
+    return {i: list(e.kv_transfers) for i, e in enumerate(fleet.engines)
+            if e.kv_transfers}
+
+
+# -- happy path: prefill on one engine, decode on another --------------------
+
+def test_streamed_disagg_two_hops_bit_identical():
+    """A streamed completion prefills on the prefill fake (one token,
+    KV pushed over the wire) and decodes on the decode fake via the
+    attached transfer; assembled text and usage are identical to a
+    unified single-engine run of the same request."""
+    n = 8
+    payload = {"model": "fake-model", "prompt": "The hedgehog",
+               "max_tokens": n, "stream": True, "temperature": 0}
+
+    async def main():
+        # unified reference run through a plain router
+        ref = ChaosFleet(1, tokens_per_second=500, ttft=0.001)
+        await ref.start()
+        try:
+            async with _router_client(ref) as client:
+                _, ref_events, ref_done = await _collect_stream(
+                    client, "/v1/completions", payload)
+        finally:
+            await ref.stop()
+        assert ref_done
+        ref_text, ref_usage = _text_of(ref_events), ref_events[-1]["usage"]
+
+        fleet = ChaosFleet(2, tokens_per_second=500, ttft=0.001,
+                           roles=["prefill", "decode"])
+        await fleet.start()
+        p, d = fleet.engines
+        try:
+            async with _router_client(fleet) as client:
+                status, events, done = await _collect_stream(
+                    client, "/v1/completions", payload)
+        finally:
+            await fleet.stop()
+        assert status == 200 and done
+        assert _text_of(events) == ref_text == _tokens(n)
+        assert events[-1]["usage"] == ref_usage == {
+            "prompt_tokens": 8, "completion_tokens": n,
+            "total_tokens": 8 + n}
+        # the handoff really happened: P pushed, D received and attached
+        assert p.kv_pushed == 1 and p.role == "prefill"
+        assert d.kv_recv_count == 1 and len(d.kv_attached) == 1
+        # each engine served its own phase
+        assert p.total_requests == 1 and d.total_requests == 1
+        assert _no_leaks(fleet) == {}
+
+    asyncio.run(main())
+
+
+def test_streamed_disagg_chat_single_opener():
+    """Chat shape: exactly one role-delta opener reaches the client (the
+    synthesized first-token events open the stream; the decode
+    continuation's opener is swallowed by the resume splice)."""
+    n = 6
+    payload = {"model": "fake-model",
+               "messages": [{"role": "user", "content": "hi"}],
+               "max_tokens": n, "stream": True, "temperature": 0}
+
+    async def main():
+        fleet = ChaosFleet(2, tokens_per_second=500, ttft=0.001,
+                           roles=["prefill", "decode"])
+        await fleet.start()
+        try:
+            async with _router_client(fleet) as client:
+                status, events, done = await _collect_stream(
+                    client, "/v1/chat/completions", payload)
+        finally:
+            await fleet.stop()
+        assert status == 200 and done
+        assert _text_of(events, chat=True) == _tokens(n)
+        openers = [e for e in events
+                   if (e["choices"][0].get("delta") or {}).get("role")]
+        assert len(openers) == 1, events
+        assert len({e["id"] for e in events}) == 1
+        assert _no_leaks(fleet) == {}
+
+    asyncio.run(main())
+
+
+def test_streamed_disagg_one_token_finishes_on_prefill():
+    """max_tokens=1: the prefill hop IS the completion — no decode hop,
+    the synthesized stream closes itself with finish + usage."""
+    payload = {"model": "fake-model", "prompt": "x", "max_tokens": 1,
+               "stream": True, "temperature": 0,
+               "stream_options": {"include_usage": True}}
+
+    async def main():
+        fleet = ChaosFleet(2, tokens_per_second=500, ttft=0.001,
+                           roles=["prefill", "decode"])
+        await fleet.start()
+        p, d = fleet.engines
+        try:
+            async with _router_client(fleet) as client:
+                status, events, done = await _collect_stream(
+                    client, "/v1/completions", payload)
+        finally:
+            await fleet.stop()
+        assert status == 200 and done
+        assert _text_of(events) == _tokens(1)
+        assert events[-1]["usage"]["completion_tokens"] == 1
+        assert d.total_requests == 0  # decode pool never consulted
+
+    asyncio.run(main())
+
+
+def test_nonstream_disagg_still_uses_pull_flow():
+    """Buffered requests keep the legacy pull orchestration (no resume
+    state to splice into): both hops run, output matches unified."""
+    payload = {"model": "fake-model", "prompt": "The hedgehog",
+               "max_tokens": 5, "temperature": 0}
+
+    async def main():
+        fleet = ChaosFleet(2, tokens_per_second=500, ttft=0.001,
+                           roles=["prefill", "decode"])
+        await fleet.start()
+        try:
+            async with _router_client(fleet) as client:
+                r = await client.post("/v1/completions", json=payload)
+                assert r.status == 200, await r.text()
+                body = await r.json()
+        finally:
+            await fleet.stop()
+        assert body["choices"][0]["text"] == _tokens(5)
+
+    asyncio.run(main())
+
+
+# -- chaos drill: kill the prefill mid-transfer ------------------------------
+
+def test_chaos_kill_prefill_unified_fallback():
+    """The prefill pool dies before the hop: the router degrades to a
+    unified single-engine request on the decode pool — full completion,
+    zero hung streams, zero parked transfers."""
+    from production_stack_tpu.router import metrics as rm
+
+    n = 10
+    payload = {"model": "fake-model", "prompt": "drill", "max_tokens": n,
+               "stream": True, "temperature": 0}
+
+    async def main():
+        fleet = ChaosFleet(2, tokens_per_second=500, ttft=0.001,
+                           roles=["prefill", "decode"])
+        await fleet.start()
+        p_idx = _pool(fleet, "prefill")[0]
+        before = rm.disagg_snapshot().get("unified_fallback", 0)
+        try:
+            await ChaosScenario(
+                fleet, [ChaosEvent(0.0, "kill", p_idx)]).run()
+            async with _router_client(fleet) as client:
+                status, events, done = await _collect_stream(
+                    client, "/v1/completions", payload)
+        finally:
+            await fleet.stop()
+        assert status == 200 and done
+        assert _text_of(events) == _tokens(n)
+        assert rm.disagg_snapshot()["unified_fallback"] == before + 1
+        assert _no_leaks(fleet) == {}
+        assert all(e.running == 0 for e in fleet.engines)
+
+    asyncio.run(main())
+
+
+def test_chaos_prefill_5xx_fails_over_then_unified():
+    """A sick (500-ing) prefill exhausts prefill failover and the
+    request is served unified — the client never sees the sickness."""
+    n = 6
+    payload = {"model": "fake-model", "prompt": "drill", "max_tokens": n,
+               "stream": True, "temperature": 0}
+
+    async def main():
+        fleet = ChaosFleet(2, tokens_per_second=500, ttft=0.001,
+                           roles=["prefill", "decode"])
+        await fleet.start()
+        p_idx = _pool(fleet, "prefill")[0]
+        fleet.fault(p_idx, "error_rate=1.0")
+        try:
+            async with _router_client(fleet) as client:
+                status, events, done = await _collect_stream(
+                    client, "/v1/completions", payload)
+        finally:
+            await fleet.stop()
+        assert status == 200 and done
+        assert _text_of(events) == _tokens(n)
+        assert _no_leaks(fleet) == {}
+
+    asyncio.run(main())
+
+
+# -- chaos drill: kill the decode after the splice ---------------------------
+
+def test_chaos_kill_decode_after_splice_replays():
+    """The decode engine dies mid-stream AFTER attaching the transfer:
+    resume-from-prefix replays the remainder on another decode backend,
+    and the client's assembled stream is bit-identical to an unbroken
+    run. The dead engine's parked state stays drained (no leak)."""
+    from production_stack_tpu.router import metrics as rm
+
+    n = 30
+    payload = {"model": "fake-model", "prompt": "drill", "max_tokens": n,
+               "stream": True, "temperature": 0}
+
+    async def main():
+        fleet = ChaosFleet(3, tokens_per_second=40, ttft=0.001,
+                           roles=["prefill", "decode", "decode"])
+        await fleet.start()
+        replayed0 = rm.disagg_snapshot().get("replayed", 0)
+        try:
+            async with _router_client(fleet) as client:
+                task = asyncio.ensure_future(_collect_stream(
+                    client, "/v1/completions", payload))
+                # kill whichever decode the stream landed on, mid-decode
+                serving = None
+                for _ in range(200):
+                    await asyncio.sleep(0.01)
+                    busy = [i for i in _pool(fleet, "decode")
+                            if fleet.engines[i].running > 0]
+                    if busy:
+                        serving = busy[0]
+                        break
+                assert serving is not None, "decode hop never started"
+                await asyncio.sleep(0.1)  # let a few tokens flow first
+                await fleet.kill(serving)
+                status, events, done = await task
+        finally:
+            await fleet.stop()
+        assert status == 200 and done
+        assert _text_of(events) == _tokens(n)
+        assert events[-1]["usage"] == {"prompt_tokens": 8,
+                                       "completion_tokens": n,
+                                       "total_tokens": 8 + n}
+        assert len({e["id"] for e in events}) == 1
+        # the replacement decode attached nothing (the push went to the
+        # dead one) yet still continued correctly from the prefix
+        assert rm.disagg_snapshot()["replayed"] == replayed0 + 1
+        assert _no_leaks(fleet) == {}
+        assert all(e.running == 0 for e in fleet.engines)
+
+    asyncio.run(main())
+
+
+# -- role plumbing -----------------------------------------------------------
+
+def test_fake_engine_advertises_role_and_transfer_state():
+    async def main():
+        fleet = ChaosFleet(1, roles=["decode"])
+        await fleet.start()
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{fleet.urls[0]}/v1/models") as r:
+                    card = (await r.json())["data"][0]
+                    assert card["role"] == "decode"
+                async with s.get(f"{fleet.urls[0]}/debug/perf") as r:
+                    kv = (await r.json())["kv_transfer"]
+                    assert kv["role"] == "decode"
+                    assert kv["pending_transfers"] == 0
+        finally:
+            await fleet.stop()
+
+    asyncio.run(main())
+
+
+def test_fake_kv_recv_rejects_corrupt_frames():
+    """The fake verifies the real framing: a flipped payload byte must
+    422 (digest mismatch) and park nothing."""
+    async def main():
+        from production_stack_tpu.engine import kv_transfer as kvt
+
+        fleet = ChaosFleet(1, roles=["decode"])
+        await fleet.start()
+        eng = fleet.engines[0]
+        body = kvt.frame(b'{"transfer_id": "t1"}') + kvt.frame(b"payload")
+        body += kvt.END_FRAME
+        corrupt = bytearray(body)
+        corrupt[kvt.FRAME_HEADER.size + 2] ^= 0xFF  # flip a meta byte
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{fleet.urls[0]}/kv/recv",
+                                  data=bytes(corrupt),
+                                  headers={"X-KV-Transfer-Id": "t1"}) as r:
+                    assert r.status == 422
+                assert eng.kv_transfers == {}
+                async with s.post(f"{fleet.urls[0]}/kv/recv", data=body,
+                                  headers={"X-KV-Transfer-Id": "t1"}) as r:
+                    assert r.status == 200
+                    assert (await r.json())["frames"] == 2
+                assert "t1" in eng.kv_transfers
+        finally:
+            await fleet.stop()
+
+    asyncio.run(main())
+
+
+def test_chaos_fleet_roles_length_validated():
+    with pytest.raises(ValueError):
+        ChaosFleet(2, roles=["prefill"])

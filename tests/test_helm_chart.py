@@ -611,6 +611,82 @@ def test_scenario_10_disagg_prefill_renders():
         "disaggregated_prefill")
 
 
+def test_engine_roles_render_two_pools_from_one_spec():
+    """engineConfig.roles.enabled splits ONE modelSpec into a prefill and
+    a decode Deployment: distinct names, per-role replicas/resources,
+    `stack/role` on both the pod labels AND the selector (or the two
+    Deployments adopt each other's pods), and the role/transfer flags on
+    the engine command line."""
+    objs = render_objects(HELM, {"servingEngineSpec": {"modelSpec": [{
+        "name": "llama", "modelRef": "llama-3-8b",
+        "servedModelName": "llama-3-8b", "replicaCount": 4,
+        "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x4",
+                "chips": 8},
+        "engineConfig": {
+            "tensorParallelSize": 8,
+            "roles": {
+                "enabled": True,
+                "prefill": {"replicaCount": 3},
+                "decode": {
+                    "replicaCount": 5,
+                    "resources": {"requests": {"google.com/tpu": 8},
+                                  "limits": {"google.com/tpu": 8}},
+                },
+            },
+            "kvTransferGroupLayers": 4,
+            "kvTransferWindow": 3,
+        },
+    }]}})
+    eng = engine_deployments(objs)
+    assert len(eng) == 2
+    by_role = {}
+    for d in eng:
+        labels = d["spec"]["template"]["metadata"]["labels"]
+        role = labels["stack/role"]
+        by_role[role] = d
+        # the selector must pin the role, not just the pod template
+        assert d["spec"]["selector"]["matchLabels"]["stack/role"] == role
+        assert d["metadata"]["name"].endswith(f"-llama-{role}")
+        args = container_args(d)
+        assert args[args.index("--role") + 1] == role
+        assert args[args.index("--kv-transfer-group-layers") + 1] == "4"
+        assert args[args.index("--kv-transfer-window") + 1] == "3"
+    assert by_role["prefill"]["spec"]["replicas"] == 3
+    assert by_role["decode"]["spec"]["replicas"] == 5
+
+
+def test_engine_roles_disabled_renders_single_unified_pool():
+    """roles.enabled=false (the default) must stay byte-compatible with
+    the pre-disagg chart: one Deployment, no stack/role label, no --role
+    flag."""
+    objs = render_objects(HELM)
+    eng = engine_deployments(objs)
+    assert len(eng) == 1
+    labels = eng[0]["spec"]["template"]["metadata"]["labels"]
+    assert "stack/role" not in labels
+    assert "stack/role" not in eng[0]["spec"]["selector"]["matchLabels"]
+    assert "--role" not in container_args(eng[0])
+
+
+def test_ci_values_render_prefill_and_decode_pools():
+    """values-ci.yaml keeps a 1-prefill + 1-decode split of the tiny
+    model so the kind CI tier exercises the disagg chart surface."""
+    with open(os.path.join(HELM, "values-ci.yaml")) as f:
+        ci = yaml.safe_load(f)
+    objs = render_objects(HELM, ci)
+    eng = engine_deployments(objs)
+    roles = {d["spec"]["template"]["metadata"]["labels"].get("stack/role"):
+             d for d in eng}
+    assert {"prefill", "decode"} <= set(roles)
+    for role in ("prefill", "decode"):
+        d = roles[role]
+        assert d["spec"]["replicas"] == 1
+        args = container_args(d)
+        assert args[args.index("--role") + 1] == role
+        assert args[args.index("--kv-transfer-window") + 1] == "2"
+        assert args[args.index("--kv-transfer-ttl") + 1] == "60"
+
+
 def test_scenario_04_multi_model_keda_renders():
     objs = render_asset("values-04-multi-model-keda.yaml")
     eng = engine_deployments(objs)

@@ -19,13 +19,15 @@ from production_stack_tpu.router.routing import (
     PrefixAwareRouter,
     RoundRobinRouter,
     SessionRouter,
+    drop_draining,
     extract_prompt,
 )
 from production_stack_tpu.router.stats import RequestStatsMonitor
 
 
-def ep(url, models=("m",), label=None):
-    return EndpointInfo(url=url, model_names=list(models), model_label=label)
+def ep(url, models=("m",), label=None, role=None, draining=False):
+    return EndpointInfo(url=url, model_names=list(models), model_label=label,
+                        role=role, draining=draining)
 
 
 def run(coro):
@@ -113,6 +115,35 @@ def test_orchestrated_pair_selection():
     # degraded: no labels → single pool
     p, d = run(r.select_pair([ep("http://x")], {}, {}, {}, {}))
     assert p is None and d == "http://x"
+
+
+def test_drop_draining_is_role_scoped():
+    """A fully-draining decode pool re-admits its drainers (degraded beats
+    unreachable) WITHOUT letting them leak into the candidate set while
+    healthy prefill engines exist — the regression the global all-draining
+    fallback had with role-split pools."""
+    p1 = ep("http://p1", role="prefill")
+    p2 = ep("http://p2", role="prefill", draining=True)
+    d1 = ep("http://d1", role="decode", draining=True)
+    d2 = ep("http://d2", role="decode", draining=True)
+
+    got = drop_draining([p1, p2, d1, d2])
+    # prefill still has live capacity → its drainer stays out; the decode
+    # role has no healthy member → both drainers come back
+    assert set(e.url for e in got) == {"http://p1", "http://d1", "http://d2"}
+
+    # homogeneous pool keeps the old behaviour: all draining → full list
+    all_drain = [ep("http://a", draining=True), ep("http://b", draining=True)]
+    assert drop_draining(all_drain) == all_drain
+    # ...and a partially-draining unified pool drops its drainers
+    mixed = [ep("http://a"), ep("http://b", draining=True)]
+    assert [e.url for e in drop_draining(mixed)] == ["http://a"]
+
+    # pre-role deployments scope by model_label instead
+    lp = ep("http://lp", label="prefill")
+    ld = ep("http://ld", label="decode", draining=True)
+    got = drop_draining([lp, ld])
+    assert set(e.url for e in got) == {"http://lp", "http://ld"}
 
 
 def test_extract_prompt_chat_and_multimodal():

@@ -217,6 +217,32 @@ def test_collect_signals_classifies_replica_states():
     assert sig.burn_fast > 0  # fused from the tracker
 
 
+def test_collect_signals_splits_role_pools():
+    """Role-labelled endpoints become independent ``model/role`` pools:
+    the prefill pool never sees KV usage (its pages are transfer
+    scratch) and only TTFT/availability burn may scale it; ITL burn
+    belongs to decode."""
+    from production_stack_tpu.router.protocols import EndpointInfo
+
+    eps = [
+        EndpointInfo(url="http://p", model_names=["m"], role="prefill"),
+        EndpointInfo(url="http://d", model_names=["m"], role="decode"),
+    ]
+    disc = _Disc(eps, {})
+    stats = {"http://p": _Stats(running=1, waiting=6, kv=0.9),
+             "http://d": _Stats(running=2, waiting=0, kv=0.7)}
+    tracker = SLOTracker(SLOConfig(ttft_p95=0.2, itl_p95=0.05))
+    tracker.record_ttft("m", 5.0, ts=T0)   # TTFT burning...
+    tracker.record_itl("m", 0.01, ts=T0)   # ...ITL healthy
+    out = collect_signals(disc, stats, tracker, now=T0 + 1)
+    assert set(out) == {"m/prefill", "m/decode"}
+    p, d = out["m/prefill"], out["m/decode"]
+    assert p.ready == 1 and d.ready == 1
+    assert p.waiting == 6 and d.waiting == 0
+    assert p.kv_usage == 0.0 and d.kv_usage == 0.7
+    assert p.burn_fast > 0 and d.burn_fast == 0.0
+
+
 def test_collect_signals_without_tracker_or_stats():
     from production_stack_tpu.router.protocols import EndpointInfo
 
@@ -270,6 +296,57 @@ def test_router_debug_scale_and_gauges():
             assert 'vllm:autoscaler_scale_events_total' in text
             assert 'vllm:autoscaler_replica_hours_total 1.0' in text
             assert 'vllm:replica_warmup_seconds_bucket' in text
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(main())
+    finally:
+        initialize_scale_advisor(None)
+        initialize_slo_tracker(None)
+
+
+def test_router_debug_scale_emits_per_role_signals():
+    """The ISSUE's disagg contract: one advisor, one /debug/scale, but
+    each role pool gets its own desired-replica signal (keyed
+    ``model/role``), fed from the role-labelled static discovery."""
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import RouterApp, build_parser
+
+        args = build_parser().parse_args([
+            "--service-discovery", "static",
+            "--static-backends", "http://127.0.0.1:1,http://127.0.0.1:2",
+            "--static-models", "tiny-llama,tiny-llama",
+            "--static-backend-roles", "prefill,decode",
+            "--scale-advisor",
+            "--scale-max-replicas", "4",
+            "--scale-target-queue", "4",
+        ])
+        router = RouterApp(args)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            adv = current_scale_advisor()
+            assert adv is not None
+            # the discovery census must split per role before any stats
+            from production_stack_tpu.router.service_discovery import (
+                get_service_discovery,
+            )
+            sigs = collect_signals(get_service_discovery(), {}, None, now=T0)
+            assert set(sigs) == {"tiny-llama/prefill", "tiny-llama/decode"}
+            # prefill pool queues up; decode pool stays idle
+            adv.evaluate("tiny-llama/prefill",
+                         ScaleSignals(ready=1, waiting=40.0), now=T0)
+            adv.evaluate("tiny-llama/decode",
+                         ScaleSignals(ready=1, waiting=0.0), now=T0)
+
+            r = await client.get("/debug/scale")
+            data = await r.json()
+            models = data["models"]
+            assert models["tiny-llama/prefill"]["desired_replicas"] == 4
+            assert models["tiny-llama/decode"]["desired_replicas"] == 1
         finally:
             await client.close()
 

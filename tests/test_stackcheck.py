@@ -354,6 +354,48 @@ def test_multichip_surface_is_inside_the_gates():
     assert "perfPeakIciGbps" in engine_tmpl
 
 
+def test_disagg_surface_is_inside_the_gates():
+    """The disaggregation surface (PR: engine roles + streamed P→D KV
+    handoff) is covered by the gates, not grandfathered: config-drift
+    sees the role/transfer flags as declared CLI flags (an
+    engineConfig.roles / kvTransfer* template typo would be an active
+    finding), and metric-hygiene tracks the transfer metric families as
+    both defined in code and documented — so renaming one, or deleting
+    its docs/observability.md row, fails
+    test_repo_has_no_active_findings."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    engine_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "engine" / "server.py")
+    assert {"--role", "--kv-transfer-group-layers", "--kv-transfer-window",
+            "--kv-transfer-retries", "--kv-transfer-ttl"} <= engine_flags
+    router_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "router" / "app.py")
+    assert {"--static-backend-roles"} <= router_flags
+
+    # exposition adds _total to the counters; the gate pins base names
+    disagg = {"vllm:kv_transfer_bytes", "vllm:kv_transfer_seconds",
+              "vllm:spliced_seqs", "vllm:disagg_requests"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert disagg <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert disagg <= documented
+
+    # the chart's two-pool roles block must stay consumed by the engine
+    # deployment template, and the CI values must exercise it (the
+    # tier-1 chart tests render values-ci.yaml)
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert "roles:" in values
+    values_ci = (REPO / "helm" / "values-ci.yaml").read_text()
+    assert "roles:" in values_ci and "kvTransferWindow:" in values_ci
+    engine_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-engine.yaml").read_text()
+    assert "--role" in engine_tmpl and "stack/role" in (
+        REPO / "helm" / "templates" / "_helpers.tpl").read_text()
+    assert "kvTransferWindow" in engine_tmpl
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

@@ -25,7 +25,7 @@ import urllib.request
 # bytes against the per-chip ICI link peak), so a TP=8 engine and a
 # single-chip one compare directly in the same table.
 COLUMNS = (
-    ("ENGINE", 28), ("MODEL", 14), ("STATUS", 10), ("CHIPS", 5),
+    ("ENGINE", 28), ("MODEL", 14), ("ROLE", 7), ("STATUS", 10), ("CHIPS", 5),
     ("MFU", 6), ("ICI", 6), ("HBM", 12), ("KVFREE", 7), ("WAIT", 5),
     ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
 )
@@ -59,6 +59,7 @@ def engine_row_cells(row: dict) -> list:
     return [
         row.get("url", "-"),
         ",".join(row.get("models") or []) or "-",
+        row.get("role") or row.get("label") or "-",
         row.get("status", "-"),
         _fmt_num(row.get("chips"), "d"),
         _fmt_pct(row.get("mfu")),
@@ -109,6 +110,11 @@ def render_table(snapshot: dict) -> str:
         lines.append("scale: " + ", ".join(
             f"{name}→{rec.get('desired_replicas')}"
             for name, rec in sorted(models.items())))
+    disagg = router.get("disagg") or {}
+    if disagg:
+        lines.append("disagg: " + ", ".join(
+            f"{outcome}={count}"
+            for outcome, count in sorted(disagg.items())))
     return "\n".join(lines)
 
 
