@@ -441,9 +441,17 @@ class CacheConfig:
     enable_prefix_caching: bool = True
     # host-DRAM offload tier (LMCache CPU-offload equivalent)
     host_offload_blocks: int = 0
+    # host tier capacity in BYTES — the authoritative knob
+    # (--kv-host-cache-bytes); when set it overrides host_offload_blocks,
+    # which remains as a block-count convenience converted via
+    # kv_cache_bytes_per_block at engine init
+    kv_host_cache_bytes: int = 0
     # shared remote tier (production_stack_tpu/kv_server URL; LMCache remote
     # cache-server equivalent)
     remote_kv_url: Optional[str] = None
+    # background threads for the async tier-prefetch pipeline (host/remote
+    # lookups + fetches run here; the serving thread only commits results)
+    kv_prefetch_workers: int = 2
 
 
 @dataclasses.dataclass

@@ -61,6 +61,11 @@ def _lp_row(lp: tuple, i: int):
 
 
 class LLMEngine:
+    # vllm:kv_prefetch_seconds histogram edges (an extra +Inf bucket is
+    # implied; metrics.py renders the cumulative prometheus form)
+    _PREFETCH_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                         1.0, 2.5, 5.0)
+
     def __init__(
         self,
         config: EngineConfig,
@@ -109,12 +114,18 @@ class LLMEngine:
             self._r_cu = np.zeros(sched.max_num_seqs + 1, np.int32)
             self._r_last_idx = np.zeros(sched.max_num_seqs, np.int32)
             self._r_sample_mask = np.zeros(sched.max_num_seqs, np.float32)
+        from production_stack_tpu.engine.kv_cache import (
+            kv_cache_bytes_per_block,
+        )
         from production_stack_tpu.engine.kv_offload import (
             maybe_make_remote,
             maybe_make_store,
         )
 
-        self.host_kv = maybe_make_store(config.cache)
+        self._kv_bytes_per_block = kv_cache_bytes_per_block(
+            config.model, config.cache)
+        self.host_kv = maybe_make_store(
+            config.cache, bytes_per_block=self._kv_bytes_per_block)
         self.remote_kv = maybe_make_remote(config.cache)
         from production_stack_tpu.parallel.mesh import AXIS_SEQ
 
@@ -122,8 +133,28 @@ class LLMEngine:
                 and config.scheduler.ring_prefill_threshold > 0
                 and getattr(self.runner, "seq_parallel", False)):
             self.scheduler.ring_enabled = True
+        # tiered-KV closed loop (engine/kv_offload.py): admission starts an
+        # async warm-tier prefix fetch (the sequence parks in PREFETCHING),
+        # HBM eviction demotes to host, host eviction demotes to remote.
+        # Per-tier traffic is byte-accounted from HBM's perspective:
+        # direction "in" = promotion into the pool, "out" = demotion/offload
+        self._prefetcher = None
+        self.hbm_demotions = 0
+        self.prefetch_blocks = 0
+        self.prefetch_count = 0
+        self.prefetch_seconds_sum = 0.0
+        self.prefetch_stall_seconds = 0.0
+        self.prefetch_hist = [0] * (len(self._PREFETCH_BUCKETS) + 1)
+        self.tier_bytes = {("host", "in"): 0, ("host", "out"): 0,
+                           ("remote", "in"): 0, ("remote", "out"): 0}
         if self.host_kv is not None or self.remote_kv is not None:
-            self.scheduler.admission_hook = self._host_extend_seq
+            from production_stack_tpu.engine.kv_offload import KVPrefetcher
+
+            self._prefetcher = KVPrefetcher(
+                self.host_kv, self.remote_kv, config.cache.block_size,
+                config.cache.kv_prefetch_workers)
+            self.scheduler.admission_hook = self._start_tier_prefetch
+        self._wire_tier_hooks()
         B = config.scheduler.max_num_seqs
         M = self.runner.max_blocks_per_seq
         # persistent decode-batch host arrays (rewritten in place each step)
@@ -364,11 +395,23 @@ class LLMEngine:
 
     # -- the step ------------------------------------------------------------
     def step(self) -> list[RequestOutput]:
+        # land finished warm-tier fetches first so their sequences become
+        # schedulable in THIS step's decision
+        self._poll_prefetches()
         out = self.scheduler.schedule()
         if out.is_empty:
             outputs = self._resolve_pending_ragged()
             outputs.extend(self._resolve_pending_prefill())
             outputs.extend(self._resolve_pending_decode())
+            if (not outputs and self._prefetcher is not None
+                    and self._prefetcher.jobs):
+                # nothing else runnable and fetches in flight: a bounded
+                # wait trades a busy-spin for latency no request observes.
+                # Time spent here is the NON-overlapped share of prefetch
+                # (the bench's prefetch-overlap fraction reads it).
+                t0 = time.monotonic()
+                self._prefetcher.wait_any(0.002)
+                self.prefetch_stall_seconds += time.monotonic() - t0
             return outputs
         if out.prefills:
             if self.attention_impl == "ragged" and not out.prefills[0].ring:
@@ -468,43 +511,103 @@ class LLMEngine:
             fetched = (np.asarray(fetched),)
         return self._finish_prefill(prefills, fetched)
 
-    # -- host-DRAM KV tier (see engine/kv_offload.py) ------------------------
-    def _host_extend_seq(self, seq: Sequence) -> None:
-        """Admission hook: extend a freshly admitted sequence's cached prefix
-        from the warm tiers — host DRAM first, then the shared remote store —
-        re-importing blocks instead of recomputing them."""
-        from production_stack_tpu.engine.kv_offload import chain_hashes
-
-        bs = self.config.cache.block_size
-        if seq.num_computed_tokens % bs:
-            return
-        start_block = seq.num_computed_tokens // bs
-        max_usable = max((len(seq.token_ids) - 1) // bs, 0)
-        slabs = []
-        cursor = start_block
+    # -- tiered KV (HBM ↔ host ↔ remote; see engine/kv_offload.py) -----------
+    def _wire_tier_hooks(self) -> None:
+        """Point the allocator's eviction at host demotion and the host
+        store's eviction at remote demotion. Re-run after anything that
+        rebuilds the allocator (sleep_mode)."""
         if self.host_kv is not None:
-            h_slabs, n = self.host_kv.match_extension(seq.token_ids, cursor)
-            slabs.extend(h_slabs)
-            cursor += n
-        if self.remote_kv is not None and cursor < max_usable:
-            hashes = chain_hashes(seq.token_ids, bs)
-            r_slabs = self.remote_kv.match_extension(hashes, cursor, max_usable)
-            slabs.extend(r_slabs)
-            cursor += len(r_slabs)
-        n = cursor - start_block
-        if not n:
-            return
-        import numpy as np
+            self.scheduler.allocator.evict_hook = self._demote_evicted_block
+            if self.remote_kv is not None:
+                self.host_kv.demote_hook = self._demote_to_remote
 
-        target = seq.block_ids[start_block:cursor]
+    def _demote_evicted_block(self, block_id: int, chain_hash: int) -> None:
+        """Allocator evict hook: an HBM block is about to be recycled —
+        copy its slab down to host DRAM so the prefix survives the pool.
+        Runs on the engine thread while the block's KV is still intact
+        (before the id returns to the free list)."""
+        if chain_hash in self.host_kv:
+            return  # already resident (e.g. offloaded at finish)
+        data = np.asarray(self.runner.export_blocks([block_id]))
+        slab = np.ascontiguousarray(data[:, 0])  # (L, bs, 2KH, D)
+        if self.host_kv.put(chain_hash, slab):
+            self.hbm_demotions += 1
+            self.tier_bytes[("host", "out")] += slab.nbytes
+
+    def _demote_to_remote(self, chain_hash: int, slab) -> None:
+        """Host-store demote hook: a host-LRU-evicted slab moves onward to
+        the shared remote tier (bounded fire-and-forget — RemoteKVClient
+        drops past its pending-put cap rather than grow a backlog)."""
+        self.remote_kv.put_slab(chain_hash, slab)
+        self.tier_bytes[("remote", "out")] += slab.nbytes
+
+    def _start_tier_prefetch(self, seq: Sequence) -> None:
+        """Admission hook: start the async warm-tier prefix lookup and park
+        the sequence in PREFETCHING until the fetch lands (committed at the
+        top of a later step). The old synchronous import stalled the whole
+        serving loop for up to the remote timeout per admission; now a cold
+        tier delays only this sequence's own prefill."""
+        if self._prefetcher.submit(seq) is not None:
+            seq.status = SequenceStatus.PREFETCHING
+
+    def _poll_prefetches(self) -> None:
+        if self._prefetcher is None:
+            return
+        for job in self._prefetcher.pop_done():
+            self._commit_prefetch(job)
+
+    def _commit_prefetch(self, job) -> None:
+        """Land one finished prefetch: import the staged slabs into the
+        blocks reserved at admission (block-table indirection only — the
+        ragged dispatch never sees tier state) and release the sequence to
+        PREFILLING. A sequence aborted mid-flight was already released (its
+        blocks may belong to someone else), so staged data is only imported
+        after re-checking the sequence still owns the snapshotted blocks."""
+        try:
+            slabs, host_n, remote_n = job.future.result()
+        except Exception:  # tier lookup died: treat as a clean miss
+            slabs, host_n, remote_n = [], 0, 0
+        self._observe_prefetch(time.monotonic() - job.submit_time)
+        seq = self.scheduler.seqs.get(job.request_id)
+        if (seq is None or seq.status is not SequenceStatus.PREFETCHING
+                or tuple(seq.block_ids[:len(job.block_snapshot)])
+                != job.block_snapshot):
+            self._prefetcher.dropped += 1
+            if seq is not None and seq.status is SequenceStatus.PREFETCHING:
+                seq.status = SequenceStatus.PREFILLING
+            return
+        seq.status = SequenceStatus.PREFILLING
+        n = len(slabs)
+        if not n:
+            return  # warm-tier miss: the normal prefill recomputes
+        bs = self.config.cache.block_size
+        start = job.start_block
+        target = seq.block_ids[start : start + n]
         data = np.stack(slabs).transpose(1, 0, 2, 3, 4)  # (L, n, bs, ...)
         self.runner.import_blocks(target, data)
         seq.num_computed_tokens += n * bs
         seq.num_cached_tokens += n * bs
         self.scheduler.allocator.commit_full_blocks(
             seq.token_ids[: seq.num_computed_tokens],
-            seq.block_ids[:cursor],
+            seq.block_ids[: start + n],
         )
+        self._prefetcher.committed += 1
+        self.prefetch_blocks += n
+        if host_n:
+            self.tier_bytes[("host", "in")] += sum(
+                s.nbytes for s in slabs[:host_n])
+        if remote_n:
+            self.tier_bytes[("remote", "in")] += sum(
+                s.nbytes for s in slabs[host_n:])
+
+    def _observe_prefetch(self, seconds: float) -> None:
+        self.prefetch_count += 1
+        self.prefetch_seconds_sum += seconds
+        for i, edge in enumerate(self._PREFETCH_BUCKETS):
+            if seconds <= edge:
+                self.prefetch_hist[i] += 1
+                return
+        self.prefetch_hist[-1] += 1  # +Inf bucket
 
     def _host_offload_finished(self, seq: Sequence) -> None:
         """Copy a finishing sequence's full blocks to the warm tiers."""
@@ -521,12 +624,16 @@ class LLMEngine:
         data = self.runner.export_blocks(seq.block_ids[:n_full])
         slabs = np.ascontiguousarray(data.transpose(1, 0, 2, 3, 4))
         if self.host_kv is not None:
-            self.host_kv.put_sequence(seq.token_ids[: n_full * bs], slabs)
+            added = self.host_kv.put_sequence(
+                seq.token_ids[: n_full * bs], slabs)
+            if added:
+                self.tier_bytes[("host", "out")] += added * slabs[0].nbytes
         if self.remote_kv is not None:
             for h, slab in zip(
                 chain_hashes(seq.token_ids[: n_full * bs], bs), slabs
             ):
                 self.remote_kv.put_slab(h, slab)
+                self.tier_bytes[("remote", "out")] += slab.nbytes
 
     def _bucket(self, n: int) -> int:
         return self.config.scheduler.bucket_for(n, self.config.model.max_model_len)
@@ -1418,9 +1525,71 @@ class LLMEngine:
             out["cpu_cache_usage_perc"] = self.host_kv.usage
             out["cpu_prefix_cache_hits_total"] = self.host_kv.hits
             out["cpu_prefix_cache_queries_total"] = self.host_kv.queries
+        if self.host_kv is not None or self.remote_kv is not None:
+            out["kv_tier"] = self.tier_stats()
         if self.perf is not None:
             out["perf"] = self.perf.stats_fields()
         return out
+
+    def tier_stats(self) -> dict:
+        """Tiered-KV snapshot: per-tier hit/miss/demote/promote counters,
+        byte-accounted traffic, and the prefetch pipeline's latency state.
+        Feeds vllm:kv_tier_hit_ratio{tier} / vllm:kv_tier_bytes_total
+        {tier,direction} / vllm:kv_prefetch_seconds, the /debug/perf
+        ``kv_tier`` block, and (through /metrics) the router's
+        tier-weighted prefix scoring."""
+        alloc = self.scheduler.allocator
+        tiers: dict = {
+            "hbm": {
+                "hits": alloc.prefix_hits,
+                "queries": alloc.prefix_queries,
+                "demotions": self.hbm_demotions,
+                "evictions": alloc.evictions,
+                "usage": alloc.usage,
+            },
+        }
+        if self.host_kv is not None:
+            tiers["host"] = {
+                "hits": self.host_kv.hits,
+                "queries": self.host_kv.queries,
+                "demotions": self.host_kv.demotions,
+                "evictions": self.host_kv.evictions,
+                "usage": self.host_kv.usage,
+                "bytes_used": self.host_kv.used_bytes,
+                "bytes_capacity": self.host_kv.capacity_bytes,
+            }
+        if self.remote_kv is not None:
+            tiers["remote"] = {
+                "hits": self.remote_kv.hits,
+                "queries": self.remote_kv.queries,
+            }
+        prefetch = None
+        if self._prefetcher is not None:
+            total = self.prefetch_seconds_sum
+            prefetch = {
+                "submitted": self._prefetcher.submitted,
+                "committed": self._prefetcher.committed,
+                "dropped": self._prefetcher.dropped,
+                "in_flight": len(self._prefetcher.jobs),
+                "blocks": self.prefetch_blocks,
+                "count": self.prefetch_count,
+                "seconds_sum": total,
+                "stall_seconds": self.prefetch_stall_seconds,
+                # share of prefetch wall time that overlapped useful engine
+                # work (1.0 = the serving loop never waited on a tier)
+                "overlap_fraction": (
+                    max(0.0, 1.0 - self.prefetch_stall_seconds / total)
+                    if total > 0 else 1.0
+                ),
+                "hist_buckets": list(self._PREFETCH_BUCKETS),
+                "hist_counts": list(self.prefetch_hist),
+            }
+        return {
+            "tiers": tiers,
+            "bytes": {f"{t}_{d}": v
+                      for (t, d), v in sorted(self.tier_bytes.items())},
+            "prefetch": prefetch,
+        }
 
     # -- sleep mode (frees HBM; reference semantics: engines release device
     #    memory on /sleep and restore on /wake_up, request.py:1027-1114) ----
@@ -1438,6 +1607,7 @@ class LLMEngine:
             self.runner.num_blocks, self.config.cache.block_size,
             self.config.cache.enable_prefix_caching,
         )
+        self._wire_tier_hooks()  # the rebuilt allocator must keep demoting
         if level >= 2:
             self.runner.drop_params()
         self.sleep_level = level

@@ -132,6 +132,13 @@ class PrefixCachingBlockAllocator:
         # metrics
         self.prefix_queries = 0
         self.prefix_hits = 0
+        self.evictions = 0
+        # demotion hook, fired with (block_id, content_hash) while the
+        # evicted block's KV is still intact in HBM — the engine exports
+        # the slab to the host tier here (eviction becomes demotion). The
+        # hook must not allocate from this pool (it only reads the device
+        # block and writes host-side dicts).
+        self.evict_hook = None
 
     # -- internals ---------------------------------------------------------
     def _evict_one(self) -> bool:
@@ -141,9 +148,12 @@ class PrefixCachingBlockAllocator:
         blk = self.blocks[bid]
         assert blk.ref_count == 0
         if blk.content_hash is not None:
+            if self.evict_hook is not None:
+                self.evict_hook(bid, blk.content_hash)
             self.hash_to_block.pop(blk.content_hash, None)
             blk.content_hash = None
         self.free_ids.append(bid)
+        self.evictions += 1
         return True
 
     def _pop_free(self) -> Optional[int]:

@@ -4,20 +4,34 @@ engine pod, deployment-vllm-multi.yaml:284-345; BASELINE.json names
 HBM↔host↔remote tiering the north-star).
 
 Design: the HBM pool's prefix cache is the hot tier; this store is the warm
-tier. When a sequence finishes, its full blocks' slabs are copied
-device→host and indexed by the same content-hash chain the allocator uses.
-On admission, any chain extension that misses HBM but hits the host store
-is imported into freshly allocated blocks — so KV survives HBM eviction and
-conversation rounds keep their prefix even under memory pressure.
+tier. Tier movement is demand-driven in both directions:
 
-Capacity-bounded LRU of block slabs; all lookups/stores are host-side dict
-ops keyed by the allocator's chain hashes.
+- **demotion**: when the HBM allocator LRU-evicts a content-addressed block
+  its slab is copied device→host first (the allocator's ``evict_hook``);
+  when THIS store LRU-evicts under byte pressure the slab demotes onward to
+  the remote tier (``demote_hook`` → bounded fire-and-forget put). Finished
+  sequences still eager-offload (the original warm path) so the shared
+  tiers fill before pressure hits.
+- **promotion**: on admission, any chain extension that misses HBM is looked
+  up host-first then remote by :class:`KVPrefetcher` on a background
+  executor; the engine commits the staged slabs into freshly allocated
+  blocks via block-table indirection while the sequence waits in the
+  ``PREFETCHING`` scheduler state — the serving loop never blocks on a tier.
+
+Capacity is accounted in BYTES (``kv_cache_bytes_per_block``), so
+``--kv-host-cache-bytes`` means what it says regardless of slab geometry;
+all lookups/stores are host-side dict ops keyed by the allocator's chain
+hashes, guarded by one lock so prefetch-executor reads and serving-thread
+writes never race.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import Optional, Sequence
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -36,37 +50,106 @@ def chain_hashes(tokens: Sequence[int], block_size: int) -> list[int]:
 
 
 class HostKVStore:
-    def __init__(self, capacity_blocks: int, block_size: int):
-        self.capacity = capacity_blocks
+    """Byte-accounted LRU of block slabs keyed by chain hash.
+
+    ``capacity_bytes`` is authoritative. The legacy ``capacity_blocks``
+    knob is converted lazily: the first stored slab fixes the byte size of
+    a block (all slabs share one geometry per model), so block-count
+    configs keep their exact historical semantics while mixed callers can
+    size in bytes up front via ``bytes_per_block`` or ``capacity_bytes``.
+    """
+
+    def __init__(self, capacity_blocks: int, block_size: int,
+                 bytes_per_block: int = 0, capacity_bytes: int = 0):
+        self.capacity = capacity_blocks  # legacy block-count knob
         self.block_size = block_size
+        self.capacity_bytes = (
+            capacity_bytes if capacity_bytes > 0
+            else capacity_blocks * bytes_per_block
+        )  # 0 → fixed by the first slab's nbytes
+        self.used_bytes = 0
         self.store: "collections.OrderedDict[int, np.ndarray]" = (
             collections.OrderedDict()
         )  # chain_hash -> (L, bs, 2KH, D) slab
         self.stores = 0
         self.hits = 0
         self.queries = 0
+        self.evictions = 0
+        self.demotions = 0
+        # fired with (chain_hash, slab) when an entry LRU-evicts — the
+        # engine points this at the remote tier's fire-and-forget put
+        self.demote_hook: Optional[Callable[[int, np.ndarray], None]] = None
+        self._lock = threading.RLock()
 
     @property
     def usage(self) -> float:
-        return len(self.store) / max(self.capacity, 1)
+        """Byte-ratio occupancy (the /metrics cpu_cache_usage_perc value)."""
+        return self.used_bytes / max(self.capacity_bytes, 1)
 
     def chain_hashes(self, tokens: Sequence[int]) -> list[int]:
         return chain_hashes(tokens, self.block_size)
+
+    def __contains__(self, chain_hash: int) -> bool:
+        with self._lock:
+            return chain_hash in self.store
+
+    def _evict_for(self, nbytes: int) -> list[tuple[int, np.ndarray]]:
+        """Pop LRU entries until ``nbytes`` fits; returns the demoted
+        entries so the hook can run OUTSIDE the lock."""
+        demoted = []
+        while self.store and self.used_bytes + nbytes > self.capacity_bytes:
+            h, slab = self.store.popitem(last=False)
+            self.used_bytes -= slab.nbytes
+            self.evictions += 1
+            demoted.append((h, slab))
+        return demoted
+
+    def put(self, chain_hash: int, slab: np.ndarray) -> bool:
+        """Store one block slab (idempotent; refreshes LRU on re-put).
+        Returns True if the slab was newly added."""
+        demoted = []
+        try:
+            with self._lock:
+                if self.capacity_bytes <= 0:
+                    self.capacity_bytes = self.capacity * slab.nbytes
+                if chain_hash in self.store:
+                    self.store.move_to_end(chain_hash)
+                    return False
+                if slab.nbytes > self.capacity_bytes:
+                    return False  # one slab over capacity: never fits
+                demoted = self._evict_for(slab.nbytes)
+                self.store[chain_hash] = slab
+                self.used_bytes += slab.nbytes
+                self.stores += 1
+                return True
+        finally:
+            if demoted and self.demote_hook is not None:
+                self.demotions += len(demoted)
+                for h, s in demoted:
+                    self.demote_hook(h, s)
 
     def put_sequence(self, tokens: Sequence[int], slabs: np.ndarray) -> int:
         """Store full-block slabs of a finished sequence.
         slabs: (n_full, L, bs, 2KH, D) — one slab per full block."""
         added = 0
         for h, slab in zip(self.chain_hashes(tokens), slabs):
-            if h in self.store:
-                self.store.move_to_end(h)
-                continue
-            while len(self.store) >= self.capacity:
-                self.store.popitem(last=False)
-            self.store[h] = slab
-            added += 1
-        self.stores += added
+            if self.put(h, slab):
+                added += 1
         return added
+
+    def probe_extension(self, tokens: Sequence[int], start_block: int) -> int:
+        """Advisory run length for routing lookups: how many blocks this
+        store could continue the chain with. Touches neither the LRU order
+        nor the hit/query counters — a router probe is not a cache use."""
+        hashes = self.chain_hashes(tokens)
+        max_usable = max((len(tokens) - 1) // self.block_size, 0)
+        n = 0
+        with self._lock:
+            for i in range(start_block, min(len(hashes), max_usable)):
+                if hashes[i] not in self.store:
+                    break
+                n += 1
+        return n
 
     def match_extension(
         self, tokens: Sequence[int], start_block: int
@@ -78,14 +161,15 @@ class HostKVStore:
         hashes = self.chain_hashes(tokens)
         max_usable = max((len(tokens) - 1) // self.block_size, 0)
         slabs: list[np.ndarray] = []
-        for i in range(start_block, min(len(hashes), max_usable)):
-            self.queries += 1
-            slab = self.store.get(hashes[i])
-            if slab is None:
-                break
-            self.store.move_to_end(hashes[i])
-            self.hits += 1
-            slabs.append(slab)
+        with self._lock:
+            for i in range(start_block, min(len(hashes), max_usable)):
+                self.queries += 1
+                slab = self.store.get(hashes[i])
+                if slab is None:
+                    break
+                self.store.move_to_end(hashes[i])
+                self.hits += 1
+                slabs.append(slab)
         return slabs, len(slabs)
 
 
@@ -219,10 +303,116 @@ class RemoteKVClient:
         return slabs
 
 
-def maybe_make_store(cache_config) -> Optional[HostKVStore]:
-    if cache_config.host_offload_blocks > 0:
+@dataclasses.dataclass
+class PrefetchJob:
+    """One in-flight warm-tier prefix fetch for an admitted sequence.
+
+    Carries everything needed for the commit-time safety recheck: the
+    sequence may be aborted while the fetch is in flight (its blocks freed
+    and possibly reallocated to another sequence), so the engine must only
+    import staged slabs when the sequence is still PREFETCHING *and* still
+    owns the exact blocks snapshotted at submit."""
+
+    request_id: str
+    start_block: int
+    block_snapshot: tuple  # seq.block_ids at submit time
+    future: "object"       # resolves to (slabs, host_blocks, remote_blocks)
+    submit_time: float
+
+
+class KVPrefetcher:
+    """Async warm-tier lookup pipeline (host DRAM first, then remote).
+
+    All tier IO runs on this executor; the serving thread submits jobs at
+    admission and polls/commits completed ones at the top of ``step()`` —
+    a miss or a dead remote never stalls the event loop, it just delays
+    one sequence's own prefill."""
+
+    def __init__(self, host_kv: Optional[HostKVStore],
+                 remote_kv: Optional[RemoteKVClient],
+                 block_size: int, workers: int = 2):
+        import concurrent.futures
+
+        self.host_kv = host_kv
+        self.remote_kv = remote_kv
+        self.block_size = block_size
+        self._io = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(workers, 1), thread_name_prefix="kv-prefetch")
+        self.jobs: list[PrefetchJob] = []
+        self.submitted = 0
+        self.committed = 0
+        self.dropped = 0  # aborted/superseded mid-flight: staging discarded
+
+    def _lookup(self, token_ids: list[int],
+                start_block: int) -> tuple[list[np.ndarray], int, int]:
+        """Executor-side: longest warm-tier run continuing the chain."""
+        slabs: list[np.ndarray] = []
+        cursor = start_block
+        host_n = remote_n = 0
+        if self.host_kv is not None:
+            h_slabs, host_n = self.host_kv.match_extension(token_ids, cursor)
+            slabs.extend(h_slabs)
+            cursor += host_n
+        max_usable = max((len(token_ids) - 1) // self.block_size, 0)
+        if self.remote_kv is not None and cursor < max_usable:
+            hashes = chain_hashes(token_ids, self.block_size)
+            r_slabs = self.remote_kv.match_extension(hashes, cursor,
+                                                     max_usable)
+            slabs.extend(r_slabs)
+            remote_n = len(r_slabs)
+        return slabs, host_n, remote_n
+
+    def submit(self, seq) -> Optional[PrefetchJob]:
+        """Queue a warm-tier lookup for a just-admitted sequence. Returns
+        the job (the caller parks the sequence in PREFETCHING) or None when
+        there is nothing past the HBM-covered prefix to even look for."""
+        bs = self.block_size
+        if seq.num_computed_tokens % bs:
+            return None
+        start_block = seq.num_computed_tokens // bs
+        max_usable = max((len(seq.token_ids) - 1) // bs, 0)
+        if start_block >= max_usable:
+            return None  # HBM already covers every importable block
+        try:
+            fut = self._io.submit(self._lookup, list(seq.token_ids),
+                                  start_block)
+        except RuntimeError:  # executor shut down (interpreter teardown)
+            return None
+        job = PrefetchJob(seq.request_id, start_block,
+                          tuple(seq.block_ids), fut, time.monotonic())
+        self.jobs.append(job)
+        self.submitted += 1
+        return job
+
+    def pop_done(self) -> list[PrefetchJob]:
+        done = [j for j in self.jobs if j.future.done()]
+        if done:
+            self.jobs = [j for j in self.jobs if not j.future.done()]
+        return done
+
+    def wait_any(self, timeout: float) -> None:
+        """Bounded wait for the oldest in-flight job — called only when the
+        scheduler has NOTHING else runnable, so the brief sleep trades a
+        busy-spin for latency no request observes."""
+        import concurrent.futures
+
+        if self.jobs:
+            concurrent.futures.wait(
+                [j.future for j in self.jobs], timeout=timeout,
+                return_when=concurrent.futures.FIRST_COMPLETED)
+
+    def shutdown(self) -> None:
+        self._io.shutdown(wait=False)
+
+
+def maybe_make_store(cache_config,
+                     bytes_per_block: int = 0) -> Optional[HostKVStore]:
+    cap_bytes = getattr(cache_config, "kv_host_cache_bytes", 0)
+    if cache_config.host_offload_blocks > 0 or cap_bytes > 0:
         return HostKVStore(cache_config.host_offload_blocks,
-                           cache_config.block_size)
+                           cache_config.block_size,
+                           bytes_per_block=bytes_per_block,
+                           capacity_bytes=cap_bytes)
     return None
 
 

@@ -16,6 +16,7 @@ from prometheus_client import CollectorRegistry, Counter, Histogram
 from prometheus_client.core import (
     CounterMetricFamily,
     GaugeMetricFamily,
+    HistogramMetricFamily,
     SummaryMetricFamily,
 )
 
@@ -240,6 +241,62 @@ class EngineStatsCollector:
                 "— a shape leaked past warmup (bug signal)",
                 perf["unexpected_recompiles"],
             )
+        # tiered KV cache (engine/kv_offload.py): per-tier hit ratios and
+        # byte-accounted traffic the router's tier-weighted prefix scoring
+        # scrapes, plus the async prefetch pipeline's latency histogram
+        kv_tier = s.get("kv_tier")
+        if kv_tier:
+            ratio = GaugeMetricFamily(
+                "vllm:kv_tier_hit_ratio",
+                "Cumulative prefix-block hit ratio per KV tier "
+                "(hbm = on-device pool, host = DRAM store, remote = shared "
+                "kv_server)",
+                labels=["model_name", "tier"],
+            )
+            for tier, t in sorted(kv_tier["tiers"].items()):
+                q = t.get("queries", 0)
+                ratio.add_metric([self.model_name, tier],
+                                 t.get("hits", 0) / q if q else 0.0)
+            yield ratio
+            tier_bytes = CounterMetricFamily(
+                "vllm:kv_tier_bytes",
+                "KV slab bytes moved per tier and direction (from the HBM "
+                "pool's perspective: in = promotion/prefetch import, out = "
+                "demotion/offload export)",
+                labels=["model_name", "tier", "direction"],
+            )
+            for key, nbytes in sorted(kv_tier["bytes"].items()):
+                tier, direction = key.rsplit("_", 1)
+                tier_bytes.add_metric(
+                    [self.model_name, tier, direction], nbytes)
+            yield tier_bytes
+            pf = kv_tier.get("prefetch")
+            if pf:
+                # cumulative le-bucket form from the engine's per-bucket
+                # counts (last count is the +Inf overflow)
+                edges = pf["hist_buckets"]
+                counts = pf["hist_counts"]
+                acc, buckets = 0, []
+                for edge, n in zip(edges, counts):
+                    acc += n
+                    buckets.append((str(edge), acc))
+                buckets.append(("+Inf", acc + counts[-1]))
+                hist = HistogramMetricFamily(
+                    "vllm:kv_prefetch_seconds",
+                    "Warm-tier prefix fetch latency (admission → staged "
+                    "slabs ready to commit); overlapped with serving, "
+                    "never blocking the loop",
+                    labels=["model_name"],
+                )
+                hist.add_metric(lv, buckets, pf["seconds_sum"])
+                yield hist
+                yield gauge(
+                    "vllm:kv_prefetch_overlap_fraction",
+                    "Share of prefetch wall time overlapped with useful "
+                    "engine work (1.0 = the serving loop never waited on "
+                    "a tier fetch)",
+                    pf.get("overlap_fraction", 1.0),
+                )
 
 
 class LifecycleCollector:

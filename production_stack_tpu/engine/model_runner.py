@@ -1054,13 +1054,32 @@ class ModelRunner:
                     B_dev.at[:, slot].set(0.0),
                 )
 
-    # -- KV block export/import (disaggregated prefill→decode transfer) -----
+    # -- KV block export/import (disagg P→D transfer + tier movement) -------
+    def _io_fns(self):
+        """Jitted whole-layer gather/scatter, cached on self: a fresh
+        jax.jit wrapper per call has its own empty trace cache, so every
+        tier demotion/prefetch-commit would recompile (~60 ms each — the
+        entire warm-tier win). One wrapper reuses traces per block-count."""
+        cache = getattr(self, "_io_fn_cache", None)
+        if cache is None:
+            def _gather(kv, i):
+                return kv[:, i]
+
+            def _scatter(kv, i, d):
+                return kv.at[:, i].set(d.astype(kv.dtype))
+
+            cache = self._io_fn_cache = (
+                jax.jit(_gather, **self._mh_gate_all),
+                jax.jit(_scatter, donate_argnums=(0,)),
+            )
+        return cache
+
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:
         """Gather blocks out of HBM → host (L, n, bs, 2KH, D) array."""
         idx = jnp.asarray(block_ids, jnp.int32)
+        gather_fn, _ = self._io_fns()
         with set_mesh(self.mesh):
-            data = jax.jit(lambda kv, i: kv[:, i],
-                           **self._mh_gate_all)(self.kv, idx)
+            data = gather_fn(self.kv, idx)
         return np.asarray(jax.device_get(data))
 
     def _range_fns(self, n_layers: int):
@@ -1101,14 +1120,9 @@ class ModelRunner:
     def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
         """Scatter transferred blocks into this engine's pool (donated)."""
         idx = jnp.asarray(block_ids, jnp.int32)
-
-        def _scatter(kv, i, d):
-            return kv.at[:, i].set(d.astype(kv.dtype))
-
+        _, scatter_fn = self._io_fns()
         with set_mesh(self.mesh):
-            self.kv = jax.jit(_scatter, donate_argnums=(0,))(
-                self.kv, idx, jnp.asarray(data)
-            )
+            self.kv = scatter_fn(self.kv, idx, jnp.asarray(data))
 
     def import_blocks_range(self, block_ids: list[int], layer_lo: int,
                             data: np.ndarray) -> None:

@@ -77,7 +77,11 @@ class Scheduler:
         self.seqs: dict[str, Sequence] = {}  # admitted, not finished
         self.free_slots = list(range(sched.max_num_seqs - 1, -1, -1))
         # invoked right after a sequence is admitted, before its first chunk
-        # is scheduled (the host-KV tier extends cached prefixes here)
+        # is scheduled. The tiered-KV engine starts an async warm-tier
+        # prefix fetch here and may park the sequence in PREFETCHING —
+        # both scheduling paths gate prefill on PREFILLING and decode on
+        # RUNNING, so a parked sequence holds its slot and blocks but
+        # consumes no budget until the engine flips it back
         self.admission_hook = None
         # set by the engine when the mesh has a seq axis > 1: long fresh
         # prompts prefill whole via ring attention instead of chunking
@@ -130,6 +134,12 @@ class Scheduler:
     @property
     def num_running(self) -> int:
         return len(self.seqs)
+
+    @property
+    def num_prefetching(self) -> int:
+        """Admitted sequences parked on an in-flight warm-tier fetch."""
+        return sum(1 for s in self.seqs.values()
+                   if s.status is SequenceStatus.PREFETCHING)
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.seqs)

@@ -13,6 +13,11 @@ from production_stack_tpu.engine.sampling import SamplingParams
 class SequenceStatus(enum.Enum):
     WAITING = "waiting"
     PREFILLING = "prefilling"
+    # admitted with blocks allocated, but a warm-tier (host/remote) prefix
+    # fetch is still in flight on the prefetch executor — the scheduler
+    # parks the sequence (neither prefill nor decode touches it) until the
+    # engine commits or drops the staged blocks and flips it to PREFILLING
+    PREFETCHING = "prefetching"
     RUNNING = "running"  # decoding
     PREEMPTED = "preempted"
     FINISHED_STOPPED = "stop"

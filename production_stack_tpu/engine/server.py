@@ -1204,9 +1204,16 @@ class EngineServer:
         else:
             ids = self.engine.tokenizer.encode(body.get("prompt") or "")
         _, matched = self.engine.scheduler.allocator.match_prefix(ids)
-        return web.json_response(
-            {"matched_tokens": matched, "total_tokens": len(ids)}
-        )
+        out = {"matched_tokens": matched, "total_tokens": len(ids)}
+        host_kv = getattr(self.engine, "host_kv", None)
+        if host_kv is not None:
+            # per-tier cached-prefix report: blocks the host tier could
+            # extend the HBM match with (KV-aware routers weight a host
+            # continuation below an HBM hit but far above a re-prefill)
+            bs = self.config.cache.block_size
+            n = host_kv.probe_extension(ids, matched // bs)
+            out["matched_tokens_host"] = n * bs
+        return web.json_response(out)
 
     async def kv_export(self, request: web.Request) -> web.Response:
         """Disaggregated-prefill KV handoff, producer side: stream the raw
@@ -1627,9 +1634,17 @@ class EngineServer:
             "pending_transfers": len(self._kv_transfers),
             "transfers": self.metrics.transfer_totals,
         }
+        # tiered-KV snapshot (hit/demote/promote counters, byte traffic,
+        # prefetch latency + overlap) — the /debug/fleet join and stacktop
+        # read it from here
+        tier_block = None
+        if (getattr(self.engine, "host_kv", None) is not None
+                or getattr(self.engine, "remote_kv", None) is not None):
+            tier_block = self.engine.tier_stats()
         if perf is None:
             return web.json_response({"enabled": False,
-                                      "kv_transfer": kv_block})
+                                      "kv_transfer": kv_block,
+                                      "kv_tier": tier_block})
         snap = perf.snapshot()
         eng = self.engine
         drafted = getattr(eng, "spec_drafted", 0)
@@ -1646,6 +1661,7 @@ class EngineServer:
             ),
         }
         snap["kv_transfer"] = kv_block
+        snap["kv_tier"] = tier_block
         return web.json_response(snap)
 
     async def memory_profile(self, request: web.Request) -> web.Response:
@@ -2886,7 +2902,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "tunnel's interpreter hook can pin the platform in "
                         "jax config before main() runs")
     p.add_argument("--host-offload-blocks", type=int, default=0,
-                   help="host-DRAM KV tier capacity (0 = off)")
+                   help="host-DRAM KV tier capacity in blocks (0 = off; "
+                        "prefer --kv-host-cache-bytes)")
+    p.add_argument("--kv-host-cache-bytes", type=int, default=0,
+                   help="host-DRAM KV tier capacity in BYTES (the "
+                        "authoritative knob; overrides "
+                        "--host-offload-blocks when both are set)")
+    p.add_argument("--kv-prefetch-workers", type=int, default=0,
+                   help="background threads for the async warm-tier "
+                        "prefix prefetch pipeline (0 = config default)")
     p.add_argument("--remote-kv-url", default=None,
                    help="shared remote KV server URL (kv_server)")
     # -- disaggregated prefill/decode (engine/kv_transfer.py) ------------
@@ -2983,6 +3007,10 @@ def config_from_args(args) -> EngineConfig:
         cfg.scheduler.max_queue_len = args.max_queue_len
     if args.host_offload_blocks:
         cfg.cache.host_offload_blocks = args.host_offload_blocks
+    if getattr(args, "kv_host_cache_bytes", 0):
+        cfg.cache.kv_host_cache_bytes = args.kv_host_cache_bytes
+    if getattr(args, "kv_prefetch_workers", 0):
+        cfg.cache.kv_prefetch_workers = args.kv_prefetch_workers
     if args.remote_kv_url:
         cfg.cache.remote_kv_url = args.remote_kv_url
     cfg.role = getattr(args, "role", "unified") or "unified"

@@ -5,7 +5,14 @@ the CacheServer CRD; tutorial 06-remote-shared-kv-cache there).
 Content-addressed block slabs over HTTP: engines PUT slabs keyed by the
 same allocator chain hashes they use locally, any engine GETs them back —
 so a conversation can continue on a different replica without recompute.
-Capacity-bounded LRU in memory.
+Capacity-bounded LRU in memory, hardened for fleet duty:
+
+- per-block body bound (``--max-block-bytes``): oversized PUTs get a clean
+  413 instead of ballooning the heap;
+- idle-TTL sweep (``--ttl-seconds``): blocks never re-read within the TTL
+  are expired by a background task, so one chatty engine can't pin the
+  whole tier forever;
+- ``/stats`` JSON + eviction/expiry/byte counters on ``/metrics``.
 
 Run: python -m production_stack_tpu.kv_server --port 8100
 """
@@ -13,46 +20,123 @@ Run: python -m production_stack_tpu.kv_server --port 8100
 from __future__ import annotations
 
 import argparse
+import asyncio
 import collections
+import contextlib
 import json
 import time
 
 from aiohttp import web
 
+_SWEEP_INTERVAL = 30.0  # seconds between TTL sweep passes
+
 
 class KVServer:
-    def __init__(self, capacity_blocks: int = 65536):
+    def __init__(self, capacity_blocks: int = 65536,
+                 max_block_bytes: int = 64 * 1024 * 1024,
+                 ttl_seconds: float = 0.0):
         self.capacity = capacity_blocks
-        self.blocks: "collections.OrderedDict[str, tuple[bytes, str]]" = (
+        self.max_block_bytes = max_block_bytes
+        self.ttl_seconds = ttl_seconds  # 0 = idle expiry disabled
+        self.blocks: "collections.OrderedDict[str, tuple[bytes, str, float]]" = (
             collections.OrderedDict()
-        )  # hash -> (raw bytes, meta json)
+        )  # hash -> (raw bytes, meta json, last access)
         self.hits = 0
         self.misses = 0
         self.puts = 0
+        self.evictions = 0
+        self.expired = 0
+        self.rejected = 0
+        self.used_bytes = 0
         self.start = time.time()
+        self._sweeper: asyncio.Task | None = None
 
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        # aiohttp enforces the bound too (413 before the handler runs for
+        # content-length'd bodies); small slack for headers-in-body framing
+        app = web.Application(client_max_size=self.max_block_bytes + 65536)
         app.router.add_put("/blocks/{key}", self.put_block)
         app.router.add_get("/blocks/{key}", self.get_block)
         app.router.add_post("/lookup", self.lookup)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/stats", self.stats)
         app.router.add_get("/metrics", self.metrics)
+        app.on_startup.append(self._start_sweeper)
+        app.on_cleanup.append(self._stop_sweeper)
         return app
+
+    # -- idle-TTL sweep ------------------------------------------------------
+
+    async def _start_sweeper(self, app) -> None:
+        if self.ttl_seconds > 0:
+            self._sweeper = asyncio.get_running_loop().create_task(
+                self._sweep_loop())
+
+    async def _stop_sweeper(self, app) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._sweeper
+            self._sweeper = None
+
+    async def _sweep_loop(self) -> None:
+        interval = min(_SWEEP_INTERVAL, max(self.ttl_seconds / 2, 1.0))
+        while True:
+            await asyncio.sleep(interval)
+            self.sweep_expired()
+
+    def sweep_expired(self, now: float | None = None) -> int:
+        """Drop blocks idle past the TTL; returns how many expired.
+        LRU order means the stalest entries are at the front — stop at the
+        first fresh one."""
+        if self.ttl_seconds <= 0:
+            return 0
+        now = time.time() if now is None else now
+        dropped = 0
+        while self.blocks:
+            key = next(iter(self.blocks))
+            data, _, last = self.blocks[key]
+            if now - last < self.ttl_seconds:
+                break
+            del self.blocks[key]
+            self.used_bytes -= len(data)
+            self.expired += 1
+            dropped += 1
+        return dropped
+
+    # -- handlers ------------------------------------------------------------
 
     async def health(self, request):
         return web.json_response({"status": "healthy"})
 
     async def put_block(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
+        if (request.content_length or 0) > self.max_block_bytes:
+            self.rejected += 1
+            return web.json_response(
+                {"error": "block exceeds max_block_bytes",
+                 "limit": self.max_block_bytes}, status=413)
         data = await request.read()
+        if len(data) > self.max_block_bytes:  # chunked bodies: no length hdr
+            self.rejected += 1
+            return web.json_response(
+                {"error": "block exceeds max_block_bytes",
+                 "limit": self.max_block_bytes}, status=413)
         meta = request.headers.get("X-KV-Meta", "{}")
+        now = time.time()
         if key in self.blocks:
+            old, _, _ = self.blocks[key]
+            self.used_bytes -= len(old)
+            self.blocks[key] = (data, meta, now)
+            self.used_bytes += len(data)
             self.blocks.move_to_end(key)
         else:
             while len(self.blocks) >= self.capacity:
-                self.blocks.popitem(last=False)
-            self.blocks[key] = (data, meta)
+                _, (old, _, _) = self.blocks.popitem(last=False)
+                self.used_bytes -= len(old)
+                self.evictions += 1
+            self.blocks[key] = (data, meta, now)
+            self.used_bytes += len(data)
             self.puts += 1
         return web.json_response({"stored": True})
 
@@ -62,9 +146,10 @@ class KVServer:
         if entry is None:
             self.misses += 1
             return web.json_response({"error": "not found"}, status=404)
+        data, meta, _ = entry
+        self.blocks[key] = (data, meta, time.time())
         self.blocks.move_to_end(key)
         self.hits += 1
-        data, meta = entry
         return web.Response(body=data, content_type="application/octet-stream",
                             headers={"X-KV-Meta": meta})
 
@@ -75,18 +160,46 @@ class KVServer:
             {"present": [k for k in keys if k in self.blocks]}
         )
 
+    def stats_dict(self) -> dict:
+        return {
+            "blocks": len(self.blocks),
+            "capacity_blocks": self.capacity,
+            "usage": len(self.blocks) / max(self.capacity, 1),
+            "bytes": self.used_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "puts": self.puts,
+            "evictions": self.evictions,
+            "expired": self.expired,
+            "rejected": self.rejected,
+            "ttl_seconds": self.ttl_seconds,
+            "max_block_bytes": self.max_block_bytes,
+            "uptime": time.time() - self.start,
+        }
+
+    async def stats(self, request):
+        return web.json_response(self.stats_dict())
+
     async def metrics(self, request):
         lines = [
             "# TYPE kvserver:blocks gauge",
             f"kvserver:blocks {len(self.blocks)}",
             "# TYPE kvserver:usage_perc gauge",
             f"kvserver:usage_perc {len(self.blocks) / max(self.capacity, 1)}",
+            "# TYPE kvserver:bytes gauge",
+            f"kvserver:bytes {self.used_bytes}",
             "# TYPE kvserver:hits_total counter",
             f"kvserver:hits_total {self.hits}",
             "# TYPE kvserver:misses_total counter",
             f"kvserver:misses_total {self.misses}",
             "# TYPE kvserver:puts_total counter",
             f"kvserver:puts_total {self.puts}",
+            "# TYPE kvserver:evictions_total counter",
+            f"kvserver:evictions_total {self.evictions}",
+            "# TYPE kvserver:expired_total counter",
+            f"kvserver:expired_total {self.expired}",
+            "# TYPE kvserver:rejected_total counter",
+            f"kvserver:rejected_total {self.rejected}",
         ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
@@ -97,8 +210,14 @@ def main(argv=None) -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8100)
     p.add_argument("--capacity-blocks", type=int, default=65536)
+    p.add_argument("--max-block-bytes", type=int, default=64 * 1024 * 1024,
+                   help="reject PUT bodies larger than this (413)")
+    p.add_argument("--ttl-seconds", type=float, default=0.0,
+                   help="expire blocks not re-read within this many "
+                        "seconds (0 = never)")
     args = p.parse_args(argv)
-    server = KVServer(args.capacity_blocks)
+    server = KVServer(args.capacity_blocks, args.max_block_bytes,
+                      args.ttl_seconds)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
 

@@ -65,6 +65,9 @@ def _engine_row(ep, probe: dict, estats, rstats, reasons: dict,
         "label": ep.model_label,
         "role": ep.role,
         "kv_transfer": perf.get("kv_transfer"),
+        # tiered-KV snapshot (tiers/bytes/prefetch) from /debug/perf —
+        # None for engines without host/remote tiers configured
+        "kv_tier": perf.get("kv_tier"),
         "status": status,
         "draining": ep.draining,
         "warming": status == "warming",

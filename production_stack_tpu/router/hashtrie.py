@@ -67,6 +67,32 @@ class HashTrie:
             selected = set(candidates)
         return match_len, selected
 
+    def endpoint_match_lengths(
+        self, text: str, available: Set[str]
+    ) -> dict[str, int]:
+        """Per-endpoint deepest-match depth in chars, for tier-weighted
+        scoring: unlike :meth:`longest_prefix_match` (which narrows to the
+        single deepest cohort), this reports how far EVERY available
+        endpoint has individually served this prefix, so the router can
+        trade a shallower match on a hot cache against a deeper match on a
+        cold one. Insert adds an endpoint to every node along its path, so
+        each child's endpoint set is a subset of its parent's — one walk
+        records the last depth each endpoint was still present at."""
+        depths: dict[str, int] = {}
+        node = self.root
+        depth = 0
+        for h in self._chunks(text):
+            node = node.children.get(h)
+            if node is None:
+                break
+            live = node.endpoints & available
+            if not live:
+                break
+            depth += self.chunk_size
+            for e in live:
+                depths[e] = depth
+        return depths
+
     def remove_endpoint(self, endpoint: str) -> None:
         """Drop a dead endpoint everywhere (stale-route prevention)."""
 

@@ -67,6 +67,11 @@ class EngineStats:
     gpu_prefix_cache_hits_total: int = 0
     gpu_prefix_cache_queries_total: int = 0
     gpu_cache_usage_perc: float = 0.0
+    # tiered-KV signal: per-tier prefix hit ratio keyed "hbm"/"host"/
+    # "remote" (vllm:kv_tier_hit_ratio{tier=...}). Empty when the engine
+    # has no warm tiers configured — routing degrades to boolean matching.
+    kv_tier_hit_ratio: dict[str, float] = dataclasses.field(default_factory=dict)
+    kv_prefetch_overlap_fraction: float = 0.0
 
     _PARSE_MAP = {
         "vllm:num_requests_running": "num_running_requests",
@@ -75,6 +80,7 @@ class EngineStats:
         "vllm:gpu_prefix_cache_hits_total": "gpu_prefix_cache_hits_total",
         "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
         "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
+        "vllm:kv_prefetch_overlap_fraction": "kv_prefetch_overlap_fraction",
     }
 
     @classmethod
@@ -84,6 +90,12 @@ class EngineStats:
         stats = cls()
         for family in text_string_to_metric_families(text):
             for sample in family.samples:
+                # labeled tier family first: the flat map drops labels
+                if sample.name == "vllm:kv_tier_hit_ratio":
+                    tier = sample.labels.get("tier")
+                    if tier:
+                        stats.kv_tier_hit_ratio[tier] = sample.value
+                    continue
                 attr = cls._PARSE_MAP.get(sample.name)
                 if attr is not None:
                     setattr(stats, attr, sample.value)

@@ -131,7 +131,47 @@ class SessionRouter(Router):
         return url if url else self._qps_fallback(endpoints, request_stats)
 
 
+# Static tier weights for expected-cached-prefix scoring: relative value of
+# a prefix token resident in each tier, normalised to HBM = 1.0. The warm
+# weights approximate 1 - t_import/t_recompute from the measured import
+# bandwidths (host DMA ~24 GB/s, remote HTTP ~8 GB/s on the bench fleet vs
+# prefill recompute) — see tier_import_weight() for the derivation used when
+# live bandwidth numbers are available.
+TIER_WEIGHTS = {"hbm": 1.0, "host": 0.7, "remote": 0.35}
+
+
+def tier_import_weight(import_gbps: float, recompute_gbps: float) -> float:
+    """Weight of a warm tier from measured bandwidths.
+
+    A cached block is only worth routing toward if importing it beats
+    recomputing it: w = max(0, 1 - bw_recompute / bw_import). A tier whose
+    import path is no faster than prefill recompute contributes nothing
+    (w=0); an infinitely fast import approaches the HBM weight of 1.
+    """
+    if import_gbps <= 0:
+        return 0.0
+    return max(0.0, 1.0 - recompute_gbps / import_gbps)
+
+
 class PrefixAwareRouter(Router):
+    """Prefix-locality routing, tier-aware.
+
+    The trie answers "how many prompt chars has each endpoint served
+    before"; the engine's scraped per-tier hit ratios answer "how much of
+    what it served is still resident, and in which tier". Score is the
+    expected *useful* cached prefix length:
+
+        score(ep) = depth(ep) * (W_hbm*r_hbm
+                                 + W_host*r_host*(1-r_hbm)
+                                 + W_remote*r_remote*(1-r_hbm)*(1-r_host))
+
+    where r_t is the endpoint's measured tier hit ratio — a proxy for the
+    survival probability of a previously-served block in that tier (warm
+    tiers only matter for the share the hotter tiers already missed).
+    Endpoints with no tier data score depth * 1.0, so a stats-less fleet
+    degenerates to the boolean deepest-match behaviour.
+    """
+
     def __init__(self, prefix_min_match_length: int = 0, chunk_size: int = 128,
                  use_native_trie: bool = True, **_):
         self.trie = None
@@ -145,6 +185,52 @@ class PrefixAwareRouter(Router):
             self.trie = HashTrie(chunk_size=chunk_size)
         self.min_match = prefix_min_match_length
 
+    @staticmethod
+    def _tier_factor(stats: Optional[EngineStats]) -> float:
+        """Expected fraction of a previously-served prefix that is still
+        cheaply reachable, tier-weighted. 1.0 when the endpoint exposes no
+        tier ratios (no warm tiers configured, or never scraped)."""
+        ratios = getattr(stats, "kv_tier_hit_ratio", None) if stats else None
+        if not ratios:
+            return 1.0
+        r_hbm = min(max(ratios.get("hbm", 0.0), 0.0), 1.0)
+        r_host = min(max(ratios.get("host", 0.0), 0.0), 1.0)
+        r_remote = min(max(ratios.get("remote", 0.0), 0.0), 1.0)
+        return (
+            TIER_WEIGHTS["hbm"] * r_hbm
+            + TIER_WEIGHTS["host"] * r_host * (1.0 - r_hbm)
+            + TIER_WEIGHTS["remote"] * r_remote * (1.0 - r_hbm) * (1.0 - r_host)
+        )
+
+    def score_endpoints(
+        self,
+        prompt: str,
+        available: set,
+        matched: set,
+        match_len: int,
+        engine_stats: dict[str, EngineStats],
+    ) -> dict[str, float]:
+        """Expected-cached-prefix score per candidate endpoint.
+
+        With the per-endpoint depth walk the candidate set is every
+        available endpoint that matched at least ``min_match`` chars — not
+        just the deepest cohort — so a shallower match on an endpoint whose
+        cache is measurably hotter can beat a deeper match on a cold one.
+        The native trie only reports the deepest cohort; there every member
+        shares match_len and only the tier factors differentiate."""
+        if hasattr(self.trie, "endpoint_match_lengths"):
+            depths = self.trie.endpoint_match_lengths(prompt, available)
+            floor = max(self.min_match, 1)
+            candidates = {u: d for u, d in depths.items() if d >= floor}
+            if not candidates:  # min_match above every depth: deepest cohort
+                candidates = {u: match_len for u in matched}
+        else:
+            candidates = {u: match_len for u in matched}
+        return {
+            url: depth * self._tier_factor(engine_stats.get(url))
+            for url, depth in candidates.items()
+        }
+
     async def route_request(self, endpoints, engine_stats, request_stats,
                             headers, request_json) -> str:
         prompt = extract_prompt(request_json)
@@ -154,7 +240,11 @@ class PrefixAwareRouter(Router):
             # fallback still inserts, otherwise affinity never bootstraps
             url = self._qps_fallback(endpoints, request_stats)
         else:
-            url = random.choice(sorted(matched))
+            scores = self.score_endpoints(prompt, available, matched,
+                                          match_len, engine_stats or {})
+            best = max(scores.values())
+            top = [u for u, s in scores.items() if s >= best - 1e-9]
+            url = random.choice(sorted(top))
         self.trie.insert(prompt, url)
         return url
 

@@ -168,6 +168,81 @@ class ChaosFleet:
         self.engines[i].fault_state.set(None)
 
 
+class ChaosKVServer:
+    """The remote KV tier (kv_server.KVServer) behind fault levers.
+
+    The tier chaos drills (docs/kv_tiering.md failure matrix) need a REAL
+    kv_server on a real socket whose responses can be corrupted mid-drill:
+    the engine's RemoteKVClient must turn a corrupt or short block body
+    into a clean miss (re-prefill), never an import of garbage. Modes:
+
+      None        healthy passthrough
+      corrupt     block GET bodies are garbled AND length-shifted, so the
+                  client's frombuffer/reshape validation must reject them
+      truncate    block GET bodies are cut to half length (short read)
+      hang        block GETs stall ``hang_seconds`` before answering —
+                  drives the client's get_timeout deadline
+      down        every request answers 503
+    """
+
+    def __init__(self, capacity_blocks: int = 4096, **kw):
+        from production_stack_tpu.kv_server import KVServer
+
+        self.server = KVServer(capacity_blocks, **kw)
+        self.mode: Optional[str] = None
+        self.hang_seconds = 5.0
+        self._ts: Optional[TestServer] = None
+
+    def set_mode(self, mode: Optional[str]) -> None:
+        if mode not in (None, "corrupt", "truncate", "hang", "down"):
+            raise ValueError(f"unknown kv chaos mode {mode!r}")
+        self.mode = mode
+
+    def build_app(self) -> web.Application:
+        app = self.server.build_app()
+
+        @web.middleware
+        async def chaos(request, handler):
+            if self.mode == "down":
+                return web.json_response({"error": "chaos: down"},
+                                         status=503)
+            is_block_get = (request.method == "GET"
+                            and request.path.startswith("/blocks/"))
+            if self.mode == "hang" and is_block_get:
+                await asyncio.sleep(self.hang_seconds)
+            resp = await handler(request)
+            if (is_block_get and resp.status == 200
+                    and self.mode in ("corrupt", "truncate")):
+                body = bytes(resp.body)
+                if self.mode == "truncate":
+                    body = body[: len(body) // 2]
+                else:
+                    # garble and shift length so dtype-sized reads break
+                    body = bytes(b ^ 0xA5 for b in body[:-3]) or b"\x00"
+                return web.Response(
+                    body=body, content_type="application/octet-stream",
+                    headers={"X-KV-Meta": resp.headers.get("X-KV-Meta",
+                                                           "{}")})
+            return resp
+
+        app.middlewares.append(chaos)
+        return app
+
+    async def start(self) -> str:
+        self._ts = TestServer(self.build_app())
+        await self._ts.start_server()
+        return self.url
+
+    @property
+    def url(self) -> str:
+        assert self._ts is not None, "ChaosKVServer not started"
+        return f"http://127.0.0.1:{self._ts.port}"
+
+    async def stop(self) -> None:
+        if self._ts is not None:
+            await self._ts.close()
+
+
 class ChaosScenario:
     """Apply a script of timed events to a fleet.
 

@@ -396,6 +396,52 @@ def test_disagg_surface_is_inside_the_gates():
     assert "kvTransferWindow" in engine_tmpl
 
 
+def test_kv_tiering_surface_is_inside_the_gates():
+    """The tiered-KV surface (PR: host/remote tiers + async prefetch +
+    tier-aware routing) is covered by the gates, not grandfathered:
+    config-drift sees the tiering flags as declared CLI flags (a helm
+    kvTiering template typo would be an active finding), and
+    metric-hygiene tracks both tier metric families as defined in code
+    AND documented — so renaming vllm:kv_tier_hit_ratio, or deleting its
+    docs/observability.md row, fails test_repo_has_no_active_findings."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    engine_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "engine" / "server.py")
+    assert {"--kv-host-cache-bytes", "--kv-prefetch-workers",
+            "--host-offload-blocks", "--remote-kv-url"} <= engine_flags
+    kvsrv_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "kv_server.py")
+    assert {"--capacity-blocks", "--max-block-bytes",
+            "--ttl-seconds"} <= kvsrv_flags
+
+    # exposition adds _total to the counter; the gate pins base names
+    tiering = {"vllm:kv_tier_hit_ratio", "vllm:kv_tier_bytes",
+               "vllm:kv_prefetch_seconds",
+               "vllm:kv_prefetch_overlap_fraction"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert tiering <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert tiering <= documented
+
+    # the chart's kvTiering block must stay consumed by the engine
+    # deployment template, the cache-server hardening knobs by its
+    # template, and the CI values must exercise the host tier (the
+    # tier-1 chart tests render values-ci.yaml)
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert "kvTiering:" in values and "maxBlockBytes:" in values
+    values_ci = (REPO / "helm" / "values-ci.yaml").read_text()
+    assert "kvTiering:" in values_ci and "hostCacheBytes:" in values_ci
+    engine_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-engine.yaml").read_text()
+    assert ("--kv-host-cache-bytes" in engine_tmpl
+            and "--kv-prefetch-workers" in engine_tmpl)
+    cs_tmpl = (REPO / "helm" / "templates"
+               / "deployment-cache-server.yaml").read_text()
+    assert "--max-block-bytes" in cs_tmpl and "--ttl-seconds" in cs_tmpl
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

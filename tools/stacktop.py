@@ -26,8 +26,8 @@ import urllib.request
 # single-chip one compare directly in the same table.
 COLUMNS = (
     ("ENGINE", 28), ("MODEL", 14), ("ROLE", 7), ("STATUS", 10), ("CHIPS", 5),
-    ("MFU", 6), ("ICI", 6), ("HBM", 12), ("KVFREE", 7), ("WAIT", 5),
-    ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
+    ("MFU", 6), ("ICI", 6), ("HBM", 12), ("KVFREE", 7), ("HOSTHIT", 7),
+    ("WAIT", 5), ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
 )
 
 
@@ -50,6 +50,17 @@ def _fmt_hbm(used, total) -> str:
     return f"{used / gib:.1f}/{total / gib:.1f}G"
 
 
+def _fmt_host_hit(row: dict) -> str:
+    """Warm-tier (host DRAM) KV hit ratio from the engine's kv_tier
+    snapshot; '-' for engines without tiering or before the first query."""
+    tiers = (row.get("kv_tier") or {}).get("tiers") or {}
+    host = tiers.get("host") or {}
+    queries = host.get("queries") or 0
+    if not queries:
+        return "-"
+    return f"{host.get('hits', 0) / queries * 100:.1f}%"
+
+
 def _clip(s: str, width: int) -> str:
     s = str(s)
     return s if len(s) <= width else s[: width - 1] + "…"
@@ -66,6 +77,7 @@ def engine_row_cells(row: dict) -> list:
         _fmt_pct(row.get("ici")),
         _fmt_hbm(row.get("hbm_used_bytes"), row.get("hbm_total_bytes")),
         _fmt_pct(row.get("kv_free")),
+        _fmt_host_hit(row),
         _fmt_num(row.get("waiting"), "d"),
         _fmt_num(row.get("running"), "d"),
         _fmt_num(row.get("qps")),
