@@ -624,6 +624,7 @@ def run_bench() -> None:
         "metric": f"output throughput ({model}, {quant or 'bf16'}, "
                   f"{num_seqs} concurrent, "
                   f"{prompt_len}p/{out_len}o, 1 chip)",
+        "status": "ok",
         "value": round(tok_per_s, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_per_s / target, 3),
@@ -805,6 +806,21 @@ def _run_child(ready_timeout: float, timeout: float) -> tuple[dict | None, str]:
     return None, f"no JSON line (rc={proc.returncode}): {tail}{axon}"
 
 
+def _failure_class(diags: list[str]) -> str:
+    """Collapse the diagnostics into one machine-readable class so the
+    round artifact (and anything grepping a directory of them) can
+    separate real perf regressions from infra weather without parsing
+    free-text errors."""
+    last = diags[-1] if diags else ""
+    if "BACKEND-READY" in last or "backend init" in last:
+        return "backend-init-timeout"
+    if "watchdog" in last:
+        return "bench-watchdog-timeout"
+    if "no JSON line" in last:
+        return "no-json-output"
+    return "unknown"
+
+
 def main() -> None:
     if os.environ.get("_PSTPU_BENCH_CHILD") == "1":
         run_bench()
@@ -830,6 +846,8 @@ def main() -> None:
     def _flush_artifact(signum, frame):
         print(json.dumps({
             "metric": "output throughput (backend unavailable)",
+            "status": "infra_failure",
+            "failure_class": "terminated-mid-claim",
             "value": 0.0,
             "unit": "tok/s/chip",
             "vs_baseline": 0.0,
@@ -887,6 +905,8 @@ def main() -> None:
         uniq[e] = uniq.get(e, 0) + 1
     print(json.dumps({
         "metric": "output throughput (backend unavailable)",
+        "status": "infra_failure",
+        "failure_class": _failure_class(errors),
         "value": 0.0,
         "unit": "tok/s/chip",
         "vs_baseline": 0.0,

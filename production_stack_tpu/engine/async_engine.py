@@ -102,11 +102,13 @@ class AsyncEngine:
         while True:
             kind, payload = item
             if kind == "add":
-                rid, prompt_ids, sampling, adapter_slot = payload
+                # 4-tuple (legacy) or 5-tuple with the tenant identity
+                rid, prompt_ids, sampling, adapter_slot = payload[:4]
+                tenant = payload[4] if len(payload) > 4 else "anonymous"
                 try:
                     self.engine.add_request(
                         rid, prompt_token_ids=prompt_ids, sampling=sampling,
-                        adapter_slot=adapter_slot,
+                        adapter_slot=adapter_slot, tenant=tenant,
                     )
                 except Exception as e:  # surfaced on the request's stream
                     if self.loop is not None:
@@ -168,12 +170,14 @@ class AsyncEngine:
         sampling: SamplingParams,
         request_id: Optional[str] = None,
         adapter_slot: int = 0,
+        tenant: str = "anonymous",
     ) -> AsyncIterator[RequestOutput]:
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         q: asyncio.Queue = asyncio.Queue()
         self.streams[rid] = q
         self.intake.put(
-            ("add", (rid, list(prompt_token_ids), sampling, adapter_slot))
+            ("add", (rid, list(prompt_token_ids), sampling, adapter_slot,
+                     tenant))
         )
         async for item in self._consume(rid, q):
             yield item
@@ -182,7 +186,7 @@ class AsyncEngine:
         self, requests: list
     ) -> list[AsyncIterator[RequestOutput]]:
         """Atomically admit requests (rid, prompt_ids, sampling,
-        adapter_slot) on the engine thread — all-or-nothing.
+        adapter_slot[, tenant]) on the engine thread — all-or-nothing.
 
         Unlike generate(), which enqueues the add and surfaces admission
         failures later on the stream, this waits for admission to complete
@@ -201,9 +205,12 @@ class AsyncEngine:
         def add_all(eng):
             added = []
             try:
-                for rid, ids, sp, slot in requests:
+                for req in requests:
+                    rid, ids, sp, slot = req[:4]
+                    tenant = req[4] if len(req) > 4 else "anonymous"
                     eng.add_request(rid, prompt_token_ids=list(ids),
-                                    sampling=sp, adapter_slot=slot)
+                                    sampling=sp, adapter_slot=slot,
+                                    tenant=tenant)
                     added.append(rid)
             except Exception:
                 for r in added:
@@ -232,6 +239,7 @@ class AsyncEngine:
         sampling: SamplingParams,
         blocks: list[int],
         adapter_slot: int = 0,
+        tenant: str = "anonymous",
     ) -> AsyncIterator[RequestOutput]:
         """Splice a pushed P→D transfer in as a decode-ready sequence
         (engine.splice_request) and return its output stream. Mirrors
@@ -245,7 +253,7 @@ class AsyncEngine:
         def do_splice(eng):
             eng.splice_request(request_id, list(prompt_token_ids),
                                first_token, sampling, blocks,
-                               adapter_slot=adapter_slot)
+                               adapter_slot=adapter_slot, tenant=tenant)
 
         try:
             await self.run_on_engine(do_splice)

@@ -577,6 +577,18 @@ class EngineConfig:
     # (int16 transition tables; 8 x 128 x 128k = 256 MB)
     max_grammars: int = 8
     max_grammar_states: int = 128
+    # tenant attribution plane (production_stack_tpu/tenancy.py):
+    # per-tenant token/chip-second metering in the perf accountant plus
+    # the per-request usage ledger. Observe-only — disabling it changes
+    # no scheduling decision and no fleet-total metric value.
+    tenant_metering: bool = True
+    # top-K label bound for every per-tenant export (remainder folds
+    # into tenant="other" — the cardinality policy)
+    tenant_top_k: int = 8
+    # durable usage ledger: rotating JSONL of per-request usage records;
+    # empty path = ledger off (metering gauges still work)
+    tenant_ledger_path: str = ""
+    tenant_ledger_max_bytes: int = 16 << 20
 
     @staticmethod
     def for_model(name: str, **kw) -> "EngineConfig":

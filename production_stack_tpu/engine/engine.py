@@ -31,6 +31,7 @@ from production_stack_tpu.engine.sequence import (
 )
 from production_stack_tpu.engine.tokenizer import get_tokenizer
 from production_stack_tpu.parallel.mesh import build_mesh
+from production_stack_tpu.tenancy import split_shares
 
 
 class GrammarBankFull(ValueError):
@@ -262,6 +263,7 @@ class LLMEngine:
         prompt_token_ids: Optional[Seq[int]] = None,
         sampling: Optional[SamplingParams] = None,
         adapter_slot: int = 0,
+        tenant: str = "anonymous",
     ) -> Sequence:
         if prompt_token_ids is None:
             assert prompt is not None, "prompt or prompt_token_ids required"
@@ -299,6 +301,7 @@ class LLMEngine:
 
         seq = Sequence(request_id, list(prompt_token_ids), sampling,
                        adapter_slot=adapter_slot,
+                       tenant=tenant or "anonymous",
                        token_ctrl=make_token_controls(
                            sampling, self.config.model.vocab_size))
         if sampling.guided_regex is not None or sampling.guided_json is not None:
@@ -658,6 +661,7 @@ class LLMEngine:
         slot_mapping = np.full(S, -1, np.int32)
         slot_mapping[:n] = slot_mapping_for(seq.block_ids, 0, n, bs)
         s = seq.sampling
+        t_dispatch = time.monotonic()
         result = self.runner.prefill_ring(
             tokens, positions, slot_mapping,
             np.asarray([n - 1], np.int32),
@@ -675,7 +679,11 @@ class LLMEngine:
             ),
         )
         if self.perf is not None:
-            self.perf.record_prefill(n, n, 1)
+            dispatch_s = time.monotonic() - t_dispatch
+            entries = [(seq, "prefill", n, n)]
+            self.perf.record_prefill(n, n, 1, seconds=dispatch_s,
+                                     tenants=self._tenant_map(entries))
+            self._attribute_seq_seconds(dispatch_s, entries)
         seq.num_computed_tokens = n
         seq.status = SequenceStatus.RUNNING
         self._slot_seq[seq.slot] = seq
@@ -692,6 +700,36 @@ class LLMEngine:
             if seq.sampling.logprobs is not None else [None]
         )
         return self._postprocess([seq], [[token]], lp_lists)
+
+    # -- tenant attribution (observe-only; production_stack_tpu/tenancy.py) --
+    def _tenant_map(self, entries) -> Optional[dict]:
+        """Per-tenant token shares of one dispatch, from ``(seq, phase,
+        goodput_tokens, live_tokens)`` rows: goodput feeds the per-tenant
+        phase counters, live tokens weight the chip-second split. None
+        when metering is off — the record_* calls then skip attribution
+        entirely (bit-identical fleet totals either way)."""
+        if self.perf is None or not self.perf.tenant_metering:
+            return None
+        tmap: dict = {}
+        for seq, phase, goodput, live in entries:
+            rec = tmap.setdefault(
+                seq.tenant, {"prefill": 0, "decode": 0, "live": 0})
+            rec[phase] += goodput
+            rec["live"] += live
+        return tmap
+
+    def _attribute_seq_seconds(self, seconds: float, entries) -> None:
+        """Ledger-grade per-sequence split of one dispatch's wall time by
+        the same live-token weights as the tenant-level split — a
+        sequence's accumulated ``chip_seconds`` lands in its usage-ledger
+        record at finish."""
+        if (self.perf is None or not self.perf.tenant_metering
+                or seconds <= 0 or not entries):
+            return
+        shares = split_shares(
+            seconds, {seq.request_id: live for seq, _, _, live in entries})
+        for seq, _, _, _ in entries:
+            seq.chip_seconds += shares.get(seq.request_id, 0.0)
 
     def _run_prefill(self, prefills: list) -> list[RequestOutput]:
         if prefills[0].ring:
@@ -763,6 +801,7 @@ class LLMEngine:
                     c_ids[i], c_vals[i], c_mode[i] = sp.seq.token_ctrl
             ctrl = (c_ids, c_vals, c_mode)
         use_grammar = bool((g_ids >= 0).any())
+        t_dispatch = time.monotonic()
         sampled_dev = self.runner.prefill(
             tokens, positions, tables, context_lens, slot_mapping.reshape(-1),
             last_idx, temps, top_ps, top_ks, seeds, greedy_only=greedy_only,
@@ -772,10 +811,15 @@ class LLMEngine:
             fetch=False,
         )
         if self.perf is not None:
+            dispatch_s = time.monotonic() - t_dispatch
+            entries = [(sp.seq, "prefill", sp.chunk_len, sp.chunk_len)
+                       for sp in prefills]
             self.perf.record_prefill(
                 sum(sp.chunk_len for sp in prefills),
                 int(context_lens.sum()), len(prefills),
+                seconds=dispatch_s, tenants=self._tenant_map(entries),
             )
+            self._attribute_seq_seconds(dispatch_s, entries)
 
         # scheduler-visible state advances NOW (the next step's scheduling
         # depends on it); the sampled tokens are fetched one step LATER so
@@ -878,6 +922,11 @@ class LLMEngine:
         spec_rows: list[tuple[int, Sequence, list[int]]] = []
         p_tokens = p_ctx = p_rows = d_ctx = 0
         sp_tokens = sp_ctx = 0
+        # (seq, phase, goodput, live) per packed row: the tenant
+        # attribution shares of this fused dispatch (draft tokens carry
+        # live weight but no goodput — they only become goodput if
+        # accepted, via record_spec_accepted)
+        t_entries: list[tuple] = []
         for slot in range(B):
             ent = rows.get(slot)
             if ent is None:
@@ -916,6 +965,7 @@ class LLMEngine:
                     sp_ctx += pos + n
                 cu += n
                 d_ctx += pos + 1
+                t_entries.append((seq, "decode", 1, n))
             else:
                 sp = obj
                 seq = sp.seq
@@ -945,6 +995,7 @@ class LLMEngine:
                 p_tokens += n
                 p_ctx += sp.chunk_start + n
                 p_rows += 1
+                t_entries.append((seq, "prefill", n, n))
             nb = len(seq.block_ids)
             self._block_tables[slot, :nb] = seq.block_ids
             self._r_last_idx[slot] = cu - 1
@@ -976,6 +1027,7 @@ class LLMEngine:
             self._count_reset_slots.clear()
         use_controls = any(s.token_ctrl is not None for s in seqs_in_step)
         use_grammar = bool((self._g_ids >= 0).any())
+        t_dispatch = time.monotonic()
         result_dev = self.runner.ragged_step(
             self._r_tokens, self._r_positions, self._block_tables,
             self._context_lens, self._r_cu, self._r_slot_mapping,
@@ -997,10 +1049,14 @@ class LLMEngine:
         if self.perf is not None:
             # draft/verify spans are prefill-shaped work with zero goodput;
             # accepted tokens land as decode goodput at resolve time
+            dispatch_s = time.monotonic() - t_dispatch
             self.perf.record_ragged(p_tokens, p_ctx, p_rows,
                                     len(decodes), d_ctx,
                                     spec_tokens=sp_tokens, spec_ctx=sp_ctx,
-                                    spec_rows=len(spec_rows))
+                                    spec_rows=len(spec_rows),
+                                    seconds=dispatch_s,
+                                    tenants=self._tenant_map(t_entries))
+            self._attribute_seq_seconds(dispatch_s, t_entries)
         self.ragged_dispatches += 1
         self.ragged_live_tokens += cu
 
@@ -1037,6 +1093,7 @@ class LLMEngine:
             "decode_rows": decode_rows,
             "spec_rows": spec_rows,
             "result": result_dev,
+            "tenant_entries": t_entries,
         }
         if spec_rows:
             # acceptance decides how far each spec row really advanced —
@@ -1051,9 +1108,21 @@ class LLMEngine:
             return []
         pending = self._pending_ragged
         self._pending_ragged = None
+        t_fetch = time.monotonic()
         fetched = tuple(
             np.asarray(x) for x in jax.device_get(pending["result"])
         )
+        if self.perf is not None:
+            # the blocking result fetch is dispatch wall time too — billed
+            # by the same live-token shares so conservation spans the
+            # dispatch/resolve split
+            entries = pending.get("tenant_entries") or []
+            fetch_s = time.monotonic() - t_fetch
+            tmap = self._tenant_map(entries)
+            if tmap:
+                self.perf.attribute_seconds(
+                    {t: rec["live"] for t, rec in tmap.items()}, fetch_s)
+            self._attribute_seq_seconds(fetch_s, entries)
         return self._finish_ragged(pending, fetched)
 
     def _finish_ragged(self, pending, fetched) -> list[RequestOutput]:
@@ -1101,7 +1170,8 @@ class LLMEngine:
             if self.perf is not None and len(new_toks) > 1:
                 # the guaranteed token was already counted as decode
                 # goodput at dispatch; accepted drafts land here
-                self.perf.record_spec_accepted(len(new_toks) - 1)
+                self.perf.record_spec_accepted(len(new_toks) - 1,
+                                               tenant=seq.tenant)
             live.append(seq)
             token_lists.append(new_toks)
             lp_lists.append(None)  # spec rows never request logprobs
@@ -1217,6 +1287,7 @@ class LLMEngine:
                     self.runner.set_count_row(seq.slot, seq.output_token_ids)
             self._count_reset_slots.clear()
         use_controls = any(s.token_ctrl is not None for s in decodes)
+        t_dispatch = time.monotonic()
         result = self.runner.decode_multi(
             self._tokens, self._positions, self._block_tables,
             self._context_lens, self._slot_mapping,
@@ -1234,10 +1305,14 @@ class LLMEngine:
             want_logprobs=use_logprobs,
         )
         if self.perf is not None:
+            dispatch_s = time.monotonic() - t_dispatch
+            K = max(self.config.scheduler.multi_step, 1)
+            entries = [(seq, "decode", K, K) for seq in decodes]
             self.perf.record_decode(
-                len(decodes), max(self.config.scheduler.multi_step, 1),
-                int(self._context_lens.sum()),
+                len(decodes), K, int(self._context_lens.sum()),
+                seconds=dispatch_s, tenants=self._tenant_map(entries),
             )
+            self._attribute_seq_seconds(dispatch_s, entries)
         if can_chain:
             sampled, next_tok = result
             # defer: speculative num_computed advance (the scheduler's
@@ -1330,6 +1405,9 @@ class LLMEngine:
                 self._slot_seq.pop(seq.slot, None)
                 self._release_grammar(seq)
                 seq.finish_time = time.monotonic()
+                if self.perf is not None and seq.admit_time is not None:
+                    self.perf.note_request(
+                        seq.tenant, seq.admit_time - seq.arrival_time)
             outputs.append(
                 RequestOutput(
                     request_id=seq.request_id,
@@ -1339,6 +1417,8 @@ class LLMEngine:
                     num_prompt_tokens=seq.num_prompt_tokens,
                     num_output_tokens=len(seq.output_token_ids),
                     num_cached_tokens=seq.num_cached_tokens,
+                    tenant=seq.tenant,
+                    chip_seconds=seq.chip_seconds,
                     block_ids=(seq.released_block_ids if status is not None
                                else None),
                     arrival_time=(seq.arrival_time if status is not None
@@ -1426,6 +1506,7 @@ class LLMEngine:
         sampling: "SamplingParams",
         blocks: list[int],
         adapter_slot: int = 0,
+        tenant: str = "anonymous",
     ) -> Sequence:
         """Engine-thread: turn a completed P→D transfer into a RUNNING
         decode row. The sequence enters with the prompt fully computed
@@ -1448,6 +1529,7 @@ class LLMEngine:
 
         seq = Sequence(request_id, list(prompt_token_ids), sampling,
                        adapter_slot=adapter_slot,
+                       tenant=tenant or "anonymous",
                        token_ctrl=make_token_controls(
                            sampling, self.config.model.vocab_size))
         seq.output_token_ids = [int(first_token)]
@@ -1529,7 +1611,20 @@ class LLMEngine:
             out["kv_tier"] = self.tier_stats()
         if self.perf is not None:
             out["perf"] = self.perf.stats_fields()
+            out["tenants"] = self.tenant_stats()
         return out
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant attribution snapshot (tokens by phase, chip-seconds,
+        live KV blocks, request/queue-time sums), top-K folded — feeds
+        ``vllm:tenant_*`` series, ``/debug/tenants`` and the fleet view.
+        Empty-shaped when perf accounting is off."""
+        if self.perf is None:
+            return {"enabled": False, "tenants": {}}
+        kv: dict[str, int] = {}
+        for seq in self.scheduler.seqs.values():
+            kv[seq.tenant] = kv.get(seq.tenant, 0) + len(seq.block_ids)
+        return self.perf.tenant_fields(kv_blocks=kv)
 
     def tier_stats(self) -> dict:
         """Tiered-KV snapshot: per-tier hit/miss/demote/promote counters,

@@ -20,6 +20,8 @@ from prometheus_client.core import (
     SummaryMetricFamily,
 )
 
+from production_stack_tpu.tenancy import fold_records
+
 if TYPE_CHECKING:
     from production_stack_tpu.engine.engine import LLMEngine
 
@@ -241,6 +243,58 @@ class EngineStatsCollector:
                 "— a shape leaked past warmup (bug signal)",
                 perf["unexpected_recompiles"],
             )
+        # tenant attribution plane (production_stack_tpu/tenancy.py):
+        # per-tenant consumption, label set bounded by the top-K +
+        # tenant="other" policy. The engine folds before exporting;
+        # fold_records here is defense-in-depth (idempotent) so this
+        # exposition can never exceed top_k+1 tenant label values even
+        # if an upstream snapshot ever arrives unfolded.
+        tn = s.get("tenants")
+        if tn and tn.get("enabled") and tn.get("tenants"):
+            folded = fold_records(tn["tenants"], k=tn.get("top_k", 8),
+                                  weight_key="chip_seconds")
+            tok = CounterMetricFamily(
+                "vllm:tenant_tokens",
+                "Live tokens attributed per tenant and phase (prefill "
+                "chunk tokens / decode goodput incl. accepted drafts); "
+                "sums to the vllm:tokens_per_second totals",
+                labels=["model_name", "tenant", "phase"],
+            )
+            chip = CounterMetricFamily(
+                "vllm:tenant_chip_seconds",
+                "Chip-seconds attributed per tenant: each dispatch's wall "
+                "time split by the tenant's live-token share of the packed "
+                "stream (conserves: per-tenant sum == total dispatch "
+                "seconds)",
+                labels=["model_name", "tenant"],
+            )
+            kvb = GaugeMetricFamily(
+                "vllm:tenant_kv_blocks",
+                "KV blocks currently held by each tenant's live sequences",
+                labels=["model_name", "tenant"],
+            )
+            queue = SummaryMetricFamily(
+                "vllm:tenant_queue_time_seconds",
+                "Queue wait (arrival to scheduler admission) per tenant "
+                "over finished requests",
+                labels=["model_name", "tenant"],
+            )
+            for tenant, row in sorted(folded.items()):
+                tok.add_metric([self.model_name, tenant, "prefill"],
+                               row.get("prefill_tokens", 0))
+                tok.add_metric([self.model_name, tenant, "decode"],
+                               row.get("decode_tokens", 0))
+                chip.add_metric([self.model_name, tenant],
+                                row.get("chip_seconds", 0.0))
+                kvb.add_metric([self.model_name, tenant],
+                               row.get("kv_blocks", 0))
+                queue.add_metric([self.model_name, tenant],
+                                 row.get("requests", 0),
+                                 row.get("queue_seconds_sum", 0.0))
+            yield tok
+            yield chip
+            yield kvb
+            yield queue
         # tiered KV cache (engine/kv_offload.py): per-tier hit ratios and
         # byte-accounted traffic the router's tier-weighted prefix scoring
         # scrapes, plus the async prefetch pipeline's latency histogram

@@ -39,7 +39,9 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
+
+from production_stack_tpu.tenancy import fold_records, split_shares
 
 # docs/roofline.md ("Rooflines (v5e: 197 TFLOP/s bf16, 819 GB/s HBM)")
 V5E_PEAK_TFLOPS = 197.0
@@ -141,7 +143,8 @@ class PerfAccountant:
                  window: float = 60.0, peak_tflops: float = 0.0,
                  peak_hbm_gbps: float = 0.0, hbm_poll_interval: float = 5.0,
                  n_chips: int = 1, tensor_parallel: int = 1,
-                 peak_ici_gbps: float = 0.0):
+                 peak_ici_gbps: float = 0.0, tenant_metering: bool = True,
+                 tenant_top_k: int = 8):
         self.window = max(window, 1.0)
         self.n_chips = max(int(n_chips), 1)
         self.tp = max(int(tensor_parallel), 1)
@@ -196,6 +199,22 @@ class PerfAccountant:
         # its capture thread and returns).
         self.anomaly_hook: Optional[Callable[[str, dict], None]] = None
         self.hbm_threshold = 0.0  # fraction of HBM; 0 = disabled
+        # -- tenant attribution plane (production_stack_tpu/tenancy.py) --
+        # Per-tenant cumulative counters, fed by the same record_* calls
+        # that bill the fleet-wide window: every dispatch's wall seconds
+        # split by each tenant's live-token share of the packed stream
+        # (split_shares: parts sum to the dispatch's seconds bit-exactly,
+        # so per-tenant chip-seconds conserve against the dispatch-seconds
+        # total). Observe-only: disabling changes nothing outside
+        # self._tenants / self._tenant_seconds — fleet totals and the
+        # event window are bit-identical either way. Internally bounded:
+        # past _tenant_cap the smallest records fold into "other" (sums
+        # conserved), and every export folds again to tenant_top_k.
+        self.tenant_metering = bool(tenant_metering)
+        self.tenant_top_k = max(int(tenant_top_k), 1)
+        self._tenant_cap = max(4 * self.tenant_top_k, 64)
+        self._tenants: Dict[str, Dict[str, float]] = {}
+        self._tenant_seconds = 0.0  # total attributed dispatch seconds
 
     @classmethod
     def from_runner(cls, config, runner) -> "PerfAccountant":
@@ -235,7 +254,9 @@ class PerfAccountant:
                    peak_hbm_gbps=perf.peak_hbm_gbps,
                    hbm_poll_interval=perf.hbm_poll_interval,
                    n_chips=n_chips, tensor_parallel=tensor_parallel,
-                   peak_ici_gbps=perf.peak_ici_gbps)
+                   peak_ici_gbps=perf.peak_ici_gbps,
+                   tenant_metering=getattr(config, "tenant_metering", True),
+                   tenant_top_k=getattr(config, "tenant_top_k", 8))
 
     # -- compile events ------------------------------------------------------
     def on_compile(self, kind: str, bucket: str, seconds: float) -> None:
@@ -263,10 +284,15 @@ class PerfAccountant:
 
     # -- dispatch accounting -------------------------------------------------
     def record_prefill(self, live_tokens: int, ctx_tokens: int,
-                       rows: int, ts: Optional[float] = None) -> None:
+                       rows: int, ts: Optional[float] = None, *,
+                       seconds: float = 0.0,
+                       tenants: Optional[dict] = None) -> None:
         """One prefill dispatch: ``live_tokens`` real prompt tokens over
         ``rows`` chunks whose post-chunk context lengths sum to
-        ``ctx_tokens`` (docs/roofline.md prefill costing)."""
+        ``ctx_tokens`` (docs/roofline.md prefill costing). ``seconds`` is
+        the dispatch's wall time and ``tenants`` the per-tenant
+        ``{"prefill": n, "decode": n, "live": n}`` token shares the
+        engine packed — both feed the tenant attribution plane only."""
         ctx_mean = ctx_tokens / max(rows, 1)
         flops = (2.0 * self.param_count * live_tokens
                  + self._attn_per_tok_ctx * live_tokens * ctx_mean)
@@ -275,9 +301,12 @@ class PerfAccountant:
         self._record(ts, "prefill", flops, hbm, live_tokens,
                      ar_bytes=live_tokens * self._ar_bytes_per_tok,
                      ag_bytes=rows * self._ag_bytes_per_row)
+        self.attribute_tenants(seconds, tenants)
 
     def record_decode(self, live_seqs: int, steps: int, ctx_tokens: int,
-                      ts: Optional[float] = None) -> None:
+                      ts: Optional[float] = None, *,
+                      seconds: float = 0.0,
+                      tenants: Optional[dict] = None) -> None:
         """One fused decode dispatch: ``steps`` iterations over
         ``live_seqs`` sequences with ``ctx_tokens`` total context. Decode
         re-reads the weights every step — the weight-bandwidth-bound
@@ -290,12 +319,14 @@ class PerfAccountant:
         self._record(ts, "decode", flops, hbm, tokens,
                      ar_bytes=tokens * self._ar_bytes_per_tok,
                      ag_bytes=tokens * self._ag_bytes_per_row)
+        self.attribute_tenants(seconds, tenants)
 
     def record_ragged(self, prefill_tokens: int, prefill_ctx: int,
                       prefill_rows: int, decode_seqs: int, decode_ctx: int,
                       ts: Optional[float] = None, *,
                       spec_tokens: int = 0, spec_ctx: int = 0,
-                      spec_rows: int = 0) -> None:
+                      spec_rows: int = 0, seconds: float = 0.0,
+                      tenants: Optional[dict] = None) -> None:
         """One unified ragged dispatch: ``prefill_tokens`` prompt tokens
         over ``prefill_rows`` chunks (post-chunk contexts summing to
         ``prefill_ctx``) packed together with ``decode_seqs`` single-token
@@ -322,9 +353,16 @@ class PerfAccountant:
         all-reduces its two row-parallel matmul outputs per layer, and
         every consumed-logits stream position (prefill samples, decode
         rows, verify columns) all-gathers its vocab-sharded logits row.
-        Zero at tp=1 — the arithmetic, not a flag, turns it off."""
+        Zero at tp=1 — the arithmetic, not a flag, turns it off.
+
+        ``seconds`` (the fused dispatch's wall time) and ``tenants``
+        (per-tenant ``{"prefill", "decode", "live"}`` token shares of the
+        packed stream) feed the tenant attribution plane: the wall time
+        splits by each tenant's live-token share with exact conservation
+        — per-tenant chip-seconds sum to total dispatch seconds."""
         if prefill_tokens <= 0 and decode_seqs <= 0 and spec_tokens <= 0:
             return
+        self.attribute_tenants(seconds, tenants)
         if prefill_tokens > 0 or spec_tokens > 0:
             ctx_mean = prefill_ctx / max(prefill_rows, 1)
             flops = (2.0 * self.param_count * prefill_tokens
@@ -355,7 +393,8 @@ class PerfAccountant:
                          ag_bytes=decode_seqs * self._ag_bytes_per_row)
 
     def record_spec_accepted(self, tokens: int,
-                             ts: Optional[float] = None) -> None:
+                             ts: Optional[float] = None,
+                             tenant: Optional[str] = None) -> None:
         """Accepted speculative tokens: pure decode goodput on top of the
         one-per-row the dispatch already counted. Zero FLOPs/HBM here —
         the verification work that produced them was costed as
@@ -367,6 +406,98 @@ class PerfAccountant:
             self._events.append((now, "decode", 0.0, 0.0, tokens, 0.0))
             self._totals["decode_tokens"] += tokens
             self._trim(now)
+        if tenant is not None:
+            self.attribute_tenants(0.0, {tenant: {"decode": tokens}})
+
+    # -- tenant attribution --------------------------------------------------
+    def attribute_tenants(self, seconds: float,
+                          tenants: Optional[dict]) -> None:
+        """Bill one dispatch to its tenants: per-tenant prefill/decode
+        goodput tokens accumulate directly, and the dispatch's wall
+        ``seconds`` split by each tenant's ``live`` token share
+        (tenancy.split_shares — parts sum to ``seconds`` bit-exactly, the
+        conservation invariant). No-op when metering is off or the
+        dispatch carried no tenant map (bucketed warmup probes)."""
+        if not self.tenant_metering or not tenants:
+            return
+        live = {t: rec.get("live", 0) for t, rec in tenants.items()
+                if rec.get("live", 0) > 0}
+        shares = split_shares(seconds, live) if seconds > 0 else {}
+        with self._lock:
+            for t, rec in tenants.items():
+                row = self._tenant_row(t)
+                row["prefill_tokens"] += int(rec.get("prefill", 0))
+                row["decode_tokens"] += int(rec.get("decode", 0))
+                row["chip_seconds"] += shares.get(t, 0.0)
+            self._tenant_seconds += sum(shares.values())
+            self._bound_tenants()
+
+    def _tenant_row(self, tenant: str) -> dict:
+        return self._tenants.setdefault(
+            tenant, {"prefill_tokens": 0, "decode_tokens": 0,
+                     "chip_seconds": 0.0, "requests": 0,
+                     "queue_seconds_sum": 0.0})
+
+    def _bound_tenants(self) -> None:
+        if len(self._tenants) > self._tenant_cap:
+            # bound the *internal* table too, not just the export: fold
+            # the smallest records into "other" (sums conserved)
+            self._tenants = fold_records(
+                self._tenants, k=self._tenant_cap // 2,
+                weight_key="chip_seconds")
+
+    def note_request(self, tenant: str, queue_seconds: float) -> None:
+        """One finished request: per-tenant request count and queue-time
+        (arrival → admission) accumulation — the source of
+        ``vllm:tenant_queue_time_seconds``."""
+        if not self.tenant_metering:
+            return
+        with self._lock:
+            row = self._tenant_row(tenant)
+            row["requests"] += 1
+            row["queue_seconds_sum"] += max(float(queue_seconds), 0.0)
+            self._bound_tenants()
+
+    def attribute_seconds(self, tenant_live: dict,
+                          seconds: float) -> None:
+        """Attribute extra wall seconds (the deferred result fetch of a
+        dispatch already billed) by the same live-token shares — keeps
+        the conservation invariant across the dispatch/resolve split."""
+        if seconds <= 0 or not tenant_live:
+            return
+        self.attribute_tenants(
+            seconds, {t: {"live": n} for t, n in tenant_live.items()})
+
+    def tenant_fields(self, kv_blocks: Optional[dict] = None) -> dict:
+        """Bounded per-tenant export for ``stats()['tenants']`` and
+        ``/debug/tenants``: cumulative records folded to the top-K by
+        chip-seconds with the remainder under ``tenant="other"``
+        (tenancy.fold_records — every field's fleet total survives the
+        fold). ``kv_blocks`` is the engine's live per-tenant block count
+        from the scheduler, merged here so one fold governs every
+        export."""
+        with self._lock:
+            records = {t: dict(r) for t, r in self._tenants.items()}
+            seconds_total = self._tenant_seconds
+        for t, blocks in (kv_blocks or {}).items():
+            rec = records.get(t)
+            if rec is None:
+                rec = records[t] = {
+                    "prefill_tokens": 0, "decode_tokens": 0,
+                    "chip_seconds": 0.0, "requests": 0,
+                    "queue_seconds_sum": 0.0}
+            rec["kv_blocks"] = int(blocks)
+        folded = fold_records(records, k=self.tenant_top_k,
+                              weight_key="chip_seconds")
+        for row in folded.values():
+            row.setdefault("kv_blocks", 0)
+        return {
+            "enabled": self.tenant_metering,
+            "top_k": self.tenant_top_k,
+            "tracked": len(records),
+            "dispatch_seconds_total": seconds_total,
+            "tenants": {t: folded[t] for t in sorted(folded)},
+        }
 
     def _record(self, ts, phase, flops, hbm_bytes, tokens,
                 ar_bytes: float = 0.0, ag_bytes: float = 0.0) -> None:

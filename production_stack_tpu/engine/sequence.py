@@ -41,6 +41,14 @@ class Sequence:
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
     adapter_slot: int = 0  # multi-LoRA bank slot; 0 = base model
+    # tenant identity resolved at admission (tenancy.resolve_tenant):
+    # host-side metadata only — never enters a jitted program's inputs,
+    # never read by scheduling. Attribution is observe-only.
+    tenant: str = "anonymous"
+    # chip-seconds attributed to this sequence so far: its live-token
+    # share of every dispatch's wall time (tenancy.split_shares, exact
+    # conservation at the tenant level) — feeds the usage ledger
+    chip_seconds: float = 0.0
     # compacted token controls (sampling.make_token_controls): or None
     token_ctrl: Optional[tuple] = None
     # constrained decoding: device grammar-bank slot (-1 = unconstrained),
@@ -117,6 +125,8 @@ class RequestOutput:
     num_prompt_tokens: int
     num_output_tokens: int
     num_cached_tokens: int = 0
+    tenant: str = "anonymous"  # attribution identity (set on finish)
+    chip_seconds: float = 0.0  # attributed dispatch wall time (on finish)
     block_ids: Optional[list[int]] = None  # set on finish (KV export handle)
     # lifecycle stamps (monotonic clock), set on finish like block_ids —
     # the server derives queue/prefill/decode stage histograms from them

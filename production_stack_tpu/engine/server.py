@@ -37,6 +37,7 @@ from production_stack_tpu.engine.lifecycle import StepWatchdog
 from production_stack_tpu.engine.metrics import ServerMetrics
 from production_stack_tpu.engine import tracing as etracing
 from production_stack_tpu.flight_recorder import FlightRecorder
+from production_stack_tpu.tenancy import resolve_tenant
 
 import logging
 
@@ -237,6 +238,18 @@ class EngineServer:
         from production_stack_tpu.engine.lora import LoraManager
 
         self.lora = LoraManager(self.engine)
+        # durable per-request usage ledger (tenancy.UsageLedger): rotating
+        # JSONL written on request finish. Off unless metering is on AND a
+        # path was configured — the in-memory attribution plane does not
+        # depend on it.
+        self.usage_ledger = None
+        if config.tenant_metering and config.tenant_ledger_path:
+            from production_stack_tpu.tenancy import UsageLedger
+
+            self.usage_ledger = UsageLedger(
+                config.tenant_ledger_path,
+                max_bytes=config.tenant_ledger_max_bytes,
+            )
         self.start_time = time.time()
         # -- fleet lifecycle: drain state machine + stuck-step watchdog.
         # SERVING → DRAINING (SIGTERM / POST /drain): readiness (GET
@@ -357,6 +370,7 @@ class EngineServer:
         app.router.add_post("/debug/profile", self.profile)
         app.router.add_get("/debug/memory", self.memory_profile)
         app.router.add_get("/debug/perf", self.debug_perf)
+        app.router.add_get("/debug/tenants", self.debug_tenants)
         app.router.add_get("/debug/requests", self.debug_requests)
         app.router.add_get("/debug/diagnostics", self.diagnostics_index)
         app.router.add_get("/debug/diagnostics/{bundle_id}",
@@ -1644,7 +1658,8 @@ class EngineServer:
         if perf is None:
             return web.json_response({"enabled": False,
                                       "kv_transfer": kv_block,
-                                      "kv_tier": tier_block})
+                                      "kv_tier": tier_block,
+                                      "tenants": self.engine.tenant_stats()})
         snap = perf.snapshot()
         eng = self.engine
         drafted = getattr(eng, "spec_drafted", 0)
@@ -1662,7 +1677,19 @@ class EngineServer:
         }
         snap["kv_transfer"] = kv_block
         snap["kv_tier"] = tier_block
+        snap["tenants"] = self.engine.tenant_stats()
         return web.json_response(snap)
+
+    async def debug_tenants(self, request: web.Request) -> web.Response:
+        """Per-tenant attribution snapshot: token/chip-second/KV/queue
+        accounting folded to the configured top-K (+"other"), plus ledger
+        health. The router's /debug/fleet join and stacktop --tenants read
+        this; the same data backs the vllm:tenant_* metric families."""
+        block = dict(self.engine.tenant_stats())
+        block["model"] = self.model_name
+        if self.usage_ledger is not None:
+            block["ledger"] = self.usage_ledger.stats()
+        return web.json_response(block)
 
     async def memory_profile(self, request: web.Request) -> web.Response:
         """Device memory profile (pprof proto) — what holds HBM right now."""
@@ -1879,9 +1906,14 @@ class EngineServer:
             attributes={"request.id": rid, "client.request.id": client_rid,
                         "http.target": request.path, "model": model},
         )
+        # tenant identity for attribution (tenancy.resolve_tenant):
+        # x-tenant-id header (the router stamps the resolved identity
+        # here) > OpenAI `user` body field > API-key hash > "anonymous".
+        tenant = resolve_tenant(request.headers, body)
+        request["tenant"] = tenant
         rec = self.flight_recorder.begin(
             request_id=rid, client_request_id=client_rid,
-            endpoint=request.path, model=model,
+            endpoint=request.path, model=model, tenant=tenant,
             streaming=bool(body.get("stream", False)),
             trace_id=None, outcome=None, status=None,
             num_prompt_tokens=0, num_output_tokens=0,
@@ -1965,6 +1997,32 @@ class EngineServer:
                 tl[key] = val if key not in tl else pick(tl[key], val)
         rec["num_prompt_tokens"] += out.num_prompt_tokens
         rec["num_output_tokens"] += out.num_output_tokens
+        if self.usage_ledger is not None:
+            stamps = {}
+            if out.admit_time is not None and out.arrival_time is not None:
+                stamps["queue_s"] = round(
+                    out.admit_time - out.arrival_time, 6)
+            if (out.first_token_time is not None
+                    and out.admit_time is not None):
+                stamps["prefill_s"] = round(
+                    out.first_token_time - out.admit_time, 6)
+            if (out.finish_time is not None
+                    and out.first_token_time is not None):
+                stamps["decode_s"] = round(
+                    out.finish_time - out.first_token_time, 6)
+            self.usage_ledger.append({
+                "ts": time.time(),
+                "tenant": out.tenant,
+                "model": rec.get("model", self.model_name),
+                "request_id": out.request_id,
+                "client_request_id": rec.get("client_request_id"),
+                "prompt_tokens": out.num_prompt_tokens,
+                "output_tokens": out.num_output_tokens,
+                "cached_tokens": out.num_cached_tokens,
+                "chip_seconds": round(out.chip_seconds, 9),
+                "finish_reason": out.finish_reason,
+                **stamps,
+            })
 
     async def _run_inner(self, request: web.Request, body: dict,
                          prompts: list, chat: bool,
@@ -2125,6 +2183,10 @@ class EngineServer:
             )
 
         adapter_slot = self.lora.slot_of(model)
+        # resolved once in _run and stashed on the request; fall back to a
+        # fresh resolution for callers that enter here directly
+        tenant = request.get("tenant") or resolve_tenant(request.headers,
+                                                        body)
         reqs, rids = [], []
         for pi, prompt_ids in enumerate(prompt_ids_list):
             for j in range(n):
@@ -2139,7 +2201,7 @@ class EngineServer:
                         sampling, seed=(sampling.seed + idx) & 0xFFFFFFFF
                     )
                 reqs.append((crid, prompt_ids, choice_sampling,
-                             adapter_slot))
+                             adapter_slot, tenant))
         # atomic admission on the engine thread: all requests add or none
         # do, BEFORE this handler commits to a response. Grammar-bank
         # exhaustion and vocab-infeasible grammars (which only surface
@@ -2253,6 +2315,8 @@ class EngineServer:
             gen = await self.async_engine.attach_spliced(
                 rid, meta["prompt_token_ids"], meta["first_token"],
                 splice_sampling, state["blocks"],
+                tenant=request.get("tenant")
+                or resolve_tenant(request.headers, body),
             )
         except (SchedulerQueueFull, ValueError) as e:
             _log.warning("kv transfer %s attach failed (%s); falling back "
@@ -2886,6 +2950,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--perf-window", type=float, default=60.0,
                    help="sliding window (seconds) the utilization gauges "
                         "are computed over")
+    p.add_argument("--no-tenant-metering", dest="tenant_metering",
+                   action="store_false", default=True,
+                   help="disable per-tenant token/chip-second attribution "
+                        "(vllm:tenant_* series, GET /debug/tenants, usage "
+                        "ledger — production_stack_tpu/tenancy.py). "
+                        "Observe-only either way: total metrics are "
+                        "bit-identical with metering on or off")
+    p.add_argument("--tenant-top-k", type=int, default=8,
+                   help="tenants exported individually per metric; the "
+                        "remainder folds into tenant=\"other\" (bounded "
+                        "label cardinality)")
+    p.add_argument("--tenant-ledger-path", default="",
+                   help="rotating JSONL usage-ledger path (one record per "
+                        "finished request: tenant, model, tokens by phase, "
+                        "chip-seconds, stage stamps); empty = ledger off")
+    p.add_argument("--tenant-ledger-max-bytes", type=int, default=16 << 20,
+                   help="ledger rotation threshold in bytes")
     p.add_argument("--perf-peak-tflops", type=float, default=0.0,
                    help="accelerator peak TFLOP/s for MFU; 0 = the v5e "
                         "bf16 roofline from docs/roofline.md (197)")
@@ -3034,6 +3115,11 @@ def config_from_args(args) -> EngineConfig:
         cfg.perf.peak_hbm_gbps = args.perf_peak_hbm_gbps
     if getattr(args, "perf_peak_ici_gbps", 0.0):
         cfg.perf.peak_ici_gbps = args.perf_peak_ici_gbps
+    cfg.tenant_metering = getattr(args, "tenant_metering", True)
+    cfg.tenant_top_k = getattr(args, "tenant_top_k", 8) or 8
+    cfg.tenant_ledger_path = getattr(args, "tenant_ledger_path", "") or ""
+    cfg.tenant_ledger_max_bytes = (
+        getattr(args, "tenant_ledger_max_bytes", 16 << 20) or (16 << 20))
     cfg.seed = args.seed
     return cfg
 

@@ -175,6 +175,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-config", default=None,
                    help="JSON object of per-model objective overrides, "
                         'e.g. {"llama-3-8b": {"ttft_p95": 0.5}}')
+    # tenant attribution plane (production_stack_tpu/tenancy.py):
+    # per-tenant request/TTFT/ITL series + fairness gauges. Observe-only:
+    # nothing here feeds routing or scheduling.
+    p.add_argument("--no-tenant-attribution", dest="tenant_attribution",
+                   action="store_false", default=True,
+                   help="disable per-tenant usage tracking "
+                        "(vllm:tenant_* router series, the router side of "
+                        "GET /debug/tenants). Identity is still resolved "
+                        "and forwarded to engines either way")
+    p.add_argument("--tenant-header", default="x-tenant-id",
+                   help="inbound header the tenant identity is read from "
+                        "(precedence: this header > OpenAI `user` body "
+                        "field > API-key hash > \"anonymous\"); the "
+                        "resolved identity is stamped onto every backend "
+                        "hop as x-tenant-id")
+    p.add_argument("--tenant-top-k", type=int, default=8,
+                   help="tenants exported individually per metric; the "
+                        "remainder folds into tenant=\"other\" (bounded "
+                        "label cardinality)")
     # scale advisor (router/scale_advisor.py): desired-replica
     # recommendations on GET /debug/scale, fusing burn rate + queue depth
     # + KV pressure; consumed by the operator's native autoscaler loop
@@ -387,9 +406,13 @@ class RouterApp:
         from production_stack_tpu.router.slo import (
             SLOConfig,
             initialize_slo_tracker,
+            initialize_tenant_tracker,
         )
 
         initialize_slo_tracker(SLOConfig.from_args(args))
+        initialize_tenant_tracker(
+            args.tenant_top_k if getattr(args, "tenant_attribution", True)
+            else None)
 
         from production_stack_tpu.router.scale_advisor import (
             ScaleAdvisorConfig,
@@ -474,6 +497,7 @@ class RouterApp:
             external_providers=external,
             resilience=resilience,
             flight_recorder=self.flight_recorder,
+            tenant_header=getattr(args, "tenant_header", "x-tenant-id"),
         )
 
         from production_stack_tpu.router.incidents import (
@@ -580,6 +604,7 @@ class RouterApp:
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_get("/debug/requests", self.debug_requests)
         app.router.add_get("/debug/slo", self.debug_slo)
+        app.router.add_get("/debug/tenants", self.debug_tenants)
         app.router.add_get("/debug/scale", self.debug_scale)
         app.router.add_get("/debug/fleet", self.debug_fleet)
         app.router.add_get("/debug/diagnostics", self.debug_diagnostics)
@@ -803,6 +828,21 @@ class RouterApp:
             return web.json_response({"enabled": False})
         return web.json_response({"enabled": True, **tracker.snapshot()})
 
+    async def debug_tenants(self, request: web.Request) -> web.Response:
+        """Tenant attribution joined across both tiers: the router's
+        per-tenant request/TTFT/ITL view (router/slo.py
+        TenantUsageTracker) plus every engine's token/chip-second/KV
+        attribution (their GET /debug/tenants), keyed by engine URL."""
+        from production_stack_tpu.router.fleet import engine_tenants
+        from production_stack_tpu.router.slo import current_tenant_tracker
+
+        tracker = current_tenant_tracker()
+        router_block = (tracker.snapshot() if tracker is not None
+                        else {"enabled": False})
+        engines = await engine_tenants(self.request_service.session)
+        return web.json_response(
+            {"router": router_block, "engines": engines})
+
     async def debug_scale(self, request: web.Request) -> web.Response:
         """Scale advisor snapshot (router/scale_advisor.py): the fused
         desired-replica recommendation per model. The operator's native
@@ -991,9 +1031,13 @@ class RouterApp:
         m.healthy_pods_total.labels(server="router").set(
             len(get_service_discovery().get_endpoint_info())
         )
-        from production_stack_tpu.router.slo import current_slo_tracker
+        from production_stack_tpu.router.slo import (
+            current_slo_tracker,
+            current_tenant_tracker,
+        )
 
         m.refresh_slo_gauges(current_slo_tracker())
+        m.refresh_tenant_gauges(current_tenant_tracker())
         from production_stack_tpu.router.scale_advisor import (
             current_scale_advisor,
         )

@@ -68,6 +68,9 @@ def _engine_row(ep, probe: dict, estats, rstats, reasons: dict,
         # tiered-KV snapshot (tiers/bytes/prefetch) from /debug/perf —
         # None for engines without host/remote tiers configured
         "kv_tier": perf.get("kv_tier"),
+        # per-tenant attribution block (tokens/chip-seconds/KV, folded to
+        # top-K + "other") — None for engines with metering off
+        "tenants": perf.get("tenants"),
         "status": status,
         "draining": ep.draining,
         "warming": status == "warming",
@@ -130,18 +133,47 @@ async def fleet_snapshot(session) -> dict:
     tracker = current_slo_tracker()
     advisor = current_scale_advisor()
     from production_stack_tpu.router import metrics as m
+    from production_stack_tpu.router.slo import current_tenant_tracker
 
+    tenant_tracker = current_tenant_tracker()
     return {
         "ts": time.time(),
         "engines": engines,
         "router": {
             "slo": tracker.snapshot() if tracker is not None else None,
+            "tenants": (tenant_tracker.snapshot()
+                        if tenant_tracker is not None else None),
             "scale": advisor.snapshot() if advisor is not None else None,
             "incidents": (incidents.snapshot() if incidents is not None
                           else {"open": 0, "incidents": []}),
             "disagg": m.disagg_snapshot(),
         },
     }
+
+
+async def engine_tenants(session) -> dict:
+    """Per-engine GET /debug/tenants probe for the router's joined
+    /debug/tenants view — same concurrent short-timeout shape as the
+    fleet probes; an engine that doesn't answer gets None."""
+    import aiohttp
+
+    from production_stack_tpu.router.service_discovery import (
+        get_service_discovery,
+    )
+
+    timeout = aiohttp.ClientTimeout(total=PROBE_TIMEOUT)
+
+    async def probe(url: str):
+        try:
+            async with session.get(f"{url}/debug/tenants",
+                                   timeout=timeout) as resp:
+                return await resp.json()
+        except Exception:
+            return None
+
+    endpoints = get_service_discovery().get_endpoint_info()
+    results = await asyncio.gather(*(probe(ep.url) for ep in endpoints))
+    return {ep.url: res for ep, res in zip(endpoints, results)}
 
 
 def request_stats_asdict(stats) -> dict:

@@ -113,6 +113,35 @@ slo_error_budget_remaining = Gauge(
     "Fraction of the 6h error budget unspent (negative = blown)",
     ["model", "slo"],
 )
+# tenant attribution plane (production_stack_tpu/tenancy.py): router-side
+# fairness gauges over the 10s-bin usage series (router/slo.py
+# TenantUsageTracker). Label cardinality is bounded: every refresh folds
+# to top_k + tenant="other" (tenancy.fold_records) and stale tenant
+# labels are removed, so the exposition can never grow with identity
+# churn. Observe-only — no scheduling or routing reads these.
+tenant_request_rate = Gauge(
+    "vllm:tenant_request_rate",
+    "Requests per second admitted for the tenant (5m window)",
+    ["tenant"],
+)
+tenant_avg_ttft = Gauge(
+    "vllm:tenant_avg_ttft",
+    "Mean time-to-first-token for the tenant over the 5m window "
+    "(-1 when no samples)",
+    ["tenant"],
+)
+tenant_avg_itl = Gauge(
+    "vllm:tenant_avg_itl",
+    "Mean inter-token latency for the tenant over the 5m window "
+    "(-1 when no samples)",
+    ["tenant"],
+)
+tenant_requests_window = Gauge(
+    "vllm:tenant_requests_window",
+    "Requests the tenant finished admitting in the 5m window "
+    "(fairness share numerator)",
+    ["tenant"],
+)
 # scale advisor (router/scale_advisor.py): the native autoscaler and a
 # KEDA metrics-api scaler both follow these
 autoscaler_desired_replicas = Gauge(
@@ -222,6 +251,43 @@ def refresh_slo_gauges(tracker) -> None:
         for window, rate in rates.items():
             slo_burn_rate.labels(model=model, slo=slo, window=window).set(rate)
         slo_error_budget_remaining.labels(model=model, slo=slo).set(remaining)
+
+
+_tenant_labels: set = set()
+
+
+def refresh_tenant_gauges(tracker) -> None:
+    """Export the per-tenant usage series; no-op when tenant attribution
+    is off (tracker is None). The tracker's raw rows are re-folded here
+    (tenancy.fold_records) so the exported label set is bounded to
+    top_k + "other" even if the tracker's internal cap is larger; labels
+    that fell out of the fold are removed immediately — a demoted tenant
+    never lingers as a stale series."""
+    from production_stack_tpu.tenancy import fold_records
+
+    if tracker is None:
+        return
+    window = 300.0
+    rows = fold_records(tracker.usage_rows(window), k=tracker.top_k,
+                        weight_key="requests")
+    for tenant, r in rows.items():
+        _tenant_labels.add(tenant)
+        tenant_requests_window.labels(tenant=tenant).set(r["requests"])
+        tenant_request_rate.labels(tenant=tenant).set(
+            r["requests"] / window)
+        tenant_avg_ttft.labels(tenant=tenant).set(
+            r["ttft_sum"] / r["ttft_count"] if r["ttft_count"] else -1.0)
+        tenant_avg_itl.labels(tenant=tenant).set(
+            r["itl_sum"] / r["itl_count"] if r["itl_count"] else -1.0)
+    for tenant in list(_tenant_labels):
+        if tenant not in rows:
+            _tenant_labels.discard(tenant)
+            for g in (tenant_request_rate, tenant_avg_ttft, tenant_avg_itl,
+                      tenant_requests_window):
+                try:
+                    g.remove(tenant)
+                except KeyError:
+                    pass
 
 
 _last_events = {"up": 0, "down": 0}

@@ -41,6 +41,7 @@ from production_stack_tpu.router.stats import (
     get_engine_stats_scraper,
     get_request_stats_monitor,
 )
+from production_stack_tpu.tenancy import TENANT_HEADER, resolve_tenant
 
 logger = init_logger(__name__)
 
@@ -146,6 +147,7 @@ class RequestService:
         external_providers=None,
         resilience: Optional[Resilience] = None,
         flight_recorder: Optional[FlightRecorder] = None,
+        tenant_header: str = TENANT_HEADER,
     ):
         self.max_failover_attempts = max_failover_attempts
         self.request_timeout = request_timeout
@@ -158,6 +160,13 @@ class RequestService:
         self._resilience = resilience
         # default keeps directly-constructed services (tests) working
         self.flight_recorder = flight_recorder or FlightRecorder()
+        # inbound header the tenant identity is read from
+        # (tenancy.resolve_tenant precedence: header > body "user" field >
+        # API-key hash > "anonymous"); the resolved identity is stamped
+        # onto every backend hop as the CANONICAL x-tenant-id so engine-
+        # side attribution agrees with the router whatever header the
+        # operator configured inbound
+        self.tenant_header = tenant_header or TENANT_HEADER
 
     @property
     def resilience(self) -> Resilience:
@@ -180,6 +189,13 @@ class RequestService:
     def session(self) -> aiohttp.ClientSession:
         assert self._session is not None, "request service not started"
         return self._session
+
+    @staticmethod
+    def _tenant_of(request) -> str:
+        """The tenant resolved at admission (_route_general_request);
+        empty for surfaces that never resolved one."""
+        return (request.get("tenant") or "") if hasattr(request, "get") \
+            else ""
 
     # -- endpoint selection ---------------------------------------------------
     def _filter_endpoints(self, model: str) -> list[EndpointInfo]:
@@ -318,6 +334,12 @@ class RequestService:
         resolved = self.resolve_model(model)
         body["model"] = resolved
         rec["model"] = resolved
+        # tenant identity for attribution, resolved once at admission and
+        # carried on the request for every backend hop (observe-only)
+        tenant = resolve_tenant(request.headers, body,
+                                header_name=self.tenant_header)
+        request["tenant"] = tenant
+        rec["tenant"] = tenant
         m.num_incoming_requests_total.labels(model=resolved or "unknown").inc()
 
         if self.external_providers is not None and self.external_providers.handles(
@@ -649,9 +671,13 @@ class RequestService:
                 strip_chunk_usage = True
             if inject:
                 body = {**body, "stream_options": {**so, **inject}}
-        monitor.on_new_request(url, request_id, time.time(), model=model)
+        tenant = self._tenant_of(request)
+        monitor.on_new_request(url, request_id, time.time(), model=model,
+                               tenant=tenant)
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         if deadline is not None:
             headers["x-request-deadline"] = f"{deadline:.3f}"
         # CLIENT span per backend attempt, child of the router SERVER span
@@ -862,11 +888,15 @@ class RequestService:
         and keeps the same stats/usage accounting."""
         monitor = get_request_stats_monitor()
         res = self.resilience
+        tenant = self._tenant_of(request)
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         if deadline is not None:
             headers["x-request-deadline"] = f"{deadline:.3f}"
-        monitor.on_new_request(url, request_id, time.time(), model=model)
+        monitor.on_new_request(url, request_id, time.time(), model=model,
+                               tenant=tenant)
         try:
             backend = await self.session.post(
                 f"{url}{endpoint_path}", json=body, headers=headers
@@ -990,10 +1020,13 @@ class RequestService:
                 },
             }
         )
+        tenant = self._tenant_of(request)
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         monitor.on_new_request(prefill_url, request_id, time.time(),
-                               model=model)
+                               model=model, tenant=tenant)
         try:
             async with self.session.post(
                 f"{prefill_url}{endpoint_path}", json=prefill_body, headers=headers
@@ -1057,8 +1090,11 @@ class RequestService:
         deadline = self._request_deadline(request, t_start)
         res.budget.on_request()
         m.retry_budget_remaining.set(res.budget.remaining())
+        tenant = self._tenant_of(request)
         headers = sanitize_headers(request.headers)
         headers["x-request-id"] = request_id
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         if deadline is not None:
             headers["x-request-deadline"] = f"{deadline:.3f}"
         transfer_id = str(uuid.uuid4())
@@ -1100,7 +1136,7 @@ class RequestService:
             })
             res.breaker.on_attempt_start(p_url)
             monitor.on_new_request(p_url, request_id, time.time(),
-                                   model=model)
+                                   model=model, tenant=tenant)
             _record_attempt(request.get("flight_record")
                             if hasattr(request, "get") else None,
                             p_url, t_start)

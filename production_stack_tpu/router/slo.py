@@ -224,7 +224,121 @@ class SLOTracker:
         }
 
 
+class TenantUsageTracker:
+    """Per-tenant request/TTFT/ITL series on the same 10-second-bin
+    machinery as the burn-rate tracker (one ``_BinSeries`` per
+    (tenant, kind); column 1 carries the sample count, column 2 the
+    value sum, so windowed rates and means reduce the same way
+    ``bad_fraction`` does).
+
+    Cardinality is bounded at ingest: once ``cap`` distinct tenants are
+    tracked, NEW tenants account into ``tenant="other"`` — the series
+    tables can never grow past the cap however many identities churn
+    through. Exports fold further to ``top_k`` (tenancy.fold_records).
+    Observe-only: nothing here feeds routing."""
+
+    KINDS = ("requests", "ttft", "itl")
+
+    def __init__(self, top_k: int = 8):
+        from production_stack_tpu.tenancy import OTHER
+
+        self.top_k = max(int(top_k), 1)
+        self.cap = max(4 * self.top_k, 64)
+        self._other = OTHER
+        self._series: Dict[Tuple[str, str], _BinSeries] = {}
+        self._tenants: set = set()
+
+    def _admit(self, tenant: str) -> str:
+        if tenant in self._tenants:
+            return tenant
+        if len(self._tenants) >= self.cap:
+            return self._other
+        self._tenants.add(tenant)
+        return tenant
+
+    def _add(self, tenant: str, kind: str, value: float, ts: float) -> None:
+        key = (self._admit(tenant or "anonymous"), kind)
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = _BinSeries()
+        series.add(True, ts)  # sample count
+        if value:
+            series.add(False, ts, count=value)  # value sum
+
+    def record_request(self, tenant: str, ts: Optional[float] = None) -> None:
+        self._add(tenant, "requests", 1.0, ts if ts is not None else time.time())
+
+    def record_ttft(self, tenant: str, seconds: float,
+                    ts: Optional[float] = None) -> None:
+        self._add(tenant, "ttft", seconds, ts if ts is not None else time.time())
+
+    def record_itl(self, tenant: str, seconds: float,
+                   ts: Optional[float] = None) -> None:
+        self._add(tenant, "itl", seconds, ts if ts is not None else time.time())
+
+    @staticmethod
+    def _window_sums(series: Optional[_BinSeries], window: float,
+                     now: float) -> Tuple[float, float]:
+        """(sample count, value sum) over the trailing window."""
+        if series is None:
+            return 0.0, 0.0
+        count = vsum = 0.0
+        cutoff = now - window
+        for start, c, v in reversed(series.bins):
+            if start + BIN_SECONDS <= cutoff:
+                break
+            count += c
+            vsum += v
+        return count, vsum
+
+    def usage_rows(self, window: float = WINDOWS["5m"],
+                   now: Optional[float] = None) -> Dict[str, dict]:
+        """Raw per-tenant sums over the window (unfolded, bounded by
+        ``cap``): {tenant: {requests, ttft_count, ttft_sum, itl_count,
+        itl_sum}}. The exporters fold this to ``top_k``."""
+        now = now if now is not None else time.time()
+        out: Dict[str, dict] = {}
+        for tenant in sorted({t for t, _ in self._series}):
+            req, _ = self._window_sums(
+                self._series.get((tenant, "requests")), window, now)
+            ttft_n, ttft_s = self._window_sums(
+                self._series.get((tenant, "ttft")), window, now)
+            itl_n, itl_s = self._window_sums(
+                self._series.get((tenant, "itl")), window, now)
+            if not (req or ttft_n or itl_n):
+                continue
+            out[tenant] = {
+                "requests": req, "ttft_count": ttft_n, "ttft_sum": ttft_s,
+                "itl_count": itl_n, "itl_sum": itl_s,
+            }
+        return out
+
+    def snapshot(self, window: float = WINDOWS["5m"],
+                 now: Optional[float] = None) -> dict:
+        """JSON document for the router side of ``GET /debug/tenants``:
+        folded to top_k, with derived rates/means."""
+        from production_stack_tpu.tenancy import fold_records
+
+        now = now if now is not None else time.time()
+        rows = fold_records(self.usage_rows(window, now), k=self.top_k,
+                            weight_key="requests", other=self._other)
+        tenants = {}
+        for tenant, r in sorted(rows.items()):
+            tenants[tenant] = {
+                "requests": int(r["requests"]),
+                "request_rate": round(r["requests"] / window, 4),
+                "avg_ttft": (round(r["ttft_sum"] / r["ttft_count"], 4)
+                             if r["ttft_count"] else -1.0),
+                "avg_itl": (round(r["itl_sum"] / r["itl_count"], 6)
+                            if r["itl_count"] else -1.0),
+            }
+        return {"enabled": True, "top_k": self.top_k,
+                "tracked": len(self._tenants), "window": window,
+                "tenants": tenants}
+
+
 _tracker: Optional[SLOTracker] = None
+_tenant_tracker: Optional[TenantUsageTracker] = None
 
 
 def initialize_slo_tracker(config: Optional[SLOConfig]) -> Optional[SLOTracker]:
@@ -237,3 +351,16 @@ def current_slo_tracker() -> Optional[SLOTracker]:
     """None when no objectives are configured — callers degrade to a
     no-op (the stats monitor feeds this opportunistically)."""
     return _tracker
+
+
+def initialize_tenant_tracker(
+        top_k: Optional[int]) -> Optional[TenantUsageTracker]:
+    """top_k=None disables tenant attribution (--no-tenant-attribution)."""
+    global _tenant_tracker
+    _tenant_tracker = (TenantUsageTracker(top_k)
+                       if top_k is not None else None)
+    return _tenant_tracker
+
+
+def current_tenant_tracker() -> Optional[TenantUsageTracker]:
+    return _tenant_tracker

@@ -147,6 +147,10 @@ class RequestStatsMonitor:
         # model per in-flight attempt, so the SLO tracker can attribute
         # TTFT/ITL/availability observations per model objective
         self.request_model: dict[tuple[str, str], str] = {}
+        # tenant per in-flight attempt (tenancy.resolve_tenant at
+        # admission), feeding the per-tenant usage series — observe-only,
+        # never read by routing
+        self.request_tenant: dict[tuple[str, str], str] = {}
         self.first_query_time: Optional[float] = None
 
     @staticmethod
@@ -155,6 +159,12 @@ class RequestStatsMonitor:
 
         return current_slo_tracker()
 
+    @staticmethod
+    def _tenant_tracker():
+        from production_stack_tpu.router.slo import current_tenant_tracker
+
+        return current_tenant_tracker()
+
     def _mon(self, table: dict, url: str) -> MovingAverageMonitor:
         if url not in table:
             table[url] = MovingAverageMonitor(self.window)
@@ -162,12 +172,17 @@ class RequestStatsMonitor:
 
     # -- lifecycle hooks (called by the request service) ---------------------
     def on_new_request(self, url: str, request_id: str, ts: float,
-                       model: str = "") -> None:
+                       model: str = "", tenant: str = "") -> None:
         if self.first_query_time is None:
             self.first_query_time = ts
         self.request_start[(url, request_id)] = ts
         if model:
             self.request_model[(url, request_id)] = model
+        if tenant:
+            self.request_tenant[(url, request_id)] = tenant
+            tt = self._tenant_tracker()
+            if tt is not None:
+                tt.record_request(tenant, ts)
         self.in_prefill[url] = self.in_prefill.get(url, 0) + 1
         self._mon(self.qps, url).update(ts, 1.0)
 
@@ -181,6 +196,11 @@ class RequestStatsMonitor:
         if tracker is not None:
             model = self.request_model.get((url, request_id), "")
             tracker.record_ttft(model, ts - start, ts)
+        tt = self._tenant_tracker()
+        if tt is not None:
+            tenant = self.request_tenant.get((url, request_id))
+            if tenant:
+                tt.record_ttft(tenant, ts - start, ts)
         self.in_prefill[url] = max(self.in_prefill.get(url, 1) - 1, 0)
         self.in_decoding[url] = self.in_decoding.get(url, 0) + 1
 
@@ -190,6 +210,7 @@ class RequestStatsMonitor:
         start = self.request_start.pop(key, None)
         first = self.first_token.pop(key, None)
         model = self.request_model.pop(key, "")
+        tenant = self.request_tenant.pop(key, "")
         if start is not None:
             self._mon(self.latency, url).update(ts, ts - start)
         if first is not None and num_output_tokens > 1:
@@ -211,6 +232,10 @@ class RequestStatsMonitor:
             # availability: an attempt that never produced a first byte
             # counts against the budget
             tracker.record_attempt(model, first is not None, ts)
+        if tenant and itl is not None:
+            tt = self._tenant_tracker()
+            if tt is not None:
+                tt.record_itl(tenant, itl, ts)
 
     def on_request_swapped(self, url: str, request_id: str, ts: float) -> None:
         self.swapped[url] = self.swapped.get(url, 0) + 1

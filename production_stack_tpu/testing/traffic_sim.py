@@ -54,6 +54,7 @@ from production_stack_tpu.router.slo import (
 from production_stack_tpu.testing.arrivals import (
     ArrivalProcess, add_arrival_args, process_from_args,
 )
+from production_stack_tpu.tenancy import split_shares
 
 PROVISIONING, WARMING, READY, DRAINING, GONE = (
     "provisioning", "warming", "ready", "draining", "gone")
@@ -79,6 +80,7 @@ class Group:
     arrived: float
     prompt_tokens: int
     output_tokens: int
+    tenant: str = "anonymous"
     admitted: float = -1.0
     tokens_done: float = 0.0           # per-stream decode progress
     kv: int = 0                        # blocks held (all streams)
@@ -162,9 +164,15 @@ class SimReplica:
                 g.admitted = now
                 prefill = g.prompt_tokens / self.spec.prefill_tokens_per_sec
                 sim.record_ttft(g, (now - g.arrived) + prefill, now)
+                sim.record_prefill(g)
             g.tokens_done += per_stream
             if g.tokens_done >= g.output_tokens:
                 done.append(g)
+        # tenant attribution (tenancy.split_shares, the REAL splitter the
+        # engine's perf accountant uses): this replica was busy for dt
+        # seconds; each tenant is billed its live stream-weight share —
+        # exact conservation per tick by construction
+        sim.attribute_tick(self.running, per_stream, dt)
         for g in done:
             self.running.remove(g)
             self.alloc -= g.kv
@@ -330,7 +338,8 @@ class ModelSim:
 
     def __init__(self, wl: Workload, spec: ReplicaSpec,
                  advisor: ScaleAdvisor, tracker: SLOTracker,
-                 loop_cfg: AutoscalerConfig, seed: int = 0):
+                 loop_cfg: AutoscalerConfig, seed: int = 0,
+                 tenants: int = 0, noisy_share: float = 0.4):
         self.wl = wl
         self.tracker = tracker
         self.advisor = advisor
@@ -348,6 +357,31 @@ class ModelSim:
         self.replica_seconds = 0.0
         self.max_replicas_seen = 1
         self.peak_burn_fast = 0.0
+        # -- tenant attribution (the metering plane's proof harness) -----
+        # "noisy" deliberately gets an outsized arrival share so the run
+        # demonstrates dominance in the chip-second ledger without any
+        # scheduling change; everything else splits the remainder evenly
+        self.tenant_names: List[str] = (
+            ["noisy"] + [f"tenant-{i}" for i in range(1, tenants)]
+            if tenants > 0 else [])
+        self.noisy_share = min(max(noisy_share, 0.0), 1.0)
+        self.tenant_usage: Dict[str, Dict[str, float]] = {}
+        self.busy_seconds = 0.0        # independent fleet-total integral
+        self.tokens_served = 0.0       # independent decode-token total
+
+    def _pick_tenant(self) -> str:
+        names = self.tenant_names
+        if not names:
+            return "anonymous"
+        if len(names) == 1 or self.rng.random() < self.noisy_share:
+            return names[0]
+        return names[1 + self.rng.randrange(len(names) - 1)]
+
+    def _tenant_row(self, tenant: str) -> Dict[str, float]:
+        return self.tenant_usage.setdefault(tenant, {
+            "requests": 0, "prefill_tokens": 0,
+            "decode_tokens": 0.0, "chip_seconds": 0.0,
+        })
 
     async def _advise(self) -> dict:
         return self.advisor.snapshot()
@@ -365,6 +399,29 @@ class ModelSim:
         self.tracker.record_attempt(g.model, False, ts=now, count=g.weight)
         self.failed += g.weight
 
+    # -- tenant attribution --------------------------------------------------
+    def record_prefill(self, g: Group) -> None:
+        self._tenant_row(g.tenant)["prefill_tokens"] += (
+            g.prompt_tokens * g.weight)
+
+    def attribute_tick(self, running: List[Group], per_stream: float,
+                       dt: float) -> None:
+        """Split one replica-tick's busy wall time across the tenants of
+        the packed stream by live stream-weight share (split_shares is
+        largest-remainder, so each call conserves dt exactly)."""
+        weights: Dict[str, float] = {}
+        for g in running:
+            weights[g.tenant] = weights.get(g.tenant, 0) + g.weight
+        if not weights:
+            return
+        for tenant, share in split_shares(dt, weights).items():
+            self._tenant_row(tenant)["chip_seconds"] += share
+        self.busy_seconds += dt
+        for g in running:
+            tokens = per_stream * g.weight
+            self._tenant_row(g.tenant)["decode_tokens"] += tokens
+            self.tokens_served += tokens
+
     # -- one virtual tick ----------------------------------------------------
     def inject_arrivals(self, t: float, dt: float) -> None:
         n = self.wl.process.sample_count(t, dt)
@@ -375,11 +432,14 @@ class ModelSim:
         full, rem = divmod(n, w)
         sizes = [w] * full + ([rem] if rem else [])
         for size in sizes:
+            tenant = self._pick_tenant()
+            self._tenant_row(tenant)["requests"] += size
             self.router.route(Group(
                 model=self.wl.model, weight=size, arrived=t,
                 prompt_tokens=self.wl.prompt_tokens,
                 output_tokens=self.rng.randint(self.wl.output_lo,
-                                               self.wl.output_hi)))
+                                               self.wl.output_hi),
+                tenant=tenant))
 
     def tick_fleet(self, now: float, dt: float) -> None:
         self.actuator.now = now
@@ -428,7 +488,7 @@ class ModelSim:
                 "fast": round(pair_burn(rates, FAST_PAIR), 4),
                 "slow": round(pair_burn(rates, SLOW_PAIR), 4),
             }
-        return {
+        rep = {
             "users": self.wl.users,
             "arrival_kind": self.wl.process.kind,
             "group_weight": self.wl.weight,
@@ -443,6 +503,46 @@ class ModelSim:
             "max_replicas_seen": self.max_replicas_seen,
             "scale_events": dict(self.loop.scale_events),
             "warmup_seconds": [round(w, 1) for w in self.loop.warmups],
+        }
+        if self.tenant_usage:
+            rep["tenants"] = self.tenant_report()
+        return rep
+
+    def tenant_report(self) -> dict:
+        """Per-tenant usage + the conservation evidence the acceptance
+        run checks: attributed chip-seconds vs the independently
+        integrated busy-seconds, attributed decode tokens vs the total
+        token counter. Residuals are pure float-summation-order noise
+        (split_shares conserves each tick exactly)."""
+        attributed = math.fsum(
+            r["chip_seconds"] for r in self.tenant_usage.values())
+        tokens_attr = math.fsum(
+            r["decode_tokens"] for r in self.tenant_usage.values())
+        rows = {
+            t: {
+                "requests": int(r["requests"]),
+                "prefill_tokens": int(r["prefill_tokens"]),
+                "decode_tokens": round(r["decode_tokens"], 3),
+                "chip_seconds": round(r["chip_seconds"], 6),
+                "chip_second_share": (round(r["chip_seconds"] / attributed, 4)
+                                      if attributed else 0.0),
+            }
+            for t, r in sorted(self.tenant_usage.items(),
+                               key=lambda kv: -kv[1]["chip_seconds"])
+        }
+        return {
+            "tenants": rows,
+            "conservation": {
+                "chip_seconds_attributed": attributed,
+                "chip_seconds_busy": self.busy_seconds,
+                "chip_seconds_residual": attributed - self.busy_seconds,
+                "decode_tokens_attributed": tokens_attr,
+                "decode_tokens_served": self.tokens_served,
+                "decode_tokens_residual": tokens_attr - self.tokens_served,
+                "requests_attributed": int(math.fsum(
+                    r["requests"] for r in self.tenant_usage.values())),
+                "requests_arrived": self.arrivals,
+            },
         }
 
 
@@ -493,7 +593,9 @@ async def simulate(args) -> dict:
                        provision_s=args.provision_seconds,
                        warmup_s=args.warmup_seconds)
     sims = [ModelSim(wl, spec, advisor, tracker, loop_cfg,
-                     seed=args.arrival_seed + i)
+                     seed=args.arrival_seed + i,
+                     tenants=getattr(args, "tenants", 0),
+                     noisy_share=getattr(args, "tenant_noisy_share", 0.4))
             for i, wl in enumerate(build_workloads(args))]
 
     dt = args.dt
@@ -550,8 +652,24 @@ async def simulate(args) -> dict:
                                   for m in models.values()),
             "kv_leaked_blocks": sum(m["kv_leaked_blocks"]
                                     for m in models.values()),
+            "tenant_conservation_breaks": sum(
+                1 for s in sims if not tenant_conserved(s)),
         },
     }
+
+
+def tenant_conserved(sim: ModelSim, rel_tol: float = 1e-6) -> bool:
+    """Attribution must account for every busy chip-second and every
+    served token; residuals beyond float-summation noise are a break."""
+    if not sim.tenant_usage:
+        return True
+    cons = sim.tenant_report()["conservation"]
+    chip_ok = (abs(cons["chip_seconds_residual"])
+               <= rel_tol * max(1.0, cons["chip_seconds_busy"]))
+    tok_ok = (abs(cons["decode_tokens_residual"])
+              <= rel_tol * max(1.0, cons["decode_tokens_served"]))
+    req_ok = cons["requests_attributed"] == cons["requests_arrived"]
+    return chip_ok and tok_ok and req_ok
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -592,6 +710,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-ttft-p95", type=float, default=10.0)
     p.add_argument("--slo-itl-p95", type=float, default=0.2)
     p.add_argument("--slo-availability", type=float, default=0.999)
+    # tenant attribution proof harness
+    p.add_argument("--tenants", type=int, default=0,
+                   help="simulate N tenant request groups (0 = off); "
+                        "tenant 'noisy' gets --tenant-noisy-share of "
+                        "arrivals so it visibly dominates chip-seconds")
+    p.add_argument("--tenant-noisy-share", type=float, default=0.4,
+                   help="arrival share of the deliberately noisy tenant")
     p.add_argument("--output", default=None,
                    help="write the run artifact JSON here")
     return p
@@ -608,6 +733,7 @@ def main(argv=None) -> int:
     v = artifact["violations"]
     ok = (v["cold_routes"] == 0 and v["failed_streams"] == 0
           and v["kv_leaked_blocks"] == 0
+          and v.get("tenant_conservation_breaks", 0) == 0
           and all(b["fast"] < 1.0 and b["slow"] < 1.0
                   for m in artifact["models"].values()
                   for b in m["final_burn"].values()))

@@ -1,0 +1,12 @@
+"""bounded-identity-label positive case: a tenant-labelled metric in a
+file that never references the top-K capping helpers — nothing here can
+be bounding the label's value space (the rule is textual, so even this
+docstring must not name them).
+
+tests/test_stackcheck.py asserts the exact finding. Never imported:
+AST-scanned only.
+"""
+from prometheus_client import Gauge
+
+TENANT_QUEUE = Gauge("router:fixture_tenant_queue", "per-tenant queue",
+                     ["tenant"])

@@ -1,0 +1,15 @@
+"""bounded-identity-label negative case: the tenant label is fine here
+because this file routes values through the shared top-K capping helper
+before setting them. Never imported: AST-scanned only.
+"""
+from prometheus_client import Gauge
+
+from production_stack_tpu.tenancy import fold_top_k
+
+TENANT_OK = Gauge("router:fixture_tenant_folded", "folded per-tenant",
+                  ["tenant"])
+
+
+def refresh(values):
+    for tenant, value in fold_top_k(values, k=8).items():
+        TENANT_OK.labels(tenant=tenant).set(value)

@@ -1045,10 +1045,22 @@ def test_perf_slo_dashboard():
         # multi-chip / ICI row
         "vllm:ici_bandwidth_utilization",
         "vllm:collective_bytes_total",
+        # tenants row (attribution plane, docs/observability.md
+        # "Tenant metering") — engine + router series
+        "vllm:tenant_chip_seconds_total",
+        "vllm:tenant_tokens_total",
+        "vllm:tenant_kv_blocks",
+        "vllm:tenant_queue_time_seconds_sum",
+        "vllm:tenant_queue_time_seconds_count",
+        "vllm:tenant_request_rate",
+        "vllm:tenant_avg_ttft",
+        "vllm:tenant_avg_itl",
     ):
         assert metric in text, f"perf-slo dashboard missing {metric}"
     assert dash["uid"] == "tpu-perf-slo"
     assert all(p["targets"] for p in dash["panels"])
+    assert any(p["type"] == "row" and p["title"] == "Tenants"
+               for p in dash["panels"])
     repo_root = os.path.dirname(HELM)
     with open(os.path.join(repo_root, "observability",
                            "perf-slo-dashboard.json")) as f:
@@ -1145,6 +1157,72 @@ def test_diagnostics_values_render_flags():
                         ("--diagnostics-profile-seconds", "2"),
                         ("--diagnostics-hbm-threshold", "0.92")):
         assert eargs[eargs.index(flag) + 1] == value
+
+
+def test_tenant_values_render_flags():
+    """routerSpec.tenancy.* and engineConfig.tenant* map onto the tenant
+    attribution surface on each tier; defaults keep metering on with no
+    --no-tenant-* rendered and no ledger path."""
+    args = router_args(render_objects(HELM))
+    assert "--no-tenant-attribution" not in args
+    assert "--tenant-header" not in args          # "" → x-tenant-id
+    assert args[args.index("--tenant-top-k") + 1] == "8"
+
+    objs = render_objects(HELM, {
+        "routerSpec": {"tenancy": {
+            "attribution": False, "header": "x-org-id", "topK": 4,
+        }},
+        "servingEngineSpec": {"modelSpec": [{
+            "name": "ten", "modelRef": "llama-3-8b",
+            "engineConfig": {
+                "maxModelLen": 2048, "maxNumSeqs": 8, "dtype": "bfloat16",
+                "tensorParallelSize": 1,
+                "tenantMetering": False, "tenantTopK": 16,
+                "tenantLedgerPath": "/data/usage/ledger.jsonl",
+                "tenantLedgerMaxBytes": 1048576,
+            },
+        }]},
+    })
+    args = router_args(objs)
+    assert "--no-tenant-attribution" in args
+    assert args[args.index("--tenant-header") + 1] == "x-org-id"
+    assert args[args.index("--tenant-top-k") + 1] == "4"
+    eargs = container_args(engine_deployments(objs)[0])
+    assert "--no-tenant-metering" in eargs
+    for flag, value in (("--tenant-top-k", "16"),
+                        ("--tenant-ledger-path", "/data/usage/ledger.jsonl"),
+                        ("--tenant-ledger-max-bytes", "1048576")):
+        assert eargs[eargs.index(flag) + 1] == value
+
+    # defaults: metering on, top-K renders, no ledger flag
+    eargs = container_args(engine_deployments(render_objects(HELM))[0])
+    assert "--no-tenant-metering" not in eargs
+    assert "--tenant-ledger-path" not in eargs
+    assert eargs[eargs.index("--tenant-top-k") + 1] == "8"
+
+    # the CI overlay must exercise the surface so config-drift pins it
+    with open(os.path.join(HELM, "values-ci.yaml")) as f:
+        ci = yaml.safe_load(f)
+    assert ci["routerSpec"]["tenancy"]["header"] == "x-ci-tenant"
+    ci_cfg = ci["servingEngineSpec"]["modelSpec"][0]["engineConfig"]
+    assert ci_cfg["tenantMetering"] is True
+    assert ci_cfg["tenantLedgerPath"]
+
+
+def test_tenant_dominance_alert():
+    """The fairness alert fires on a NAMED tenant only — the capped
+    "other" aggregate is many tenants by construction — and points its
+    runbook at the tenant-metering doc section."""
+    repo_root = os.path.dirname(HELM)
+    with open(os.path.join(repo_root, "observability",
+                           "alert-rules.yaml")) as f:
+        rules = yaml.safe_load(f)
+    (dom,) = [r for g in rules["groups"] for r in g["rules"]
+              if r.get("alert") == "TenantDominance"]
+    assert 'tenant!="other"' in dom["expr"]
+    assert "vllm:tenant_chip_seconds_total" in dom["expr"]
+    assert dom["annotations"]["runbook_url"] == \
+        "docs/observability.md#tenant-metering"
 
 
 def test_alert_rules_carry_runbooks():

@@ -121,7 +121,7 @@ def test_config_drift():
 def test_metric_hygiene():
     r = fixture_report(only="metric-hygiene")
     msgs = "\n".join(f"{f.path}: {f.message}" for f in r.findings)
-    assert len(r.findings) == 6, msgs
+    assert len(r.findings) == 7, msgs
     assert "references 'vllm:fixture_dashboard_ghost', not defined" in msgs
     # rule files: recorded names count as defined, ghost exprs do not
     assert "references 'vllm:fixture_rule_ghost', not defined" in msgs
@@ -132,6 +132,11 @@ def test_metric_hygiene():
     assert "already registered on the default registry" in msgs
     # the registry=... constructor is exempt from duplicate checking
     assert sum("already registered" in f.message for f in r.findings) == 1
+    # identity label with no fold helper in the file is a finding; the
+    # _ok_ fixture declares the same label but references fold_top_k
+    assert ("tenant_metrics_fixture.py: metric 'router:fixture_tenant_queue'"
+            " label 'tenant' is free-form identity" in msgs)
+    assert "fixture_tenant_folded" not in msgs
 
 
 # ---- baseline round-trip --------------------------------------------------

@@ -1,0 +1,327 @@
+"""Tenant attribution plane: identity precedence, the bounded-cardinality
+fold helpers, the exact-conservation chip-second split, the durable usage
+ledger, and the two invariants the metering surfaces promise —
+
+* conservation: per-tenant chip-seconds sum to total dispatch seconds and
+  per-tenant tokens sum to the phase totals, across arbitrarily many
+  dispatches and a 1000-tenant churn through the top-K fold;
+* observe-only: the roofline totals are bit-identical with metering on or
+  off (attribution never perturbs what it measures).
+"""
+
+import json
+import math
+import random
+
+import pytest
+
+from production_stack_tpu.engine.config import ModelConfig
+from production_stack_tpu.engine.perf_accounting import PerfAccountant
+from production_stack_tpu.router.slo import TenantUsageTracker
+from production_stack_tpu.tenancy import (
+    ANONYMOUS,
+    OTHER,
+    UsageLedger,
+    fold_records,
+    fold_top_k,
+    hash_api_key,
+    resolve_tenant,
+    sanitize_tenant,
+    split_shares,
+)
+
+
+def tiny_cfg() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=64, hidden_size=8, intermediate_size=16, num_layers=2,
+        num_heads=2, num_kv_heads=1, head_dim=4, dtype="bfloat16",
+    )
+
+
+def make_accountant(**kw) -> PerfAccountant:
+    kw.setdefault("param_count", 1000)
+    kw.setdefault("param_bytes", 2000)
+    kw.setdefault("window", 60.0)
+    return PerfAccountant(tiny_cfg(), **kw)
+
+
+# -- identity precedence -----------------------------------------------------
+
+def test_resolve_tenant_precedence():
+    headers = {"x-tenant-id": "acme", "authorization": "Bearer sk-secret"}
+    body = {"user": "bodyuser"}
+    # 1. explicit header wins over everything
+    assert resolve_tenant(headers, body) == "acme"
+    # 2. OpenAI `user` body field
+    assert resolve_tenant({"authorization": "Bearer sk-secret"},
+                          body) == "bodyuser"
+    # 3. API-key hash
+    t = resolve_tenant({"authorization": "Bearer sk-secret"}, {})
+    assert t == hash_api_key("Bearer sk-secret")
+    assert t.startswith("key-") and len(t) == len("key-") + 12
+    # 4. anonymous
+    assert resolve_tenant({}, {}) == ANONYMOUS
+    assert resolve_tenant() == ANONYMOUS
+
+
+def test_resolve_tenant_sanitizes_and_falls_through():
+    # label-unsafe characters are stripped; an id that sanitizes to
+    # nothing falls through to the next precedence level
+    assert resolve_tenant({"x-tenant-id": 'a{b}"c\n'}, None) == "abc"
+    assert resolve_tenant({"x-tenant-id": '{"}\n'}, {"user": "u1"}) == "u1"
+    assert sanitize_tenant("  team-a  ") == "team-a"
+    assert sanitize_tenant("x" * 200) == "x" * 64
+    assert sanitize_tenant(None) is None
+    # custom header name (routerSpec.tenancy.header)
+    assert resolve_tenant({"x-org-id": "org9"}, None,
+                          header_name="x-org-id") == "org9"
+
+
+def test_hash_api_key_stable_and_non_reversible():
+    a = hash_api_key("Bearer sk-alpha")
+    assert a == hash_api_key("sk-alpha")  # scheme prefix is cosmetic
+    assert a != hash_api_key("sk-beta")
+    assert "sk-alpha" not in a
+    assert hash_api_key("") is None and hash_api_key("Bearer ") is None
+
+
+# -- bounded-cardinality folds ----------------------------------------------
+
+def test_fold_top_k_conserves_and_is_deterministic():
+    rng = random.Random(7)
+    values = {f"t{i:03d}": rng.randrange(1, 1000) for i in range(200)}
+    folded = fold_top_k(values, k=8)
+    assert len(folded) == 9 and OTHER in folded
+    assert sum(folded.values()) == sum(values.values())
+    # deterministic: K largest survive, ties break by name
+    kept = set(folded) - {OTHER}
+    floor = min(folded[t] for t in kept)
+    assert all(v <= floor for t, v in values.items() if t not in kept)
+    assert fold_top_k(values, k=8) == folded
+    # a pre-existing "other" never competes for a slot — it is the bucket
+    refold = fold_top_k(folded, k=2)
+    assert sum(refold.values()) == sum(values.values())
+    assert len(refold) == 3
+
+
+def test_fold_records_conserves_every_field():
+    rng = random.Random(11)
+    records = {
+        f"t{i}": {"chip_seconds": rng.random() * 10,
+                  "prefill_tokens": rng.randrange(100),
+                  "requests": rng.randrange(10)}
+        for i in range(50)
+    }
+    folded = fold_records(records, k=4, weight_key="chip_seconds")
+    assert len(folded) == 5 and OTHER in folded
+    for field in ("chip_seconds", "prefill_tokens", "requests"):
+        assert sum(r[field] for r in folded.values()) == pytest.approx(
+            sum(r[field] for r in records.values()), rel=1e-12)
+    # ranked by the weight key: every kept tenant outweighs every folded one
+    kept_min = min(r["chip_seconds"]
+                   for t, r in folded.items() if t != OTHER)
+    assert all(r["chip_seconds"] <= kept_min
+               for t, r in records.items() if t not in folded)
+
+
+def test_split_shares_conserves_and_is_proportional():
+    parts = split_shares(8.0, {"a": 1, "b": 3})
+    assert parts == {"a": 2.0, "b": 6.0}
+    assert split_shares(5.0, {}) == {}
+    assert split_shares(5.0, {"a": 0, "b": -1}) == {}
+    rng = random.Random(3)
+    for _ in range(200):
+        weights = {f"t{i}": rng.random() * 10 ** rng.randrange(-3, 4)
+                   for i in range(rng.randrange(1, 10))}
+        total = rng.random() * 10 ** rng.randrange(-3, 4)
+        parts = split_shares(total, weights)
+        assert set(parts) == set(weights)
+        assert math.isclose(sum(parts.values()), total,
+                            rel_tol=1e-12, abs_tol=1e-300)
+
+
+# -- PerfAccountant: conservation invariant ----------------------------------
+
+def test_chip_second_conservation_across_dispatches():
+    """Sum of per-tenant chip-seconds == total dispatch seconds, and the
+    per-tenant token sums == the roofline phase totals, over many mixed
+    dispatches with randomized multi-tenant packing."""
+    acc = make_accountant(tenant_top_k=4)
+    rng = random.Random(17)
+    names = [f"team-{i}" for i in range(12)]
+    total_seconds = 0.0
+    total_prefill = total_decode = 0
+
+    for step in range(300):
+        packed = rng.sample(names, rng.randrange(1, 6))
+        tenants = {}
+        p_tok = d_tok = 0
+        for t in packed:
+            p = rng.randrange(0, 64)
+            d = rng.randrange(0, 8)
+            tenants[t] = {"prefill": p, "decode": d, "live": p + d}
+            p_tok += p
+            d_tok += d
+        if p_tok + d_tok == 0:
+            continue
+        secs = rng.random() * 0.05
+        acc.record_ragged(p_tok, p_tok * 4, max(len(packed), 1),
+                          d_tok, d_tok * 32, ts=float(step),
+                          seconds=secs, tenants=tenants)
+        total_seconds += secs
+        total_prefill += p_tok
+        total_decode += d_tok
+
+    fields = acc.tenant_fields()
+    rows = fields["tenants"].values()
+    attributed = math.fsum(r["chip_seconds"] for r in rows)
+    assert attributed == pytest.approx(fields["dispatch_seconds_total"],
+                                       rel=1e-9)
+    assert attributed == pytest.approx(total_seconds, rel=1e-9)
+    # token conservation against the roofline totals the gauges export
+    assert sum(r["prefill_tokens"] for r in rows) == total_prefill
+    assert sum(r["decode_tokens"] for r in rows) == total_decode
+    assert acc._totals["prefill_tokens"] == total_prefill
+    assert acc._totals["decode_tokens"] == total_decode
+
+
+def test_spec_accepted_and_deferred_seconds_attribute():
+    acc = make_accountant()
+    acc.record_ragged(0, 0, 0, 2, 64, ts=0.0, seconds=0.1,
+                      tenants={"a": {"decode": 1, "live": 1},
+                               "b": {"decode": 1, "live": 1}})
+    acc.record_spec_accepted(3, ts=0.0, tenant="a")
+    # deferred result fetch billed by the same live shares
+    acc.attribute_seconds({"a": 1, "b": 3}, 0.4)
+    rows = acc.tenant_fields()["tenants"]
+    assert rows["a"]["decode_tokens"] == 4
+    assert rows["a"]["chip_seconds"] == pytest.approx(0.05 + 0.1)
+    assert rows["b"]["chip_seconds"] == pytest.approx(0.05 + 0.3)
+    assert acc.tenant_fields()["dispatch_seconds_total"] == pytest.approx(0.5)
+
+
+def test_top_k_other_folding_under_1000_tenant_churn():
+    """1000 distinct tenants churn through: the internal table stays
+    bounded at the cap, the export folds to top_k + "other", and every
+    counter's total survives both folds."""
+    acc = make_accountant(tenant_top_k=8)
+    cap = acc._tenant_cap
+    assert cap == 64
+    rng = random.Random(23)
+    total_seconds = 0.0
+    for i in range(1000):
+        t = f"tenant-{i:04d}"
+        secs = rng.random() * 0.01
+        acc.record_decode(1, 4, 64, ts=float(i), seconds=secs,
+                          tenants={t: {"decode": 4, "live": 1}})
+        acc.note_request(t, queue_seconds=0.25)
+        total_seconds += secs
+    assert len(acc._tenants) <= cap
+    fields = acc.tenant_fields()
+    assert fields["tracked"] <= cap
+    assert len(fields["tenants"]) <= fields["top_k"] + 1
+    assert OTHER in fields["tenants"]
+    rows = fields["tenants"].values()
+    assert sum(r["requests"] for r in rows) == 1000
+    assert sum(r["decode_tokens"] for r in rows) == 4000
+    assert sum(r["queue_seconds_sum"] for r in rows) == pytest.approx(250.0)
+    assert math.fsum(r["chip_seconds"] for r in rows) == pytest.approx(
+        total_seconds, rel=1e-9)
+    # the fold bucket holds the bulk of the churned identities' usage
+    assert fields["tenants"][OTHER]["requests"] > 900
+
+
+def test_tenant_fields_merges_kv_blocks_under_one_fold():
+    acc = make_accountant(tenant_top_k=2)
+    for t, secs in (("a", 0.3), ("b", 0.2), ("c", 0.1)):
+        acc.record_decode(1, 1, 8, ts=0.0, seconds=secs,
+                          tenants={t: {"decode": 1, "live": 1}})
+    fields = acc.tenant_fields(kv_blocks={"a": 5, "c": 2, "idle": 7})
+    rows = fields["tenants"]
+    assert set(rows) == {"a", "b", OTHER}
+    assert rows["a"]["kv_blocks"] == 5
+    assert rows["b"]["kv_blocks"] == 0
+    assert rows[OTHER]["kv_blocks"] == 9  # c + idle fold together
+    assert sum(r["kv_blocks"] for r in rows.values()) == 14
+
+
+def test_metering_off_and_observe_only_totals_bit_identical():
+    """The attribution plane never perturbs the roofline measurements:
+    driving identical dispatch sequences with metering on (tenant maps
+    attached) and metering off yields bit-identical totals, window events
+    and stats gauges."""
+    on = make_accountant(tenant_metering=True)
+    off = make_accountant(tenant_metering=False)
+    rng = random.Random(5)
+    for step in range(50):
+        p, d = rng.randrange(1, 32), rng.randrange(0, 4)
+        tenants = {"a": {"prefill": p, "live": p},
+                   "b": {"decode": d, "live": d}}
+        for acc in (on, off):
+            acc.record_ragged(p, p * 2, 1, d, d * 16, ts=float(step),
+                              seconds=0.01, tenants=dict(tenants))
+            acc.note_request("a", 0.1)
+    assert on._totals == off._totals
+    assert list(on._events) == list(off._events)
+    assert on.stats_fields() == off.stats_fields()
+    # metering off means off: nothing accumulated, export says disabled
+    assert off._tenants == {}
+    fields = off.tenant_fields()
+    assert fields["enabled"] is False and fields["tenants"] == {}
+    assert on.tenant_fields()["enabled"] is True
+    assert on.tenant_fields()["tenants"]
+
+
+# -- router-side tracker -----------------------------------------------------
+
+def test_tenant_usage_tracker_caps_admission_and_conserves():
+    tracker = TenantUsageTracker(top_k=4)
+    assert tracker.cap == 64
+    now = 1000.0
+    for i in range(200):
+        t = f"u{i:03d}"
+        tracker.record_request(t, ts=now)
+        tracker.record_ttft(t, 0.5, ts=now)
+        tracker.record_itl(t, 0.02, ts=now)
+    rows = tracker.usage_rows(window=300.0, now=now + 1)
+    assert len(tracker._tenants) <= tracker.cap
+    assert len(rows) <= tracker.cap + 1 and OTHER in rows
+    assert sum(r["requests"] for r in rows.values()) == 200
+    assert sum(r["ttft_sum"] for r in rows.values()) == pytest.approx(100.0)
+
+    snap = tracker.snapshot(window=300.0, now=now + 1)
+    assert snap["enabled"] and snap["tracked"] <= tracker.cap
+    assert len(snap["tenants"]) <= tracker.top_k + 1
+    assert OTHER in snap["tenants"]
+    assert sum(r["requests"] for r in snap["tenants"].values()) == 200
+    other = snap["tenants"][OTHER]
+    assert other["avg_ttft"] == pytest.approx(0.5, rel=1e-3)
+    assert other["avg_itl"] == pytest.approx(0.02, rel=1e-3)
+
+
+# -- durable usage ledger ----------------------------------------------------
+
+def test_usage_ledger_appends_and_rotates(tmp_path):
+    path = tmp_path / "usage.jsonl"
+    ledger = UsageLedger(str(path), max_bytes=1, backups=2)
+    assert ledger.max_bytes == 4096  # floor, not zero
+    record = {"tenant": "acme", "prefill_tokens": 64, "decode_tokens": 32,
+              "chip_seconds": 0.125, "model": "tiny-llama"}
+    for i in range(200):
+        assert ledger.append(dict(record, request_id=f"r{i:05d}"))
+    assert ledger.records_written == 200
+    assert ledger.rotations >= 1
+    assert path.exists() and (tmp_path / "usage.jsonl.1").exists()
+    # backups cap the generations: nothing past .2 exists
+    assert not (tmp_path / "usage.jsonl.3").exists()
+    last = path.read_text().splitlines()[-1]
+    row = json.loads(last)
+    assert row["tenant"] == "acme" and row["chip_seconds"] == 0.125
+
+
+def test_usage_ledger_io_errors_counted_not_raised(tmp_path):
+    ledger = UsageLedger(str(tmp_path / "no-such-dir" / "usage.jsonl"))
+    assert ledger.append({"tenant": "a"}) is False
+    assert ledger.write_errors == 1
+    stats = ledger.stats()
+    assert stats["records_written"] == 0 and stats["write_errors"] == 1

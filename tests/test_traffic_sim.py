@@ -80,6 +80,39 @@ def test_scale_down_is_drain_based():
     assert m["scale_events"].get("down", 0) >= 1
 
 
+def test_tenant_attribution_conserves_and_shows_noisy_neighbor():
+    """--tenants 8 tags every request group with a tenant and attributes
+    chip-seconds per tick via the exact-conservation split: attributed ==
+    busy to float noise, tokens and requests conserve, and the noisy
+    tenant (40% arrival share) is visibly dominant over the 7 others."""
+    artifact = run_sim(["--users", "10000", "--per-user-rate", "0.02",
+                        "--tenants", "8"])
+    assert_clean(artifact)
+    assert artifact["violations"]["tenant_conservation_breaks"] == 0
+    rep = artifact["models"]["sim-chat"]["tenants"]
+    rows = rep["tenants"]
+    assert len(rows) == 8 and "noisy" in rows
+
+    cons = rep["conservation"]
+    busy = cons["chip_seconds_busy"]
+    assert busy > 0
+    assert abs(cons["chip_seconds_residual"]) <= 1e-6 * busy
+    assert abs(cons["decode_tokens_residual"]) <= \
+        1e-6 * max(cons["decode_tokens_served"], 1.0)
+    assert cons["requests_attributed"] == cons["requests_arrived"]
+    # per-row sums re-derive the attributed totals (the report is honest)
+    assert sum(r["chip_seconds"] for r in rows.values()) == \
+        pytest.approx(cons["chip_seconds_attributed"], rel=1e-9)
+
+    # fairness signal: the noisy tenant dominates every quiet one
+    shares = {t: r["chip_second_share"] for t, r in rows.items()}
+    quiet = [s for t, s in shares.items() if t != "noisy"]
+    assert shares["noisy"] > max(quiet) * 2
+    assert shares["noisy"] == pytest.approx(0.4, abs=0.12)
+    # rows round the share to 4 decimals — tolerance covers 8 roundings
+    assert sum(shares.values()) == pytest.approx(1.0, abs=1e-3)
+
+
 @pytest.mark.slow
 def test_soak_million_users_multimodel():
     """10^6-user soak (weighted request groups keep it tractable): diurnal

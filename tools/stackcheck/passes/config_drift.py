@@ -147,7 +147,8 @@ def _check_values_consumed(ctx: Context) -> List[Finding]:
             check_key("engineConfig", key)
     router = data.get("routerSpec") or {}
     check_map("routerSpec", router)
-    for sub in ("resilience", "observability", "slo", "diagnostics"):
+    for sub in ("resilience", "observability", "slo", "tenancy",
+                "diagnostics"):
         for key in (router.get(sub) or {}):
             check_key(f"routerSpec.{sub}", key)
     check_map("cacheserverSpec", data.get("cacheserverSpec") or {})

@@ -16,6 +16,11 @@ Absorbs ``tools/metrics_lint.py`` (now a thin shim over this pass) as the
   (request/trace/span/session ids) makes Prometheus mint one series per
   request: unbounded cardinality that melts the TSDB. Ids belong in
   traces and the flight recorder, never in labels.
+* bounded identity labels — a free-form identity label (``tenant``,
+  ``user``, ``adapter``) is caller-controlled, so its value space is
+  unbounded unless the exporting module folds it to top-K + ``"other"``
+  first. Any file declaring such a metric must reference the shared
+  capping helpers (``tenancy.fold_top_k`` / ``fold_records``).
 * duplicate registration — two constructors declaring the same metric
   name against the default registry raise ``Duplicated timeseries`` at
   import time in whichever process imports both modules.
@@ -51,6 +56,10 @@ _ID_LABEL = re.compile(
     r"(^|_)(request_?id|req_?id|trace_?id|span_?id|session_?id|"
     r"correlation_?id|uuid|user_?id|id)$"
 )
+# caller-controlled identity labels: bounded only if the declaring file
+# routes values through the shared top-K + "other" capping helpers
+_IDENTITY_LABELS = {"tenant", "user", "adapter"}
+_FOLD_HELPERS = re.compile(r"\bfold_(?:top_k|records)\b")
 
 
 def normalize(name: str) -> str:
@@ -205,7 +214,30 @@ def _labels_and_duplicates(ctx: Context) -> List[Finding]:
     return out
 
 
+def _bounded_identity(ctx: Context) -> List[Finding]:
+    """Free-form identity labels must be capped at the export boundary.
+
+    File-scoped on purpose: the fold happens right where label values are
+    set, so a declaring module that never mentions the helpers cannot be
+    bounding anything."""
+    out: List[Finding] = []
+    folded = {ctx.rel(p) for p in ctx.py_files("production_stack_tpu")
+              if _FOLD_HELPERS.search(ctx.read(p))}
+    for rel, lineno, name, labels, _registry, _family in _declarations(ctx):
+        if rel in folded:
+            continue
+        for label in labels:
+            if label in _IDENTITY_LABELS:
+                out.append(Finding(
+                    PASS, rel, lineno,
+                    f"metric {name!r} label {label!r} is free-form "
+                    f"identity: cap its cardinality with "
+                    f"tenancy.fold_top_k/fold_records (top-K + 'other') "
+                    f"before export"))
+    return out
+
+
 @register(PASS, "metric drift (dashboards/docs/code), per-request labels, "
-                "duplicate registration")
+                "unbounded identity labels, duplicate registration")
 def run(ctx: Context) -> List[Finding]:
-    return _drift(ctx) + _labels_and_duplicates(ctx)
+    return _drift(ctx) + _labels_and_duplicates(ctx) + _bounded_identity(ctx)

@@ -27,7 +27,15 @@ import urllib.request
 COLUMNS = (
     ("ENGINE", 28), ("MODEL", 14), ("ROLE", 7), ("STATUS", 10), ("CHIPS", 5),
     ("MFU", 6), ("ICI", 6), ("HBM", 12), ("KVFREE", 7), ("HOSTHIT", 7),
-    ("WAIT", 5), ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("INCIDENTS", 14),
+    ("WAIT", 5), ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("TENANT", 14),
+    ("INCIDENTS", 14),
+)
+
+# --tenants mode: one row per tenant, aggregated across every engine's
+# attribution block (chip-second conservation means SHARE sums to 100%)
+TENANT_COLUMNS = (
+    ("TENANT", 20), ("PREFILL", 10), ("DECODE", 10), ("CHIPSEC", 10),
+    ("SHARE", 7), ("KVBLK", 7), ("REQS", 7), ("QUEUE", 8),
 )
 
 
@@ -61,6 +69,19 @@ def _fmt_host_hit(row: dict) -> str:
     return f"{host.get('hits', 0) / queries * 100:.1f}%"
 
 
+def _fmt_top_tenant(row: dict) -> str:
+    """Dominant tenant by chip-second share from the engine's attribution
+    block; '-' for engines with metering off or before the first token."""
+    block = row.get("tenants") or {}
+    tenants = block.get("tenants") or {}
+    total = sum((t or {}).get("chip_seconds", 0.0) for t in tenants.values())
+    if not total:
+        return "-"
+    name, rec = max(tenants.items(),
+                    key=lambda kv: (kv[1] or {}).get("chip_seconds", 0.0))
+    return f"{name} {rec.get('chip_seconds', 0.0) / total * 100:.0f}%"
+
+
 def _clip(s: str, width: int) -> str:
     s = str(s)
     return s if len(s) <= width else s[: width - 1] + "…"
@@ -82,6 +103,7 @@ def engine_row_cells(row: dict) -> list:
         _fmt_num(row.get("running"), "d"),
         _fmt_num(row.get("qps")),
         _fmt_num(row.get("ttft"), ".3f"),
+        _fmt_top_tenant(row),
         ",".join(row.get("incidents") or []) or "-",
     ]
 
@@ -130,6 +152,63 @@ def render_table(snapshot: dict) -> str:
     return "\n".join(lines)
 
 
+def render_tenants(snapshot: dict) -> str:
+    """Pure /debug/fleet document → per-tenant attribution table,
+    aggregated across every engine's tenants block. SHARE is each
+    tenant's fraction of all attributed chip-seconds — conservation
+    means the column sums to ~100% whenever any engine metered."""
+    agg: dict[str, dict] = {}
+    for row in snapshot.get("engines", []):
+        block = row.get("tenants") or {}
+        for name, rec in (block.get("tenants") or {}).items():
+            rec = rec or {}
+            a = agg.setdefault(name, {
+                "prefill_tokens": 0, "decode_tokens": 0,
+                "chip_seconds": 0.0, "kv_blocks": 0, "requests": 0,
+                "queue_seconds_sum": 0.0,
+            })
+            for key in a:
+                a[key] += rec.get(key, 0)
+    total_chip = sum(a["chip_seconds"] for a in agg.values())
+
+    lines = []
+    header = "  ".join(name.ljust(width) for name, width in TENANT_COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name in sorted(agg, key=lambda n: -agg[n]["chip_seconds"]):
+        a = agg[name]
+        reqs = a["requests"]
+        cells = [
+            name,
+            _fmt_num(a["prefill_tokens"], "d"),
+            _fmt_num(a["decode_tokens"], "d"),
+            _fmt_num(a["chip_seconds"], ".3f"),
+            (f"{a['chip_seconds'] / total_chip * 100:.1f}%"
+             if total_chip else "-"),
+            _fmt_num(a["kv_blocks"], "d"),
+            _fmt_num(reqs, "d"),
+            (_fmt_num(a["queue_seconds_sum"] / reqs, ".4f")
+             if reqs else "-"),
+        ]
+        lines.append("  ".join(
+            _clip(cell, width).ljust(width)
+            for cell, (_, width) in zip(cells, TENANT_COLUMNS)))
+    if not agg:
+        lines.append("(no tenant attribution — engines meter with "
+                     "--no-tenant-metering unset)")
+
+    router_block = (snapshot.get("router") or {}).get("tenants") or {}
+    tenants = router_block.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append("router (5m window): " + ", ".join(
+            f"{name}={rec.get('requests', 0)}req"
+            + (f"/{rec['avg_ttft']:.3f}s ttft"
+               if rec.get("avg_ttft", -1) >= 0 else "")
+            for name, rec in sorted(tenants.items())))
+    return "\n".join(lines)
+
+
 def fetch_fleet(router: str, timeout: float = 10.0) -> dict:
     url = router.rstrip("/") + "/debug/fleet"
     with urllib.request.urlopen(url, timeout=timeout) as resp:
@@ -145,6 +224,10 @@ def main(argv=None) -> int:
                    help="refresh every N seconds (0 = one shot)")
     p.add_argument("--json", action="store_true",
                    help="print the raw /debug/fleet document instead")
+    p.add_argument("--tenants", action="store_true",
+                   help="per-tenant attribution table (tokens, "
+                        "chip-seconds, fairness share) instead of the "
+                        "engine table")
     args = p.parse_args(argv)
 
     while True:
@@ -162,8 +245,9 @@ def main(argv=None) -> int:
         else:
             stamp = time.strftime("%H:%M:%S", time.localtime(
                 snap.get("ts", time.time())))
-            out = f"stacktop @ {stamp}  ({args.router})\n" + \
-                render_table(snap)
+            table = (render_tenants(snap) if args.tenants
+                     else render_table(snap))
+            out = f"stacktop @ {stamp}  ({args.router})\n" + table
         if args.watch:
             # clear + home, like watch(1), so the table repaints in place
             sys.stdout.write("\x1b[2J\x1b[H")
