@@ -19,7 +19,13 @@ independent):
   7. disaggregated prefill/decode: the same streamed requests through
      the orchestrated router over a 1-prefill + 1-decode pool vs one
      unified engine — TTFT/ITL p50/p95, the P→D transfer cost per
-     request, and greedy bit-identity of every stream pair.
+     request, and greedy bit-identity of every stream pair,
+  8. tiered KV cache on multi-round QA: turn-N TTFT with the host tier
+     off vs on under HBM eviction pressure, tier hit ratios, and
+  9. noisy-neighbor fair-share: 8 tenants, one submitting 10x a
+     victim's request count — victim TTFT/ITL p95 with the scheduler's
+     DRR fair-share pass off vs on, greedy bit-identity across the
+     toggle (fairness is pure host-side ordering).
 
 Prints ONE JSON line (driver contract): the headline metric/value/unit/
 vs_baseline plus the scenario numbers as extra keys.
@@ -619,6 +625,138 @@ def run_bench() -> None:
         "greedy_identical": on_answers == off_answers,
     }
 
+    # 9) noisy-neighbor fair-share: 8 tenants, one submitting 10x a
+    # victim's request count into a scheduler with room for only a few
+    # concurrent sequences — the FIFO admission queue makes every victim
+    # wait out the noisy tenant's backlog. With --fair-share the stride
+    # dequeue + DRR token split serve victims at their weight instead.
+    # Fairness is pure host-side ordering, so every tenant's greedy
+    # output must be bit-identical across the toggle.
+    nn_victims = 7
+    nn_victim_reqs = 2 if on_tpu else 1
+    nn_noisy_reqs = 10 * nn_victim_reqs
+    nn_prompt = 256 if on_tpu else 96
+    nn_out = 32 if on_tpu else 12
+    nn_sched = dataclasses.replace(
+        cfg.scheduler, max_num_seqs=8 if on_tpu else 4,
+        max_num_batched_tokens=256,
+        prefill_buckets=(256,) if on_tpu else (128,))
+    nn_noisy_prompts = [prompt(nn_prompt) for _ in range(nn_noisy_reqs)]
+    nn_victim_prompts = [[prompt(nn_prompt) for _ in range(nn_victim_reqs)]
+                         for _ in range(nn_victims)]
+
+    # the enforcement run gates submissions through the REAL router-tier
+    # QuotaManager (submission is this harness' admission point): noisy's
+    # bucket holds 2 requests with ~zero refill, so 8 of its 10 burst
+    # requests are rejected before ever touching the engine
+    from production_stack_tpu.router.quota import QuotaManager
+
+    nn_noisy_budget = 2
+    nn_quota = QuotaManager.from_json(json.dumps({"tenants": {"noisy": {
+        "rps": 0.001, "burst_s": nn_noisy_budget / 0.001}}}))
+
+    def fairness_run(fair: bool, quota=None):
+        nonlocal engine
+        engine = LLMEngine(
+            dataclasses.replace(
+                cfg, scheduler=dataclasses.replace(nn_sched,
+                                                   fair_share=fair),
+                model=dataclasses.replace(cfg.model, quant=None)),
+            mesh=mesh, num_blocks=num_blocks,
+        )
+        run_batch(f"nn-warm-{fair}", [prompt(nn_prompt)] * 2, 4)
+        sp = SamplingParams(temperature=0.0, max_tokens=nn_out,
+                            ignore_eos=True)
+        submit: dict[str, float] = {}
+        stamps: dict[str, list] = {}
+        outs: dict[str, list] = {}
+        rejections: dict[str, int] = {}
+
+        def _admit(rid, toks, tenant):
+            if quota is not None:
+                verdict = quota.check(tenant, nn_prompt + nn_out,
+                                      now=time.monotonic())
+                if not verdict.allowed:
+                    rejections[tenant] = rejections.get(tenant, 0) + 1
+                    return
+            engine.add_request(rid, prompt_token_ids=toks, sampling=sp,
+                               tenant=tenant)
+            submit[rid] = time.perf_counter()
+
+        # the noisy tenant's burst lands first: without enforcement every
+        # victim queues behind all of it
+        for i in range(nn_noisy_reqs):
+            _admit(f"nn-noisy-{i}", nn_noisy_prompts[i], "noisy")
+        for v in range(nn_victims):
+            for i in range(nn_victim_reqs):
+                _admit(f"nn-v{v}-{i}", nn_victim_prompts[v][i],
+                       f"tenant-{v}")
+        while engine.has_unfinished():
+            for out in engine.step():
+                if out.new_token_ids:
+                    stamps.setdefault(out.request_id, []).append(
+                        time.perf_counter())
+                    outs.setdefault(out.request_id, []).extend(
+                        out.new_token_ids)
+        victim = [r for r in stamps if not r.startswith("nn-noisy")]
+        noisy = [r for r in stamps if r.startswith("nn-noisy")]
+
+        def _ttfts(rids):
+            return [(stamps[r][0] - submit[r]) * 1000.0 for r in rids]
+
+        def _itls(rids):
+            return [(b - a) * 1000.0 for r in rids
+                    for a, b in zip(stamps[r], stamps[r][1:])]
+
+        row = {
+            "victim_ttft_p95_ms": round(pctl(_ttfts(victim), 95), 1),
+            "victim_itl_p95_ms": round(pctl(_itls(victim), 95), 1),
+            "noisy_ttft_p95_ms": round(pctl(_ttfts(noisy), 95), 1),
+            "victim_itl_p95_ms_by_tenant": {
+                f"tenant-{v}": round(pctl(_itls(
+                    [r for r in victim if r.startswith(f"nn-v{v}-")]),
+                    95), 1)
+                for v in range(nn_victims)},
+        }
+        del engine
+        gc.collect()
+        engine = None
+        return row, outs, rejections
+
+    nn_off, nn_off_outs, _ = fairness_run(False)
+    nn_on, nn_on_outs, nn_rejections = fairness_run(True, quota=nn_quota)
+    fair_row = {
+        "tenants": nn_victims + 1,
+        "noisy_over_victim_requests": nn_noisy_reqs // nn_victim_reqs,
+        "victim_ttft_p95_ms": {
+            "enforcement_off": nn_off["victim_ttft_p95_ms"],
+            "enforcement_on": nn_on["victim_ttft_p95_ms"],
+        },
+        "victim_itl_p95_ms": {
+            "enforcement_off": nn_off["victim_itl_p95_ms"],
+            "enforcement_on": nn_on["victim_itl_p95_ms"],
+        },
+        "victim_itl_p95_ms_by_tenant": {
+            t: {"enforcement_off": nn_off["victim_itl_p95_ms_by_tenant"][t],
+                "enforcement_on": nn_on["victim_itl_p95_ms_by_tenant"][t]}
+            for t in nn_off["victim_itl_p95_ms_by_tenant"]},
+        "noisy_ttft_p95_ms": {
+            "enforcement_off": nn_off["noisy_ttft_p95_ms"],
+            "enforcement_on": nn_on["noisy_ttft_p95_ms"],
+        },
+        "victim_ttft_speedup": round(
+            nn_off["victim_ttft_p95_ms"]
+            / max(nn_on["victim_ttft_p95_ms"], 1e-9), 3),
+        "quota": {"noisy_budget_requests": nn_noisy_budget,
+                  "rejections": nn_rejections},
+        # every request admitted under enforcement (all victims + noisy's
+        # in-budget head) generated the same greedy tokens as the
+        # enforcement-off run — fairness/quota are pure admission +
+        # ordering, never a dispatch-shape change
+        "greedy_identical_in_budget": all(
+            nn_on_outs[r] == nn_off_outs[r] for r in nn_on_outs),
+    }
+
     target = 2000.0
     print(json.dumps({
         "metric": f"output throughput ({model}, {quant or 'bf16'}, "
@@ -680,6 +818,7 @@ def run_bench() -> None:
         },
         "disagg": disagg_row,
         "kv_tiering": tier_row,
+        "noisy_neighbor": fair_row,
     }))
 
 

@@ -497,6 +497,26 @@ class SchedulerConfig:
     spec_ngram_min: int = 1
     # how many trailing history tokens the proposer searches
     spec_window: int = 4096
+    # per-tenant fair share (ROADMAP item 3: "a noisy tenant must not
+    # starve others' ITL"). When on AND >=2 tenants are present, the
+    # unified prefill budget is split deficit-round-robin by tenant
+    # weight and the waiting queue dequeues weighted-fair instead of
+    # FIFO. Default OFF, and with a single tenant both paths reduce to
+    # the exact FCFS schedule (bit-identity pinned in
+    # tests/test_fair_share.py) — fairness is pure host-side ordering,
+    # never a new dispatch signature.
+    fair_share: bool = False
+    # tenant -> relative weight (default 1.0 per tenant). Unknown
+    # tenants weigh 1.0; weights only matter relative to each other.
+    # Shared with the stage-3 brownout over-weight shed set.
+    tenant_weights: dict = dataclasses.field(default_factory=dict)
+
+    def tenant_weight(self, tenant: str) -> float:
+        try:
+            w = float(self.tenant_weights.get(tenant, 1.0))
+        except (TypeError, ValueError):
+            return 1.0
+        return w if w > 0 else 1.0
 
     @property
     def decode_horizon(self) -> int:

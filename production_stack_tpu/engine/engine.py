@@ -141,6 +141,11 @@ class LLMEngine:
         # direction "in" = promotion into the pool, "out" = demotion/offload
         self._prefetcher = None
         self.hbm_demotions = 0
+        # brownout stage 2+ (engine/overload.py): stop LAUNCHING new
+        # warm-tier prefetches; admitted sequences fall back to a plain
+        # cold prefill (correct, just not prefetched)
+        self.prefetch_paused = False
+        self.prefetch_shed_count = 0
         self.prefetch_blocks = 0
         self.prefetch_count = 0
         self.prefetch_seconds_sum = 0.0
@@ -550,6 +555,9 @@ class LLMEngine:
         top of a later step). The old synchronous import stalled the whole
         serving loop for up to the remote timeout per admission; now a cold
         tier delays only this sequence's own prefill."""
+        if self.prefetch_paused:
+            self.prefetch_shed_count += 1
+            return
         if self._prefetcher.submit(seq) is not None:
             seq.status = SequenceStatus.PREFETCHING
 

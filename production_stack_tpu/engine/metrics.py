@@ -465,6 +465,56 @@ class DiagnosticsCollector:
         yield seconds
 
 
+class OverloadCollector:
+    """Brownout / fair-share families (engine tier), read at scrape time
+    from ``EngineServer._overload_snapshot`` — same snapshot-callable
+    pattern as ``LifecycleCollector``. The router exports the same
+    ``vllm:brownout_*`` families with ``tier="router"`` from the default
+    registry (router/metrics.py); the tier label keeps a shared scrape
+    collision-free. Per-tenant deficits come from the scheduler's DRR
+    state, whose tenant set is already bounded (deficits exist only for
+    tenants with pending work) and folded upstream via fold_records'
+    top-k discipline on the attribution plane."""
+
+    def __init__(self, source, model_name: str):
+        self.source = source
+        self.model_name = model_name
+
+    def collect(self):
+        s = self.source()
+        b = s.get("brownout") or {}
+        stage = GaugeMetricFamily(
+            "vllm:brownout_stage",
+            "Current staged-degradation level (0 healthy; 1 spec-decode "
+            "grants shed; 2 + max_tokens clamped, KV prefetch paused; "
+            "3 + over-weight tenants' new admissions shed)",
+            labels=["model_name", "tier"],
+        )
+        stage.add_metric([self.model_name, "engine"],
+                         float(b.get("stage", 0)))
+        yield stage
+        sheds = CounterMetricFamily(
+            "vllm:brownout_sheds",
+            "Work shed by the brownout ladder, by reason (spec grants "
+            "suppressed, max_tokens clamps, prefetches skipped, tenant "
+            "admissions refused)",
+            labels=["model_name", "reason", "tier"],
+        )
+        for reason, count in sorted((b.get("sheds") or {}).items()):
+            sheds.add_metric([self.model_name, reason, "engine"], count)
+        yield sheds
+        fair = s.get("fair_share") or {}
+        deficit = GaugeMetricFamily(
+            "vllm:fair_share_deficit",
+            "Carried deficit-round-robin credit per tenant, in stream "
+            "tokens (positive = the tenant is owed budget next dispatch)",
+            labels=["model_name", "tenant"],
+        )
+        for tenant, value in sorted((fair.get("deficits") or {}).items()):
+            deficit.add_metric([self.model_name, tenant], value)
+        yield deficit
+
+
 _BUCKETS_TTFT = (
     0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5, 0.75,
     1.0, 2.5, 5.0, 7.5, 10.0,
@@ -564,6 +614,11 @@ class ServerMetrics:
         """Attach the anomaly-capture stats source
         (DiagnosticsManager.stats on EngineServer)."""
         self.registry.register(DiagnosticsCollector(source, self.model_name))
+
+    def register_overload(self, source) -> None:
+        """Attach the brownout/fair-share snapshot source
+        (EngineServer._overload_snapshot)."""
+        self.registry.register(OverloadCollector(source, self.model_name))
 
     def generate(self) -> bytes:
         from prometheus_client import generate_latest

@@ -41,7 +41,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
-from production_stack_tpu.tenancy import fold_records, split_shares
+from production_stack_tpu.tenancy import OTHER, fold_records, split_shares
 
 # docs/roofline.md ("Rooflines (v5e: 197 TFLOP/s bf16, 819 GB/s HBM)")
 V5E_PEAK_TFLOPS = 197.0
@@ -215,6 +215,13 @@ class PerfAccountant:
         self._tenant_cap = max(4 * self.tenant_top_k, 64)
         self._tenants: Dict[str, Dict[str, float]] = {}
         self._tenant_seconds = 0.0  # total attributed dispatch seconds
+        # last-activity stamp per tenant: rows idle past tenant_idle_expiry
+        # are dropped (their cumulative sums fold into "other" first, so
+        # fleet totals conserve) — a month-long process doesn't pin every
+        # tenant ever seen under the fold cap. 6h matches the router
+        # tracker's bin horizon (router/slo.py _HORIZON).
+        self.tenant_idle_expiry = 21600.0
+        self._tenant_seen: Dict[str, float] = {}
 
     @classmethod
     def from_runner(cls, config, runner) -> "PerfAccountant":
@@ -423,14 +430,16 @@ class PerfAccountant:
         live = {t: rec.get("live", 0) for t, rec in tenants.items()
                 if rec.get("live", 0) > 0}
         shares = split_shares(seconds, live) if seconds > 0 else {}
+        now = time.monotonic()
         with self._lock:
             for t, rec in tenants.items():
                 row = self._tenant_row(t)
                 row["prefill_tokens"] += int(rec.get("prefill", 0))
                 row["decode_tokens"] += int(rec.get("decode", 0))
                 row["chip_seconds"] += shares.get(t, 0.0)
+                self._tenant_seen[t] = now
             self._tenant_seconds += sum(shares.values())
-            self._bound_tenants()
+            self._bound_tenants(now)
 
     def _tenant_row(self, tenant: str) -> dict:
         return self._tenants.setdefault(
@@ -438,13 +447,41 @@ class PerfAccountant:
                      "chip_seconds": 0.0, "requests": 0,
                      "queue_seconds_sum": 0.0})
 
-    def _bound_tenants(self) -> None:
+    def _bound_tenants(self, now: Optional[float] = None) -> None:
+        self.expire_idle_tenants(now, _locked=True)
         if len(self._tenants) > self._tenant_cap:
             # bound the *internal* table too, not just the export: fold
             # the smallest records into "other" (sums conserved)
             self._tenants = fold_records(
                 self._tenants, k=self._tenant_cap // 2,
                 weight_key="chip_seconds")
+            self._tenant_seen = {t: ts for t, ts in
+                                 self._tenant_seen.items()
+                                 if t in self._tenants}
+
+    def expire_idle_tenants(self, now: Optional[float] = None,
+                            _locked: bool = False) -> int:
+        """Fold tenants idle past ``tenant_idle_expiry`` (6h, the router
+        tracker's bin horizon) into the ``"other"`` row. Cumulative sums
+        conserve — only the identity is forgotten — and the cap slots
+        recycle under identity churn instead of pinning every tenant
+        ever seen for the life of the process. Returns the number
+        expired."""
+        now = now if now is not None else time.monotonic()
+        if not _locked:
+            with self._lock:
+                return self.expire_idle_tenants(now, _locked=True)
+        cutoff = now - self.tenant_idle_expiry
+        stale = [t for t, ts in self._tenant_seen.items()
+                 if ts < cutoff and t != OTHER]
+        for t in stale:
+            row = self._tenants.pop(t, None)
+            self._tenant_seen.pop(t, None)
+            if row:
+                other = self._tenant_row(OTHER)
+                for k, v in row.items():
+                    other[k] = other.get(k, 0) + v
+        return len(stale)
 
     def note_request(self, tenant: str, queue_seconds: float) -> None:
         """One finished request: per-tenant request count and queue-time
@@ -452,11 +489,13 @@ class PerfAccountant:
         ``vllm:tenant_queue_time_seconds``."""
         if not self.tenant_metering:
             return
+        now = time.monotonic()
         with self._lock:
             row = self._tenant_row(tenant)
             row["requests"] += 1
             row["queue_seconds_sum"] += max(float(queue_seconds), 0.0)
-            self._bound_tenants()
+            self._tenant_seen[tenant] = now
+            self._bound_tenants(now)
 
     def attribute_seconds(self, tenant_live: dict,
                           seconds: float) -> None:

@@ -35,6 +35,15 @@ from production_stack_tpu.engine.diagnostics import (
 from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.lifecycle import StepWatchdog
 from production_stack_tpu.engine.metrics import ServerMetrics
+from production_stack_tpu.engine.overload import (
+    BrownoutController,
+    PressureSignals,
+    SHED_MAX_TOKENS,
+    SHED_PREFETCH,
+    SHED_SPEC,
+    SHED_TENANT,
+    overweight_tenants,
+)
 from production_stack_tpu.engine import tracing as etracing
 from production_stack_tpu.flight_recorder import FlightRecorder
 from production_stack_tpu.tenancy import resolve_tenant
@@ -215,7 +224,8 @@ class EngineServer:
                  flight_recorder_size: int = 256,
                  drain_deadline: float = 30.0,
                  watchdog_stall_seconds: float = 0.0,
-                 diagnostics: Optional[DiagnosticsConfig] = None):
+                 diagnostics: Optional[DiagnosticsConfig] = None,
+                 brownout: Optional[BrownoutController] = None):
         self.config = config
         self.warmup_on_start = warmup_on_start
         self.model_name = config.model.name
@@ -232,9 +242,21 @@ class EngineServer:
         # this table until the attach splices them into a sequence (then
         # the scheduler owns them) or the TTL sweep frees them.
         self._kv_transfers: dict = {}
-        # Retry-After seconds advertised on overload 429s; the router's
-        # circuit breaker uses it as the ejection cooldown
+        # Floor for the Retry-After seconds advertised on overload 429s;
+        # the actual value is derived from the admission queue's depth and
+        # recent drain rate (scheduler.retry_after_hint), so a deep queue
+        # advertises a proportionally longer backoff. The router's circuit
+        # breaker uses it as the ejection cooldown.
         self.overload_retry_after = overload_retry_after
+        # staged brownout degradation (engine/overload.py): evaluated on
+        # its own asyncio loop against scheduler depth / HBM occupancy /
+        # watchdog state; None = feature off (default)
+        self.brownout = brownout
+        self._brownout_task: Optional[asyncio.Task] = None
+        # stage-3 shed set, recomputed each evaluation from live per-tenant
+        # scheduler load (overweight_tenants); admission checks membership
+        self._brownout_shed: set = set()
+        self._shed_counts_seen = {"spec": 0, "prefetch": 0}
         from production_stack_tpu.engine.lora import LoraManager
 
         self.lora = LoraManager(self.engine)
@@ -280,6 +302,7 @@ class EngineServer:
         self.watchdog = StepWatchdog(self.async_engine,
                                      watchdog_stall_seconds)
         self.metrics.register_lifecycle(self._lifecycle_snapshot)
+        self.metrics.register_overload(self._overload_snapshot)
         # -- anomaly-triggered diagnostic bundles (engine/diagnostics.py):
         # subscribe the capture manager to the bug signals this server
         # already raises — unexpected recompile, watchdog stall, drain-
@@ -370,6 +393,7 @@ class EngineServer:
         app.router.add_post("/debug/profile", self.profile)
         app.router.add_get("/debug/memory", self.memory_profile)
         app.router.add_get("/debug/perf", self.debug_perf)
+        app.router.add_get("/debug/overload", self.debug_overload)
         app.router.add_get("/debug/tenants", self.debug_tenants)
         app.router.add_get("/debug/requests", self.debug_requests)
         app.router.add_get("/debug/diagnostics", self.diagnostics_index)
@@ -400,6 +424,9 @@ class EngineServer:
             self.warming = True
             self._warmup_t0 = time.monotonic()
             self._warmup_task = asyncio.ensure_future(self._run_warmup())
+        if self.brownout is not None and self.brownout.config.enabled:
+            self._brownout_task = asyncio.ensure_future(
+                self._brownout_worker())
 
     async def _run_warmup(self) -> None:
         assert self._warmup_t0 is not None
@@ -414,6 +441,8 @@ class EngineServer:
     async def _on_stop(self, app) -> None:
         if self._warmup_task is not None:
             self._warmup_task.cancel()
+        if self._brownout_task is not None:
+            self._brownout_task.cancel()
         if self._drain_task is not None:
             self._drain_task.cancel()
         self.watchdog.stop()
@@ -454,6 +483,90 @@ class EngineServer:
             "warming": self.warming,
             "warmup_seconds": self.warmup_seconds,
         }
+
+    # -- staged brownout (engine/overload.py) --------------------------------
+    async def _brownout_worker(self) -> None:
+        """Periodic pressure evaluation: read the signals ON the engine
+        thread (scheduler/accountant state is engine-owned), step the
+        hysteretic controller, then push the stage actions back onto the
+        engine thread. Everything a stage changes is host-side admission/
+        grant policy — the jitted programs never see a different shape."""
+        ctl = self.brownout
+        assert ctl is not None
+        while True:
+            await asyncio.sleep(ctl.config.interval)
+            try:
+                sig = await self.async_engine.run_on_engine(
+                    lambda eng: self._pressure_signals(eng))
+                prev = ctl.stage
+                ctl.evaluate(sig, time.monotonic())
+                if ctl.stage != prev:
+                    _log.warning(
+                        "brownout stage %d -> %d (%s)", prev, ctl.stage,
+                        ",".join(ctl.last_reasons) or "recovered")
+                await self.async_engine.run_on_engine(
+                    lambda eng: self._apply_brownout(eng))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                _log.exception("brownout evaluation failed")
+
+    def _pressure_signals(self, eng) -> PressureSignals:
+        """Build one evaluation's signals (runs on the engine thread)."""
+        sched = eng.scheduler
+        qcap = max(1, int(getattr(sched.config, "max_queue_len", 0) or 0))
+        qfrac = len(sched.waiting) / qcap
+        hbm_frac = 0.0
+        perf = getattr(eng, "perf", None)
+        if perf is not None:
+            hbm = getattr(perf, "_hbm", None) or {}
+            total = hbm.get("total") or 0
+            if total > 0:
+                hbm_frac = hbm.get("used", 0) / total
+        return PressureSignals(
+            queue_fraction=qfrac,
+            hbm_fraction=hbm_frac,
+            watchdog_stalled=self.watchdog.stalled,
+        )
+
+    def _apply_brownout(self, eng) -> None:
+        """Push the current stage's actions onto engine-owned state (runs
+        on the engine thread) and fold the engine-side shed tallies into
+        the controller's counter source."""
+        ctl = self.brownout
+        sched = eng.scheduler
+        sched.spec_shed = ctl.shed_spec
+        eng.prefetch_paused = ctl.pause_prefetch
+        # engine-side tallies (grants suppressed, prefetches skipped) are
+        # counted where they happen; diff them into ctl.sheds here
+        for reason, attr, obj in ((SHED_SPEC, "spec_shed_count", sched),
+                                  (SHED_PREFETCH, "prefetch_shed_count",
+                                   eng)):
+            total = getattr(obj, attr, 0)
+            delta = total - self._shed_counts_seen[reason]
+            if delta > 0:
+                ctl.record_shed(reason, delta)
+                self._shed_counts_seen[reason] = total
+        if ctl.shed_overweight:
+            self._brownout_shed = set(overweight_tenants(
+                sched.tenant_loads(),
+                getattr(sched.config, "tenant_weights", None)))
+        elif self._brownout_shed:
+            self._brownout_shed = set()
+
+    def _overload_snapshot(self) -> dict:
+        """Scrape-time source for vllm:brownout_* / vllm:fair_share_deficit
+        (engine/metrics.py OverloadCollector) and /debug/overload."""
+        ctl = self.brownout
+        return {
+            "brownout": (ctl.snapshot() if ctl is not None
+                         else {"enabled": False, "stage": 0, "sheds": {}}),
+            "shed_tenants": sorted(self._brownout_shed),
+            "fair_share": self.engine.scheduler.fair_share_snapshot(),
+        }
+
+    async def debug_overload(self, request: web.Request) -> web.Response:
+        return web.json_response(self._overload_snapshot())
 
     def begin_drain(self, reason: str) -> bool:
         """Flip SERVING → DRAINING (idempotent; returns False when already
@@ -2187,6 +2300,23 @@ class EngineServer:
         # fresh resolution for callers that enter here directly
         tenant = request.get("tenant") or resolve_tenant(request.headers,
                                                         body)
+        ctl = self.brownout
+        if ctl is not None and ctl.stage > 0:
+            # stage 3: refuse NEW work from over-weight tenants. A pushed
+            # P->D continuation is not new work — shedding it would kill a
+            # stream whose prefill already ran, so it always passes.
+            if (ctl.shed_overweight and tenant in self._brownout_shed
+                    and not kv_params.get("transfer_id")):
+                ctl.record_shed(SHED_TENANT)
+                return self._overloaded(
+                    f"brownout stage {ctl.stage}: tenant {tenant!r} is over "
+                    "its fair share; new admissions are shed until pressure "
+                    "recedes")
+            # stage 2: bound tail work by clamping per-request max_tokens
+            clamp = ctl.max_tokens_clamp
+            if clamp and sampling.max_tokens > clamp:
+                ctl.record_shed(SHED_MAX_TOKENS)
+                sampling = dataclasses.replace(sampling, max_tokens=clamp)
         reqs, rids = [], []
         for pi, prompt_ids in enumerate(prompt_ids_list):
             for j in range(n):
@@ -2261,11 +2391,19 @@ class EngineServer:
     def _overloaded(self, msg: str) -> web.Response:
         """429 with Retry-After: an HONEST overload signal the router's
         circuit breaker respects (fails over now, throttles this backend
-        for the advertised interval)."""
+        for the advertised interval). The interval is derived from the
+        admission queue's depth over its recent drain rate — a deep queue
+        behind a slow engine advertises a proportionally longer backoff —
+        with ``overload_retry_after`` as the floor."""
+        try:
+            retry_after = self.engine.scheduler.retry_after_hint(
+                floor=self.overload_retry_after)
+        except Exception:
+            retry_after = self.overload_retry_after
         return web.json_response(
             {"error": {"message": msg, "type": "rate_limit_error"}},
             status=429,
-            headers={"Retry-After": f"{self.overload_retry_after:g}"},
+            headers={"Retry-After": f"{retry_after:g}"},
         )
 
     async def _abort_all(self, tasks, rids):
@@ -2888,7 +3026,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "of piling onto an overloaded engine (0 = "
                         "unbounded)")
     p.add_argument("--overload-retry-after", type=float, default=1.0,
-                   help="Retry-After seconds advertised on overload 429s")
+                   help="floor for the Retry-After seconds advertised on "
+                        "overload 429s (the actual value scales with queue "
+                        "depth over the recent admission drain rate)")
+    p.add_argument("--fair-share", action="store_true",
+                   help="per-tenant deficit-round-robin scheduling: split "
+                        "the prefill token budget across tenants with "
+                        "pending work by weight and a weighted-fair "
+                        "admission dequeue, so one flooding tenant queues "
+                        "behind everyone else instead of starving them. "
+                        "With a single active tenant the schedule is "
+                        "bit-identical to FCFS")
+    p.add_argument("--tenant-weights", default=None,
+                   help="JSON object tenant -> relative weight for "
+                        "--fair-share and stage-3 brownout shedding, e.g. "
+                        "'{\"team-a\": 3, \"team-b\": 1}'; unlisted "
+                        "tenants weigh 1.0")
+    p.add_argument("--brownout", action="store_true",
+                   help="staged brownout degradation under sustained "
+                        "pressure (queue depth, HBM occupancy, watchdog "
+                        "stall): stage 1 sheds speculative-decode grants, "
+                        "stage 2 clamps max_tokens and pauses KV "
+                        "prefetch, stage 3 sheds over-weight tenants' new "
+                        "admissions; recovery needs sustained calm")
+    p.add_argument("--brownout-interval", type=float, default=2.0,
+                   help="seconds between brownout pressure evaluations")
+    p.add_argument("--brownout-queue-high", type=float, default=0.5,
+                   help="waiting/max-queue-len fraction treated as hot")
+    p.add_argument("--brownout-hbm-high", type=float, default=0.92,
+                   help="HBM used/total fraction treated as hot")
+    p.add_argument("--brownout-up-evals", type=int, default=2,
+                   help="consecutive hot evaluations per stage up")
+    p.add_argument("--brownout-calm-evals", type=int, default=3,
+                   help="consecutive calm evaluations per stage down")
+    p.add_argument("--brownout-max-tokens-clamp", type=int, default=256,
+                   help="stage-2 per-request max_tokens ceiling")
     p.add_argument("--drain-deadline", type=float, default=30.0,
                    help="graceful-drain budget (seconds): on SIGTERM or "
                         "POST /drain, in-flight sequences get this long "
@@ -3086,6 +3258,17 @@ def config_from_args(args) -> EngineConfig:
         cfg.scheduler.spec_window = args.speculative_window
     if args.max_queue_len is not None:
         cfg.scheduler.max_queue_len = args.max_queue_len
+    if getattr(args, "fair_share", False):
+        cfg.scheduler.fair_share = True
+    if getattr(args, "tenant_weights", None):
+        try:
+            weights = json.loads(args.tenant_weights)
+        except ValueError as e:
+            raise SystemExit(f"--tenant-weights is not valid JSON: {e}")
+        if not isinstance(weights, dict):
+            raise SystemExit("--tenant-weights must be a JSON object "
+                             "(tenant -> weight)")
+        cfg.scheduler.tenant_weights = weights
     if args.host_offload_blocks:
         cfg.cache.host_offload_blocks = args.host_offload_blocks
     if getattr(args, "kv_host_cache_bytes", 0):
@@ -3122,6 +3305,24 @@ def config_from_args(args) -> EngineConfig:
         getattr(args, "tenant_ledger_max_bytes", 16 << 20) or (16 << 20))
     cfg.seed = args.seed
     return cfg
+
+
+def brownout_from_args(args) -> Optional[BrownoutController]:
+    """Build the staged-brownout controller from CLI flags (None when the
+    feature is off — the default)."""
+    if not getattr(args, "brownout", False):
+        return None
+    from production_stack_tpu.engine.overload import BrownoutConfig
+
+    return BrownoutController(BrownoutConfig(
+        enabled=True,
+        interval=getattr(args, "brownout_interval", 2.0),
+        queue_high=getattr(args, "brownout_queue_high", 0.5),
+        hbm_high=getattr(args, "brownout_hbm_high", 0.92),
+        up_evals=getattr(args, "brownout_up_evals", 2),
+        calm_evals=getattr(args, "brownout_calm_evals", 3),
+        max_tokens_clamp=getattr(args, "brownout_max_tokens_clamp", 256),
+    ))
 
 
 def diagnostics_config_from_args(args) -> DiagnosticsConfig:
@@ -3326,7 +3527,8 @@ def main(argv=None) -> None:
                           flight_recorder_size=args.flight_recorder_size,
                           drain_deadline=args.drain_deadline,
                           watchdog_stall_seconds=args.watchdog_stall_seconds,
-                          diagnostics=diagnostics_config_from_args(args))
+                          diagnostics=diagnostics_config_from_args(args),
+                          brownout=brownout_from_args(args))
     # the real process drains on SIGTERM instead of dying mid-stream;
     # in-process test servers keep run_app semantics untouched
     server.drain_on_sigterm = True

@@ -190,6 +190,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "field > API-key hash > \"anonymous\"); the "
                         "resolved identity is stamped onto every backend "
                         "hop as x-tenant-id")
+    # overload protection plane (router/quota.py + engine/overload.py):
+    # per-tenant admission quotas and the router-tier brownout hook.
+    # Both default OFF — with neither configured the admission path is
+    # byte-identical to the observe-only behavior.
+    p.add_argument("--tenant-quota-config", default=None,
+                   help="JSON per-tenant token-bucket quotas: "
+                        '{"default": {"rps": 0, "tps": 0, "burst_s": 2.0, '
+                        '"weight": 1.0}, "tenants": {"acme": {"rps": 10, '
+                        '"tps": 5000, "weight": 4}}}. rps/tps <= 0 = '
+                        "unlimited; empty/absent disables quotas. "
+                        "Over-quota requests 429 with Retry-After derived "
+                        "from the bucket's actual refill time "
+                        "(docs/resilience.md \"Overload & fairness\")")
+    p.add_argument("--brownout", action="store_true",
+                   help="enable the router-tier brownout ladder: staged "
+                        "degradation on sustained fleet pressure "
+                        "(admission-queue depth, SLO fast-burn page); at "
+                        "stage 3 over-weight tenants' NEW admissions are "
+                        "shed (429) until the fleet recovers")
+    p.add_argument("--brownout-interval", type=float, default=2.0,
+                   help="seconds between brownout evaluations")
+    p.add_argument("--brownout-queue-depth", type=float, default=64.0,
+                   help="mean per-engine waiting depth treated as fully "
+                        "saturated (queue_fraction = waiting / this)")
+    p.add_argument("--brownout-queue-high", type=float, default=0.5,
+                   help="queue_fraction at/above which an evaluation "
+                        "counts as hot")
+    p.add_argument("--brownout-up-evals", type=int, default=2,
+                   help="consecutive hot evaluations per stage UP")
+    p.add_argument("--brownout-calm-evals", type=int, default=3,
+                   help="consecutive calm evaluations per stage DOWN "
+                        "(hysteretic recovery, mirroring the scale "
+                        "advisor's down_stable)")
     p.add_argument("--tenant-top-k", type=int, default=8,
                    help="tenants exported individually per metric; the "
                         "remainder folds into tenant=\"other\" (bounded "
@@ -316,6 +349,7 @@ class RouterApp:
         self._log_stats_task: Optional[asyncio.Task] = None
         self._scale_task: Optional[asyncio.Task] = None
         self._incident_task: Optional[asyncio.Task] = None
+        self._brownout_task: Optional[asyncio.Task] = None
 
     # -- initialization (reference: app.py initialize_all) -------------------
     def initialize(self) -> None:
@@ -488,6 +522,27 @@ class RouterApp:
 
         self.flight_recorder = FlightRecorder(
             getattr(args, "flight_recorder_size", 256))
+        from production_stack_tpu.router.quota import QuotaManager
+
+        quota = QuotaManager.from_json(
+            getattr(args, "tenant_quota_config", None),
+            top_k=getattr(args, "tenant_top_k", 8),
+            now=time.monotonic(),
+        )
+        brownout = None
+        if getattr(args, "brownout", False):
+            from production_stack_tpu.engine.overload import (
+                BrownoutConfig,
+                BrownoutController,
+            )
+
+            brownout = BrownoutController(BrownoutConfig(
+                enabled=True,
+                interval=getattr(args, "brownout_interval", 2.0),
+                queue_high=getattr(args, "brownout_queue_high", 0.5),
+                up_evals=getattr(args, "brownout_up_evals", 2),
+                calm_evals=getattr(args, "brownout_calm_evals", 3),
+            ))
         self.request_service = RequestService(
             max_failover_attempts=args.max_instance_failover_reroute_attempts,
             request_timeout=args.request_timeout,
@@ -498,6 +553,8 @@ class RouterApp:
             resilience=resilience,
             flight_recorder=self.flight_recorder,
             tenant_header=getattr(args, "tenant_header", "x-tenant-id"),
+            quota=quota,
+            brownout=brownout,
         )
 
         from production_stack_tpu.router.incidents import (
@@ -606,6 +663,7 @@ class RouterApp:
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/tenants", self.debug_tenants)
         app.router.add_get("/debug/scale", self.debug_scale)
+        app.router.add_get("/debug/overload", self.debug_overload)
         app.router.add_get("/debug/fleet", self.debug_fleet)
         app.router.add_get("/debug/diagnostics", self.debug_diagnostics)
         app.router.add_get("/debug/diagnostics/{bundle_id}",
@@ -691,6 +749,9 @@ class RouterApp:
         if current_scale_advisor() is not None:
             self._scale_task = asyncio.create_task(
                 self._scale_advisor_worker())
+        if self.request_service.brownout is not None:
+            self._brownout_task = asyncio.create_task(
+                self._brownout_worker())
         from production_stack_tpu.router.incidents import (
             current_incident_manager,
         )
@@ -714,6 +775,8 @@ class RouterApp:
             self._scale_task.cancel()
         if self._incident_task:
             self._incident_task.cancel()
+        if self._brownout_task:
+            self._brownout_task.cancel()
 
     async def _log_stats_worker(self) -> None:
         while True:
@@ -857,6 +920,20 @@ class RouterApp:
             return web.json_response({"enabled": False})
         return web.json_response(advisor.snapshot())
 
+    async def debug_overload(self, request: web.Request) -> web.Response:
+        """Overload protection plane state: quota manager (buckets,
+        rejection totals) + router-tier brownout ladder (stage, streaks,
+        shed set). Both blocks report enabled=False when off."""
+        svc = self.request_service
+        quota_block = ({"enabled": True, **svc.quota.snapshot()}
+                       if svc.quota is not None else {"enabled": False})
+        brownout_block = (svc.brownout.snapshot()
+                          if svc.brownout is not None
+                          else {"enabled": False})
+        brownout_block["shed_tenants"] = sorted(svc.brownout_shed)
+        return web.json_response(
+            {"quota": quota_block, "brownout": brownout_block})
+
     async def debug_fleet(self, request: web.Request) -> web.Response:
         """One joined snapshot of every engine (perf + KV + queue +
         drain/watchdog/warming state) plus the router's SLO / scale /
@@ -935,6 +1012,61 @@ class RouterApp:
                 raise
             except Exception:
                 logger.exception("scale advisor evaluation failed")
+
+    async def _brownout_worker(self) -> None:
+        """Router-tier brownout hook: fold fleet pressure (mean engine
+        admission-queue depth, the SLO tracker's fast-burn page flag)
+        into the hysteretic controller every interval, and refresh the
+        stage-3 shed set — tenants whose share of the 5m request window
+        exceeds their configured weight share (engine/overload.py
+        overweight_tenants)."""
+        from production_stack_tpu.engine.overload import (
+            PressureSignals,
+            overweight_tenants,
+        )
+        from production_stack_tpu.router.slo import (
+            current_slo_tracker,
+            current_tenant_tracker,
+        )
+
+        svc = self.request_service
+        ctl = svc.brownout
+        depth_full = max(getattr(self.args, "brownout_queue_depth", 64.0),
+                         1.0)
+        while True:
+            await asyncio.sleep(ctl.config.interval)
+            try:
+                es = get_engine_stats_scraper().get_engine_stats()
+                waits = [getattr(s, "num_queuing_requests", 0) or 0
+                         for s in es.values()]
+                qfrac = (sum(waits) / len(waits) / depth_full) if waits \
+                    else 0.0
+                slo = current_slo_tracker()
+                page = slo.page_firing() if slo is not None else False
+                prev = ctl.stage
+                ctl.evaluate(PressureSignals(queue_fraction=qfrac,
+                                             burn_page=page),
+                             time.monotonic())
+                if ctl.stage != prev:
+                    logger.warning(
+                        "brownout stage %d -> %d (reasons=%s)",
+                        prev, ctl.stage, ctl.last_reasons)
+                if ctl.shed_overweight:
+                    tracker = current_tenant_tracker()
+                    loads = {}
+                    if tracker is not None:
+                        loads = {t: r.get("requests", 0.0)
+                                 for t, r in tracker.usage_rows(300.0).items()}
+                    weights = svc.quota.weights() if svc.quota else {}
+                    svc.brownout_shed = set(
+                        overweight_tenants(loads, weights))
+                else:
+                    svc.brownout_shed = set()
+                m.refresh_brownout_gauges(ctl)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("brownout evaluation failed")
 
     # -- files / batches -------------------------------------------------------
     async def upload_file(self, request: web.Request) -> web.Response:
@@ -1043,6 +1175,8 @@ class RouterApp:
         )
 
         m.refresh_scale_gauges(current_scale_advisor())
+        m.refresh_quota_gauges(self.request_service.quota)
+        m.refresh_brownout_gauges(self.request_service.brownout)
         m.refresh_self_metrics()
         return web.Response(body=generate_latest(), content_type="text/plain")
 

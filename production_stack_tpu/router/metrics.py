@@ -187,6 +187,29 @@ incidents_open = Gauge(
     "Router incidents currently open (burn-rate page, breaker open, "
     "stream-resume failure) — each carries a correlated bundle set",
 )
+# overload protection plane (router/quota.py + engine/overload.py):
+# admission quotas and the staged brownout ladder. The engine tier
+# exports brownout families from its private registry (engine/metrics.py)
+# with tier="engine", so fleet-wide max/sum over {tier} is meaningful.
+quota_rejections = Gauge(
+    "vllm:quota_rejections_total",
+    "Requests 429'd by per-tenant admission quotas (monotone totals "
+    "re-exported from the quota manager; label set folded to top-K + "
+    "\"other\" via tenancy.fold_top_k, stale labels removed)",
+    ["tenant"],
+)
+brownout_stage = Gauge(
+    "vllm:brownout_stage",
+    "Current brownout degradation stage at this tier (0=healthy, "
+    "1=spec shed, 2=max_tokens/prefetch clamp, 3=over-weight tenant shed)",
+    ["tier"],
+)
+brownout_sheds_total = Counter(
+    "vllm:brownout_sheds",
+    "Work intentionally shed by the brownout ladder, by reason "
+    "(spec, max_tokens, prefetch, tenant) and tier",
+    ["reason", "tier"],
+)
 # router self-metrics (reference: routers/metrics_router.py:43-57)
 router_cpu_percent = Gauge("router:cpu_usage_perc", "Router CPU usage percent")
 router_mem_percent = Gauge("router:memory_usage_perc", "Router memory usage percent")
@@ -288,6 +311,46 @@ def refresh_tenant_gauges(tracker) -> None:
                     g.remove(tenant)
                 except KeyError:
                     pass
+
+
+_quota_labels: set = set()
+
+
+def refresh_quota_gauges(quota) -> None:
+    """Export the quota manager's rejection totals; no-op when quotas are
+    off (manager is None). The manager already folds to top-K + "other"
+    (tenancy.fold_top_k); labels that fell out of the fold are removed
+    immediately, same contract as the tenant usage gauges."""
+    if quota is None:
+        return
+    rows = quota.rejection_counts()
+    for tenant, v in rows.items():
+        _quota_labels.add(tenant)
+        quota_rejections.labels(tenant=tenant).set(v)
+    for tenant in list(_quota_labels):
+        if tenant not in rows:
+            _quota_labels.discard(tenant)
+            try:
+                quota_rejections.remove(tenant)
+            except KeyError:
+                pass
+
+
+_last_sheds: dict = {}
+
+
+def refresh_brownout_gauges(controller) -> None:
+    """Export the router-tier brownout stage + shed counters; no-op when
+    the brownout hook is off. Shed counts are diffed against the
+    controller's monotone totals so re-exports never double-count."""
+    if controller is None:
+        return
+    brownout_stage.labels(tier="router").set(controller.stage)
+    for reason, total in controller.sheds.items():
+        delta = total - _last_sheds.get(reason, 0)
+        if delta > 0:
+            brownout_sheds_total.labels(reason=reason, tier="router").inc(delta)
+        _last_sheds[reason] = total
 
 
 _last_events = {"up": 0, "down": 0}

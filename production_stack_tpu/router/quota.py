@@ -1,0 +1,242 @@
+"""Per-tenant admission quotas: token-bucket rate limits at the router.
+
+The router is the ONE place every request passes exactly once — under
+disaggregation the P->D decode hop is an engine-to-engine transfer that
+never re-enters the router's admission path, so charging quotas here
+charges each request once by construction. Enforcement happens at the
+same point ``resolve_tenant`` already runs (router/request_service.py),
+before any backend is touched.
+
+Two buckets per tenant, both optional:
+
+* **requests/s** — each admission costs 1
+* **tokens/s** — each admission costs its *estimated* token footprint
+  (prompt chars/4 + max_tokens; the router has no tokenizer, and an
+  estimate is fine for rate limiting — the engine's fair-share pass
+  enforces exact budgets downstream)
+
+Over-quota requests get 429 with Retry-After derived from the bucket's
+ACTUAL refill time (deficit/rate, not a constant) — PR 1's breaker and
+backoff machinery already honors Retry-After, so clients self-pace
+proportionally to how far over quota they are.
+
+Config is a single JSON document (``--tenant-quota-config`` / helm
+``routerSpec.tenancy.quotas.config``)::
+
+    {
+      "default": {"rps": 0, "tps": 0, "burst_s": 2.0, "weight": 1.0},
+      "tenants": {
+        "acme": {"rps": 10, "tps": 5000, "weight": 4.0},
+        "free-tier": {"rps": 1, "tps": 500}
+      }
+    }
+
+``rps``/``tps`` <= 0 means unlimited (default-off: an empty config
+admits everything). ``burst_s`` sizes each bucket at ``rate * burst_s``
+(min 1 op / 1 token). ``weight`` feeds the engine fair-share pass and
+the stage-3 brownout over-weight shed set — quota (hard ceiling) and
+weight (relative share under contention) compose but are independent
+knobs.
+
+Everything is clock-injected (``now`` is always a parameter) so the
+virtual-time traffic simulator drives the SAME enforcement code the
+production router runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Mapping, Optional, Tuple
+
+from production_stack_tpu.tenancy import fold_top_k  # noqa: F401  (metric fold)
+
+# estimated chars per token for the router-side prompt estimate; the
+# true ratio varies by tokenizer but rate limiting only needs magnitude
+_CHARS_PER_TOKEN = 4.0
+_DEFAULT_MAX_TOKENS = 16  # OpenAI-API default when the body omits it
+
+
+class TokenBucket:
+    """Classic token bucket on an injected clock. ``try_take(n, now)``
+    returns 0.0 on success (tokens deducted) or the seconds until the
+    bucket will have refilled enough for ``n`` — the Retry-After."""
+
+    def __init__(self, rate: float, burst: float, now: float = 0.0):
+        self.rate = float(rate)           # tokens per second
+        self.burst = max(float(burst), 1.0)
+        self.tokens = self.burst          # start full: no cold-start 429s
+        self._stamp = now
+
+    def _refill(self, now: float) -> None:
+        if now > self._stamp:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._stamp) * self.rate)
+        self._stamp = max(self._stamp, now)
+
+    def try_take(self, n: float, now: float) -> float:
+        self._refill(now)
+        if self.tokens >= n:
+            self.tokens -= n
+            return 0.0
+        if self.rate <= 0:
+            return float("inf")
+        # seconds until the deficit refills — capped at the time to fill
+        # the whole bucket (n may exceed burst for a one-shot huge request)
+        deficit = min(n, self.burst) - self.tokens
+        return max(deficit / self.rate, 0.0)
+
+
+@dataclasses.dataclass
+class TenantQuotaSpec:
+    rps: float = 0.0        # requests/sec; <= 0 = unlimited
+    tps: float = 0.0        # estimated tokens/sec; <= 0 = unlimited
+    burst_s: float = 2.0    # bucket depth in seconds of rate
+    weight: float = 1.0     # fair-share weight (engine DRR + brownout)
+
+
+@dataclasses.dataclass
+class QuotaVerdict:
+    allowed: bool
+    retry_after: float = 0.0   # seconds; meaningful when not allowed
+    reason: str = ""           # "rps" | "tps"
+
+
+def estimate_tokens(body: Mapping) -> int:
+    """Router-side token-footprint estimate for the tps bucket: prompt
+    (or chat messages) chars/4 plus the requested completion budget."""
+    chars = 0
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        chars = len(prompt)
+    elif isinstance(prompt, (list, tuple)):
+        chars = sum(len(p) for p in prompt if isinstance(p, str))
+    msgs = body.get("messages")
+    if isinstance(msgs, (list, tuple)):
+        for m in msgs:
+            if isinstance(m, Mapping) and isinstance(m.get("content"), str):
+                chars += len(m["content"])
+    try:
+        max_tokens = int(body.get("max_tokens") or _DEFAULT_MAX_TOKENS)
+    except (TypeError, ValueError):
+        max_tokens = _DEFAULT_MAX_TOKENS
+    return int(chars / _CHARS_PER_TOKEN) + max(max_tokens, 0)
+
+
+def _parse_spec(raw: Mapping, base: TenantQuotaSpec) -> TenantQuotaSpec:
+    def num(key, fallback):
+        try:
+            return float(raw.get(key, fallback))
+        except (TypeError, ValueError):
+            return fallback
+    return TenantQuotaSpec(
+        rps=num("rps", base.rps),
+        tps=num("tps", base.tps),
+        burst_s=max(num("burst_s", base.burst_s), 0.1),
+        weight=max(num("weight", base.weight), 0.0) or 1.0,
+    )
+
+
+class QuotaManager:
+    """Parses the quota config and enforces it, one pair of buckets per
+    tenant, lazily created on first sight. Tenants are identity-bounded
+    the same way ``TenantUsageTracker`` bounds them: past ``cap``
+    distinct tenants, NEW unknown tenants share the ``default`` buckets
+    under a single overflow slot so a tenant-id-spinning client can't
+    grow host memory (explicitly configured tenants always get their own
+    buckets). Rejection counts fold to top-K + "other" at export via
+    :func:`production_stack_tpu.tenancy.fold_top_k`."""
+
+    def __init__(self, config: Optional[Mapping] = None, top_k: int = 8,
+                 now: float = 0.0):
+        config = config or {}
+        self.default = _parse_spec(config.get("default") or {},
+                                   TenantQuotaSpec())
+        self.tenants: Dict[str, TenantQuotaSpec] = {}
+        for name, raw in (config.get("tenants") or {}).items():
+            if isinstance(raw, Mapping):
+                self.tenants[str(name)] = _parse_spec(raw, self.default)
+        self.top_k = max(int(top_k), 1)
+        self.cap = max(4 * self.top_k, 64) + len(self.tenants)
+        self._buckets: Dict[str, Tuple[Optional[TokenBucket],
+                                       Optional[TokenBucket]]] = {}
+        self._boot = now
+        self.rejections: Dict[str, int] = {}   # tenant -> 429 count
+        self.admissions: Dict[str, int] = {}   # tenant -> admit count
+
+    @classmethod
+    def from_json(cls, text: Optional[str], top_k: int = 8,
+                  now: float = 0.0) -> Optional["QuotaManager"]:
+        """None/empty/'{}' disables quotas entirely (default-off)."""
+        if not text or not text.strip():
+            return None
+        config = json.loads(text)
+        if not isinstance(config, dict) or not config:
+            return None
+        return cls(config, top_k=top_k, now=now)
+
+    # -- enforcement ---------------------------------------------------------
+    def spec_for(self, tenant: str) -> TenantQuotaSpec:
+        return self.tenants.get(tenant, self.default)
+
+    def _bucket_key(self, tenant: str) -> str:
+        """Identity bound: configured tenants and the first ``cap`` seen
+        get their own buckets; the rest share one overflow pair."""
+        if tenant in self.tenants or tenant in self._buckets:
+            return tenant
+        if len(self._buckets) >= self.cap:
+            return "other"
+        return tenant
+
+    def _buckets_for(self, tenant: str, now: float):
+        key = self._bucket_key(tenant)
+        pair = self._buckets.get(key)
+        if pair is None:
+            spec = self.spec_for(key if key != "other" else tenant)
+            rps = (TokenBucket(spec.rps, spec.rps * spec.burst_s, now)
+                   if spec.rps > 0 else None)
+            tps = (TokenBucket(spec.tps, spec.tps * spec.burst_s, now)
+                   if spec.tps > 0 else None)
+            pair = (rps, tps)
+            self._buckets[key] = pair
+        return key, pair
+
+    def check(self, tenant: str, tokens: int, now: float) -> QuotaVerdict:
+        """Charge one request + ``tokens`` estimated tokens. On a 429 the
+        OTHER bucket is not charged — rejected work consumed nothing."""
+        key, (rps, tps) = self._buckets_for(tenant, now)
+        retry_rps = rps.try_take(1.0, now) if rps is not None else 0.0
+        if retry_rps > 0.0:
+            self.rejections[key] = self.rejections.get(key, 0) + 1
+            return QuotaVerdict(False, retry_after=min(retry_rps, 300.0),
+                                reason="rps")
+        retry_tps = tps.try_take(float(tokens), now) if tps is not None else 0.0
+        if retry_tps > 0.0:
+            if rps is not None:  # refund the request-bucket charge
+                rps.tokens = min(rps.tokens + 1.0, rps.burst)
+            self.rejections[key] = self.rejections.get(key, 0) + 1
+            return QuotaVerdict(False, retry_after=min(retry_tps, 300.0),
+                                reason="tps")
+        self.admissions[key] = self.admissions.get(key, 0) + 1
+        return QuotaVerdict(True)
+
+    # -- export --------------------------------------------------------------
+    def weights(self) -> Dict[str, float]:
+        """Configured per-tenant weights (fair-share + brownout input)."""
+        return {t: s.weight for t, s in self.tenants.items()}
+
+    def rejection_counts(self) -> Dict[str, float]:
+        """Per-tenant 429 totals, folded to top-K + "other" — the source
+        for ``vllm:quota_rejections_total{tenant}``."""
+        return fold_top_k({t: float(v) for t, v in self.rejections.items()},
+                          k=self.top_k)
+
+    def snapshot(self) -> dict:
+        return {
+            "tenants_configured": len(self.tenants),
+            "buckets_live": len(self._buckets),
+            "rejections": self.rejection_counts(),
+            "admissions": fold_top_k(
+                {t: float(v) for t, v in self.admissions.items()},
+                k=self.top_k),
+        }

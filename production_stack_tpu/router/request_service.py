@@ -148,6 +148,8 @@ class RequestService:
         resilience: Optional[Resilience] = None,
         flight_recorder: Optional[FlightRecorder] = None,
         tenant_header: str = TENANT_HEADER,
+        quota=None,
+        brownout=None,
     ):
         self.max_failover_attempts = max_failover_attempts
         self.request_timeout = request_timeout
@@ -167,6 +169,17 @@ class RequestService:
         # side attribution agrees with the router whatever header the
         # operator configured inbound
         self.tenant_header = tenant_header or TENANT_HEADER
+        # per-tenant admission quotas (router/quota.py QuotaManager; None
+        # = default-off). Checked right after resolve_tenant — the ONE
+        # point every request passes exactly once, so under disagg the
+        # P->D decode hop (engine-to-engine) can never double-charge.
+        self.quota = quota
+        # router-tier brownout ladder (engine/overload.py
+        # BrownoutController; None = off). The app's eval worker drives
+        # evaluate() and refreshes `brownout_shed` — the over-weight
+        # tenant set stage 3 refuses new admissions from.
+        self.brownout = brownout
+        self.brownout_shed: set = set()
 
     @property
     def resilience(self) -> Resilience:
@@ -196,6 +209,53 @@ class RequestService:
         empty for surfaces that never resolved one."""
         return (request.get("tenant") or "") if hasattr(request, "get") \
             else ""
+
+    def _admission_check(self, tenant: str, body: dict,
+                         rec: dict):
+        """Per-tenant admission control (overload protection plane).
+
+        Two independent gates, both default-off: the stage-3 brownout
+        shed (over-weight tenants' NEW admissions refused while the
+        ladder is at stage 3) and the token-bucket quota check. Returns
+        a 429 response to short-circuit with, or None to admit. The 429
+        carries Retry-After derived from the bucket's ACTUAL refill time
+        so PR 1's breaker/backoff machinery paces clients proportionally
+        to how far over quota they are."""
+        if (self.brownout is not None and self.brownout.shed_overweight
+                and tenant in self.brownout_shed):
+            self.brownout.record_shed("tenant")
+            rec["outcome"] = "brownout_shed"
+            return web.json_response(
+                {"error": {
+                    "message": f"tenant {tenant!r} admissions shed: fleet "
+                               "in brownout stage "
+                               f"{self.brownout.stage} and this tenant is "
+                               "over its fair-share weight; retry later",
+                    "type": "RateLimitError", "code": "brownout_shed",
+                }},
+                status=429,
+                headers={"Retry-After": f"{self.brownout.config.interval:g}"},
+            )
+        if self.quota is None:
+            return None
+        from production_stack_tpu.router.quota import estimate_tokens
+        verdict = self.quota.check(tenant, estimate_tokens(body),
+                                   time.monotonic())
+        if verdict.allowed:
+            return None
+        m.refresh_quota_gauges(self.quota)
+        rec["outcome"] = "over_quota"
+        ra = max(verdict.retry_after, 0.05)
+        return web.json_response(
+            {"error": {
+                "message": f"tenant {tenant!r} over its "
+                           f"{'requests/s' if verdict.reason == 'rps' else 'tokens/s'}"
+                           f" quota; retry after {ra:.2f}s",
+                "type": "RateLimitError", "code": "over_quota",
+            }},
+            status=429,
+            headers={"Retry-After": f"{ra:.2f}"},
+        )
 
     # -- endpoint selection ---------------------------------------------------
     def _filter_endpoints(self, model: str) -> list[EndpointInfo]:
@@ -341,6 +401,10 @@ class RequestService:
         request["tenant"] = tenant
         rec["tenant"] = tenant
         m.num_incoming_requests_total.labels(model=resolved or "unknown").inc()
+
+        shed = self._admission_check(tenant, body, rec)
+        if shed is not None:
+            return shed
 
         if self.external_providers is not None and self.external_providers.handles(
             resolved

@@ -196,6 +196,16 @@ class SLOTracker:
             yield (model, slo, self.burn_rates(model, slo, now),
                    self.error_budget_remaining(model, slo, now))
 
+    def page_firing(self, now: Optional[float] = None) -> bool:
+        """True when ANY active series' fast-burn page condition holds —
+        the ``burn_page`` pressure signal the brownout ladder
+        (engine/overload.py) folds into its evaluation."""
+        now = now if now is not None else time.time()
+        for model, slo in list(self._series):
+            if self._flags(self.burn_rates(model, slo, now))["page"]:
+                return True
+        return False
+
     def snapshot(self, now: Optional[float] = None) -> dict:
         """JSON document for ``GET /debug/slo``."""
         now = now if now is not None else time.time()
@@ -234,8 +244,11 @@ class TenantUsageTracker:
     Cardinality is bounded at ingest: once ``cap`` distinct tenants are
     tracked, NEW tenants account into ``tenant="other"`` — the series
     tables can never grow past the cap however many identities churn
-    through. Exports fold further to ``top_k`` (tenancy.fold_records).
-    Observe-only: nothing here feeds routing."""
+    through. Tenants idle past the 6h bin horizon are EXPIRED (their
+    bins have all aged out anyway), so the cap slots recycle under
+    identity churn instead of pinning every tenant ever seen for the
+    life of the process. Exports fold further to ``top_k``
+    (tenancy.fold_records). Observe-only: nothing here feeds routing."""
 
     KINDS = ("requests", "ttft", "itl")
 
@@ -247,17 +260,37 @@ class TenantUsageTracker:
         self._other = OTHER
         self._series: Dict[Tuple[str, str], _BinSeries] = {}
         self._tenants: set = set()
+        self._last_seen: Dict[str, float] = {}
 
-    def _admit(self, tenant: str) -> str:
+    def _admit(self, tenant: str, ts: float) -> str:
         if tenant in self._tenants:
+            self._last_seen[tenant] = max(self._last_seen.get(tenant, 0.0),
+                                          ts)
             return tenant
+        if len(self._tenants) >= self.cap:
+            self.expire_idle(ts)  # idle slots recycle before overflow
         if len(self._tenants) >= self.cap:
             return self._other
         self._tenants.add(tenant)
+        self._last_seen[tenant] = ts
         return tenant
 
+    def expire_idle(self, now: Optional[float] = None) -> int:
+        """Drop tenants with no activity inside the 6h bin horizon —
+        every bin they ever wrote has aged out, so removing them changes
+        no windowed answer. Returns how many were expired."""
+        now = now if now is not None else time.time()
+        stale = [t for t, ts in self._last_seen.items()
+                 if now - ts > _HORIZON]
+        for t in stale:
+            self._tenants.discard(t)
+            self._last_seen.pop(t, None)
+            for kind in self.KINDS:
+                self._series.pop((t, kind), None)
+        return len(stale)
+
     def _add(self, tenant: str, kind: str, value: float, ts: float) -> None:
-        key = (self._admit(tenant or "anonymous"), kind)
+        key = (self._admit(tenant or "anonymous", ts), kind)
         series = self._series.get(key)
         if series is None:
             series = self._series[key] = _BinSeries()

@@ -70,6 +70,11 @@ class FakeEngine:
         self.capabilities = capabilities
         self.running = 0
         self.total_requests = 0
+        #: canonical x-tenant-id header on each generation request, in
+        #: arrival order ("" when absent) — disagg composition tests
+        #: assert every hop of a request carries the SAME identity the
+        #: router resolved at admission
+        self.tenants_seen: list[str] = []
         self.sleeping = False
         self.lora_loaded: list[str] = []
         self.lora_unloaded: list[str] = []
@@ -461,6 +466,7 @@ class FakeEngine:
         created = int(time.time())
         self.running += 1
         self.total_requests += 1
+        self.tenants_seen.append(request.headers.get("x-tenant-id") or "")
         try:
             await asyncio.sleep(self.ttft)
             first = self._resume_index(body, chat)

@@ -29,6 +29,17 @@ replica-hours (vs. flat peak provisioning), scale events, warmup
 durations, and the violation counters the acceptance gate asserts are
 zero: cold routes (a request sent to a warming replica), failed streams,
 leaked KV blocks.
+
+The simulator is also the overload-protection plane's proof harness
+(docs/resilience.md "Overload & fairness"): ``--quota-config`` admits
+arrivals through the REAL ``QuotaManager`` (router/quota.py) on the
+virtual clock, ``--fair-share`` splits each replica's token rate across
+tenants by quota weight before splitting across streams (mirroring the
+scheduler's DRR pass), and ``--brownout`` drives the REAL hysteretic
+``BrownoutController`` (engine/overload.py) from router queue depth —
+stage 2 clamps new arrivals' output budgets, stage 3 sheds over-weight
+tenants' new admissions. Victim (non-noisy) vs noisy cohort burn rates
+in the artifact are the noisy-neighbor drill's evidence.
 """
 
 from __future__ import annotations
@@ -42,9 +53,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
 
+from production_stack_tpu.engine.overload import (
+    BrownoutConfig, BrownoutController, PressureSignals, SHED_MAX_TOKENS,
+    SHED_TENANT, overweight_tenants,
+)
 from production_stack_tpu.operator.autoscaler import (
     AutoscalerConfig, AutoscalerLoop, FleetActuator, ReplicaInfo,
 )
+from production_stack_tpu.router.quota import QuotaManager
 from production_stack_tpu.router.scale_advisor import (
     ScaleAdvisor, ScaleAdvisorConfig, ScaleSignals, pair_burn,
 )
@@ -158,6 +174,7 @@ class SimReplica:
             return
         per_stream = self.spec.tokens_per_sec * dt / streams
         itl = streams / self.spec.tokens_per_sec  # seconds per token
+        fair = self._fair_rates(sim, dt) if sim.fair_share else None
         done: List[Group] = []
         for g in self.running:
             if g.admitted < 0:
@@ -165,18 +182,41 @@ class SimReplica:
                 prefill = g.prompt_tokens / self.spec.prefill_tokens_per_sec
                 sim.record_ttft(g, (now - g.arrived) + prefill, now)
                 sim.record_prefill(g)
-            g.tokens_done += per_stream
+            g.tokens_done += fair[0][g.tenant] if fair else per_stream
             if g.tokens_done >= g.output_tokens:
                 done.append(g)
         # tenant attribution (tenancy.split_shares, the REAL splitter the
         # engine's perf accountant uses): this replica was busy for dt
         # seconds; each tenant is billed its live stream-weight share —
         # exact conservation per tick by construction
-        sim.attribute_tick(self.running, per_stream, dt)
+        sim.attribute_tick(self.running, fair[0] if fair else per_stream, dt)
         for g in done:
             self.running.remove(g)
             self.alloc -= g.kv
-            sim.record_finish(g, itl, now)
+            sim.record_finish(g, fair[1][g.tenant] if fair else itl, now)
+
+    def _fair_rates(self, sim: "ModelSim", dt: float):
+        """Weighted-fair processor sharing: split the replica's token
+        rate across *tenants* by fair-share weight, then equally across
+        each tenant's streams — the same discipline as the scheduler's
+        DRR pass. Returns (per-stream token gain by tenant, seconds per
+        token by tenant), or None with fewer than two live tenants: the
+        single-tenant case collapses to plain processor sharing, so the
+        float path stays bit-identical with fairness on."""
+        by_tenant: Dict[str, int] = {}
+        for g in self.running:
+            by_tenant[g.tenant] = by_tenant.get(g.tenant, 0) + g.weight
+        if len(by_tenant) < 2:
+            return None
+        w = {t: sim.tenant_weight(t) for t in by_tenant}
+        wsum = sum(w.values())
+        gain: Dict[str, float] = {}
+        itl_of: Dict[str, float] = {}
+        for t, streams_t in by_tenant.items():
+            rate_t = self.spec.tokens_per_sec * w[t] / wsum
+            gain[t] = rate_t * dt / streams_t
+            itl_of[t] = streams_t / rate_t
+        return gain, itl_of
 
     def abort_all(self, sim: "ModelSim", now: float) -> None:
         """Drain deadline: abort stragglers, free their KV (the engine's
@@ -339,7 +379,11 @@ class ModelSim:
     def __init__(self, wl: Workload, spec: ReplicaSpec,
                  advisor: ScaleAdvisor, tracker: SLOTracker,
                  loop_cfg: AutoscalerConfig, seed: int = 0,
-                 tenants: int = 0, noisy_share: float = 0.4):
+                 tenants: int = 0, noisy_share: float = 0.4,
+                 quota: Optional[QuotaManager] = None,
+                 fair_share: bool = False,
+                 brownout: Optional[BrownoutController] = None,
+                 brownout_queue_depth: float = 64.0):
         self.wl = wl
         self.tracker = tracker
         self.advisor = advisor
@@ -368,6 +412,31 @@ class ModelSim:
         self.tenant_usage: Dict[str, Dict[str, float]] = {}
         self.busy_seconds = 0.0        # independent fleet-total integral
         self.tokens_served = 0.0       # independent decode-token total
+        # -- overload-protection plane (quota + fair-share + brownout) ---
+        self.quota = quota
+        self.fair_share = fair_share
+        self.brownout = brownout
+        self.brownout_queue_depth = max(brownout_queue_depth, 1.0)
+        self._tenant_weights: Dict[str, float] = (
+            quota.weights() if quota is not None else {})
+        self.quota_rejections: Dict[str, int] = {}   # tenant -> streams
+        self.shed_arrivals: Dict[str, int] = {}      # stage-3 sheds
+        self.clamped_arrivals = 0                    # stage-2 clamps
+        self._shed_tenants: set = set()
+        self._next_brownout = 0.0
+        self.brownout_peak = 0
+        self.brownout_transitions: List[dict] = []
+        # victim-vs-noisy cohort burns: the noisy-neighbor drill's proof
+        self.cohorts: Optional[SLOTracker] = (
+            SLOTracker(tracker.config) if self.tenant_names else None)
+
+    def tenant_weight(self, tenant: str) -> float:
+        return float(self._tenant_weights.get(tenant, 1.0)) or 1.0
+
+    def _cohort(self, g: Group) -> Optional[str]:
+        if self.cohorts is None or g.tenant == "anonymous":
+            return None
+        return "noisy" if g.tenant == "noisy" else "victims"
 
     def _pick_tenant(self) -> str:
         names = self.tenant_names
@@ -389,26 +458,41 @@ class ModelSim:
     # -- SLO recording (weighted; virtual ts) --------------------------------
     def record_ttft(self, g: Group, ttft: float, now: float) -> None:
         self.tracker.record_ttft(g.model, ttft, ts=now, count=g.weight)
+        cohort = self._cohort(g)
+        if cohort:
+            self.cohorts.record_ttft(cohort, ttft, ts=now, count=g.weight)
 
     def record_finish(self, g: Group, itl: float, now: float) -> None:
         self.tracker.record_itl(g.model, itl, ts=now, count=g.weight)
         self.tracker.record_attempt(g.model, True, ts=now, count=g.weight)
         self.completed += g.weight
+        cohort = self._cohort(g)
+        if cohort:
+            self.cohorts.record_itl(cohort, itl, ts=now, count=g.weight)
+            self.cohorts.record_attempt(cohort, True, ts=now, count=g.weight)
 
     def record_abort(self, g: Group, now: float) -> None:
         self.tracker.record_attempt(g.model, False, ts=now, count=g.weight)
         self.failed += g.weight
+        cohort = self._cohort(g)
+        if cohort:
+            self.cohorts.record_attempt(cohort, False, ts=now,
+                                        count=g.weight)
 
     # -- tenant attribution --------------------------------------------------
     def record_prefill(self, g: Group) -> None:
         self._tenant_row(g.tenant)["prefill_tokens"] += (
             g.prompt_tokens * g.weight)
 
-    def attribute_tick(self, running: List[Group], per_stream: float,
+    def attribute_tick(self, running: List[Group], per_stream,
                        dt: float) -> None:
         """Split one replica-tick's busy wall time across the tenants of
         the packed stream by live stream-weight share (split_shares is
-        largest-remainder, so each call conserves dt exactly)."""
+        largest-remainder, so each call conserves dt exactly).
+        ``per_stream`` is either a float (plain processor sharing) or a
+        per-tenant dict (weighted-fair service); either way the gains
+        sum to the replica's full token rate, so token conservation
+        holds identically."""
         weights: Dict[str, float] = {}
         for g in running:
             weights[g.tenant] = weights.get(g.tenant, 0) + g.weight
@@ -418,7 +502,9 @@ class ModelSim:
             self._tenant_row(tenant)["chip_seconds"] += share
         self.busy_seconds += dt
         for g in running:
-            tokens = per_stream * g.weight
+            gain = (per_stream[g.tenant] if isinstance(per_stream, dict)
+                    else per_stream)
+            tokens = gain * g.weight
             self._tenant_row(g.tenant)["decode_tokens"] += tokens
             self.tokens_served += tokens
 
@@ -434,15 +520,64 @@ class ModelSim:
         for size in sizes:
             tenant = self._pick_tenant()
             self._tenant_row(tenant)["requests"] += size
+            out_tokens = self.rng.randint(self.wl.output_lo,
+                                          self.wl.output_hi)
+            if self.quota is not None:
+                # the REAL router-side check on the virtual clock; the
+                # sim knows true token counts, so the estimate is exact
+                est = (self.wl.prompt_tokens + out_tokens) * size
+                if not self.quota.check(tenant, est, now=t).allowed:
+                    # a 429, not a failure: the group is never routed
+                    self.quota_rejections[tenant] = (
+                        self.quota_rejections.get(tenant, 0) + size)
+                    continue
+            ctl = self.brownout
+            if ctl is not None and ctl.stage > 0:
+                if ctl.shed_overweight and tenant in self._shed_tenants:
+                    ctl.record_shed(SHED_TENANT, size)
+                    self.shed_arrivals[tenant] = (
+                        self.shed_arrivals.get(tenant, 0) + size)
+                    continue
+                clamp = ctl.max_tokens_clamp
+                if clamp and out_tokens > clamp:
+                    ctl.record_shed(SHED_MAX_TOKENS, size)
+                    self.clamped_arrivals += size
+                    out_tokens = clamp
             self.router.route(Group(
                 model=self.wl.model, weight=size, arrived=t,
                 prompt_tokens=self.wl.prompt_tokens,
-                output_tokens=self.rng.randint(self.wl.output_lo,
-                                               self.wl.output_hi),
+                output_tokens=out_tokens,
                 tenant=tenant))
+
+    def _evaluate_brownout(self, now: float) -> None:
+        """Drive the REAL hysteretic controller from router queue depth
+        normalized per ready replica — the same signal the production
+        router's brownout worker feeds it."""
+        ctl = self.brownout
+        self._next_brownout = now + ctl.config.interval
+        ready = sum(1 for r in self.fleet.alive() if r.state == READY)
+        qfrac = self.router.waiting / (max(ready, 1)
+                                       * self.brownout_queue_depth)
+        prev = ctl.stage
+        ctl.evaluate(PressureSignals(queue_fraction=qfrac), now)
+        if ctl.stage != prev:
+            self.brownout_transitions.append(
+                {"t": round(now, 1), "from": prev, "to": ctl.stage})
+        self.brownout_peak = max(self.brownout_peak, ctl.stage)
+        if ctl.shed_overweight:
+            loads: Dict[str, float] = {}
+            for r in self.fleet.alive():
+                for g in list(r.running) + list(r.queue):
+                    loads[g.tenant] = loads.get(g.tenant, 0.0) + g.weight
+            self._shed_tenants = set(overweight_tenants(
+                loads, self._tenant_weights or None))
+        else:
+            self._shed_tenants = set()
 
     def tick_fleet(self, now: float, dt: float) -> None:
         self.actuator.now = now
+        if self.brownout is not None and now >= self._next_brownout:
+            self._evaluate_brownout(now)
         for r in self.fleet.alive():
             r.advance_lifecycle(now)
         self.router.flush_pending()
@@ -480,14 +615,18 @@ class ModelSim:
             leaked += max(0, r.alloc - backed)
         return leaked
 
-    def report(self, now: float) -> dict:
-        burns = {}
-        for slo in self.tracker.config.objectives(self.wl.model):
-            rates = self.tracker.burn_rates(self.wl.model, slo, now)
-            burns[slo] = {
+    def _burns(self, tracker: SLOTracker, series: str, now: float) -> dict:
+        out = {}
+        for slo in tracker.config.objectives(series):
+            rates = tracker.burn_rates(series, slo, now)
+            out[slo] = {
                 "fast": round(pair_burn(rates, FAST_PAIR), 4),
                 "slow": round(pair_burn(rates, SLOW_PAIR), 4),
             }
+        return out
+
+    def report(self, now: float) -> dict:
+        burns = self._burns(self.tracker, self.wl.model, now)
         rep = {
             "users": self.wl.users,
             "arrival_kind": self.wl.process.kind,
@@ -506,6 +645,35 @@ class ModelSim:
         }
         if self.tenant_usage:
             rep["tenants"] = self.tenant_report()
+        if self.cohorts is not None:
+            # victim vs noisy burn — the noisy-neighbor drill asserts
+            # victims stay under budget while the noisy tenant absorbs
+            # every 429 (and, counterfactually, that victims burn >1
+            # with enforcement off)
+            rep["cohort_burn"] = {
+                name: self._burns(self.cohorts, name, now)
+                for name in ("victims", "noisy")}
+        if (self.quota is not None or self.brownout is not None
+                or self.fair_share):
+            overload: dict = {
+                "fair_share": self.fair_share,
+                "quota_rejections": {
+                    t: int(v)
+                    for t, v in sorted(self.quota_rejections.items())},
+                "shed_arrivals": {
+                    t: int(v)
+                    for t, v in sorted(self.shed_arrivals.items())},
+                "clamped_arrivals": self.clamped_arrivals,
+            }
+            if self.brownout is not None:
+                overload["brownout"] = {
+                    "peak_stage": self.brownout_peak,
+                    "final_stage": self.brownout.stage,
+                    "transitions": self.brownout_transitions,
+                    "sheds": {k: int(v) for k, v in
+                              sorted(self.brownout.sheds.items())},
+                }
+            rep["overload"] = overload
         return rep
 
     def tenant_report(self) -> dict:
@@ -573,6 +741,20 @@ def build_workloads(args) -> List[Workload]:
                      process_from_args(args, rate), weight)]
 
 
+def _brownout_from_args(args) -> Optional[BrownoutController]:
+    """One controller per ModelSim (each model's fleet walks its own
+    ladder), mirroring engine/server.py's brownout_from_args."""
+    if not getattr(args, "brownout", False):
+        return None
+    return BrownoutController(BrownoutConfig(
+        enabled=True,
+        interval=getattr(args, "brownout_interval", 2.0),
+        queue_high=getattr(args, "brownout_queue_high", 0.5),
+        up_evals=getattr(args, "brownout_up_evals", 2),
+        calm_evals=getattr(args, "brownout_calm_evals", 3),
+        max_tokens_clamp=getattr(args, "brownout_max_tokens_clamp", 256)))
+
+
 async def simulate(args) -> dict:
     slo_cfg = SLOConfig(ttft_p95=args.slo_ttft_p95,
                         itl_p95=args.slo_itl_p95,
@@ -592,10 +774,16 @@ async def simulate(args) -> dict:
                        kv_blocks=args.replica_kv_blocks,
                        provision_s=args.provision_seconds,
                        warmup_s=args.warmup_seconds)
+    quota = QuotaManager.from_json(getattr(args, "quota_config", None))
     sims = [ModelSim(wl, spec, advisor, tracker, loop_cfg,
                      seed=args.arrival_seed + i,
                      tenants=getattr(args, "tenants", 0),
-                     noisy_share=getattr(args, "tenant_noisy_share", 0.4))
+                     noisy_share=getattr(args, "tenant_noisy_share", 0.4),
+                     quota=quota,
+                     fair_share=getattr(args, "fair_share", False),
+                     brownout=_brownout_from_args(args),
+                     brownout_queue_depth=getattr(
+                         args, "brownout_queue_depth", 64.0))
             for i, wl in enumerate(build_workloads(args))]
 
     dt = args.dt
@@ -717,6 +905,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "arrivals so it visibly dominates chip-seconds")
     p.add_argument("--tenant-noisy-share", type=float, default=0.4,
                    help="arrival share of the deliberately noisy tenant")
+    # overload-protection drills (quota + fair-share + brownout ladder)
+    p.add_argument("--quota-config", default=None,
+                   help="tenant-quota JSON (same schema as the router's "
+                        "--tenant-quota-config); over-quota groups count "
+                        "as 429s in the artifact, never failed streams")
+    p.add_argument("--fair-share", action="store_true",
+                   help="weighted-fair service: split each replica's "
+                        "token rate across tenants by quota weight "
+                        "before splitting across streams")
+    p.add_argument("--brownout", action="store_true",
+                   help="drive the real staged-degradation controller "
+                        "from router queue depth")
+    p.add_argument("--brownout-interval", type=float, default=2.0)
+    p.add_argument("--brownout-queue-depth", type=float, default=64.0,
+                   help="queued streams per ready replica treated as "
+                        "1.0 queue pressure")
+    p.add_argument("--brownout-queue-high", type=float, default=0.5)
+    p.add_argument("--brownout-up-evals", type=int, default=2)
+    p.add_argument("--brownout-calm-evals", type=int, default=3)
+    p.add_argument("--brownout-max-tokens-clamp", type=int, default=256)
     p.add_argument("--output", default=None,
                    help="write the run artifact JSON here")
     return p

@@ -447,6 +447,66 @@ def test_kv_tiering_surface_is_inside_the_gates():
     assert "--max-block-bytes" in cs_tmpl and "--ttl-seconds" in cs_tmpl
 
 
+def test_overload_surface_is_inside_the_gates():
+    """The overload-protection surface (PR: per-tenant quotas + scheduler
+    fair-share + staged brownout) is covered by the gates, not
+    grandfathered: config-drift sees the quota/fairness/brownout flags as
+    declared CLI flags on BOTH tiers (a helm tenancy/brownout template
+    typo would be an active finding), and metric-hygiene tracks the
+    overload metric families as defined in code and documented — so
+    renaming vllm:brownout_stage, or deleting its docs/observability.md
+    row, fails test_repo_has_no_active_findings."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    engine_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "engine" / "server.py")
+    assert {"--fair-share", "--tenant-weights", "--brownout",
+            "--brownout-interval", "--brownout-queue-high",
+            "--brownout-hbm-high", "--brownout-up-evals",
+            "--brownout-calm-evals",
+            "--brownout-max-tokens-clamp"} <= engine_flags
+    router_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "router" / "app.py")
+    assert {"--tenant-quota-config", "--brownout", "--brownout-interval",
+            "--brownout-queue-depth", "--brownout-queue-high",
+            "--brownout-up-evals", "--brownout-calm-evals"} <= router_flags
+
+    # exposition adds _total to the counters; the gate pins base names
+    overload = {"vllm:brownout_stage", "vllm:brownout_sheds",
+                "vllm:quota_rejections", "vllm:fair_share_deficit"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert overload <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert overload <= documented
+
+    # the chart's tenancy/brownout blocks must stay consumed by both
+    # deployment templates, and the CI values must exercise fair-share +
+    # quotas + the brownout ladder (the tier-1 chart tests render
+    # values-ci.yaml)
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert ("fairShare:" in values and "tenantWeights:" in values
+            and "quotas:" in values and "brownout:" in values)
+    values_ci = (REPO / "helm" / "values-ci.yaml").read_text()
+    assert ("fairShare: true" in values_ci and "quotas:" in values_ci
+            and "brownout:" in values_ci)
+    router_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-router.yaml").read_text()
+    assert ("--tenant-quota-config" in router_tmpl
+            and "--brownout" in router_tmpl)
+    engine_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-engine.yaml").read_text()
+    assert "--fair-share" in engine_tmpl and "--brownout" in engine_tmpl
+
+    # the sustained-brownout alert rides the same metric family in both
+    # rule copies (repo-root reference + chart-shipped)
+    for rules in (REPO / "observability" / "alert-rules.yaml",
+                  REPO / "helm" / "rules" / "alert-rules.yaml"):
+        text = rules.read_text()
+        assert "BrownoutSustained" in text
+        assert "vllm:brownout_stage" in text
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

@@ -325,3 +325,78 @@ def test_usage_ledger_io_errors_counted_not_raised(tmp_path):
     assert ledger.write_errors == 1
     stats = ledger.stats()
     assert stats["records_written"] == 0 and stats["write_errors"] == 1
+
+
+# -- idle-identity expiry (6h horizon, overload-plane satellite) -------------
+
+def test_perf_accountant_expires_idle_tenants_into_other():
+    """A tenant idle past the 6h horizon loses its named row — the usage
+    folds into "other" (sums conserved) and the identity slot frees."""
+    acc = make_accountant(tenant_top_k=8)
+    acc.attribute_tenants(1.0, {"old": {"decode": 10, "live": 1}})
+    acc.attribute_tenants(2.0, {"fresh": {"decode": 5, "live": 1}})
+    acc._tenant_seen["old"] -= acc.tenant_idle_expiry + 1.0
+    assert acc.expire_idle_tenants() == 1
+    fields = acc.tenant_fields()
+    rows = fields["tenants"]
+    assert "old" not in rows and "fresh" in rows and OTHER in rows
+    assert rows[OTHER]["decode_tokens"] == 10
+    assert rows[OTHER]["chip_seconds"] == pytest.approx(1.0)
+    assert math.fsum(r["chip_seconds"] for r in rows.values()) == \
+        pytest.approx(3.0)
+    # the freed slot is really free: a new tenant gets a named row
+    acc.attribute_tenants(0.5, {"newcomer": {"decode": 1, "live": 1}})
+    assert "newcomer" in acc._tenants
+
+
+def test_perf_accountant_idle_expiry_recycles_slots_under_churn():
+    """Cohorts of one-visit tenants churn through with every cohort
+    going idle: the table never pins dead identities, every cohort's
+    usage survives in "other", and totals conserve across 10 folds."""
+    acc = make_accountant(tenant_top_k=8)
+    total = 0.0
+    for epoch in range(10):
+        for i in range(30):
+            acc.attribute_tenants(
+                0.01, {f"e{epoch}-t{i}": {"decode": 1, "live": 1}})
+            total += 0.01
+        for t in list(acc._tenant_seen):
+            if t != OTHER:
+                acc._tenant_seen[t] -= acc.tenant_idle_expiry + 1.0
+        assert acc.expire_idle_tenants() > 0
+    assert len(acc._tenants) <= acc._tenant_cap
+    rows = acc.tenant_fields()["tenants"]
+    assert math.fsum(r["chip_seconds"] for r in rows.values()) == \
+        pytest.approx(total, rel=1e-9)
+    assert sum(r["decode_tokens"] for r in rows.values()) == 300
+    assert rows[OTHER]["decode_tokens"] == 300  # every cohort folded
+
+
+def test_tenant_tracker_expires_idle_and_recycles_slots():
+    """Router-side mirror: past the 6h bin horizon idle tenants expire
+    (their bins all aged out, so no windowed answer changes) and the
+    freed cap slots admit new identities instead of folding them."""
+    tracker = TenantUsageTracker(top_k=4)
+    for i in range(tracker.cap):
+        tracker.record_request(f"old{i:02d}", ts=0.0)
+    assert len(tracker._tenants) == tracker.cap
+    # cap full and nobody idle yet: a newcomer folds to "other"
+    tracker.record_request("mid", ts=100.0)
+    assert "mid" not in tracker._tenants
+    # past the horizon the idle cohort expires on the next admission —
+    # the newcomer lands in a named slot, not "other"
+    late = 21600.0 + 200.0
+    tracker.record_request("new", ts=late)
+    assert "new" in tracker._tenants
+    assert not any(t.startswith("old") for t in tracker._tenants)
+    rows = tracker.usage_rows(window=300.0, now=late + 1.0)
+    assert rows.get("new", {}).get("requests") == 1
+
+
+def test_tenant_tracker_expire_idle_is_idempotent():
+    tracker = TenantUsageTracker(top_k=4)
+    tracker.record_request("a", ts=0.0)
+    tracker.record_request("b", ts=30000.0)
+    assert tracker.expire_idle(now=30001.0) == 1   # only "a" aged out
+    assert tracker.expire_idle(now=30001.0) == 0
+    assert tracker._tenants == {"b"}

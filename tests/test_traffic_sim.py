@@ -113,6 +113,88 @@ def test_tenant_attribution_conserves_and_shows_noisy_neighbor():
     assert sum(shares.values()) == pytest.approx(1.0, abs=1e-3)
 
 
+# -- overload protection drills (docs/resilience.md "Overload & fairness") --
+
+NOISY_ARGS = [
+    "--users", "4000", "--max-groups", "4000", "--horizon", "900",
+    "--settle-seconds", "300", "--tenants", "4",
+    "--tenant-noisy-share", "0.85", "--replica-tokens-per-sec", "2000",
+    "--replica-max-streams", "64", "--max-replicas", "2",
+]
+
+
+def test_noisy_tenant_drill_quota_protects_victims():
+    """Acceptance drill: one tenant floods at ~10x its quota. With
+    enforcement on, every 429 lands on the noisy tenant and the victim
+    cohort's burn stays < 1 on every SLO; nothing fails (429s are
+    protection working, not errors)."""
+    artifact = run_sim(NOISY_ARGS + [
+        "--fair-share", "--quota-config",
+        '{"default": {"rps": 0, "tps": 0},'
+        ' "tenants": {"noisy": {"rps": 2, "weight": 1}}}',
+    ])
+    assert_clean(artifact)
+    m = artifact["models"]["sim-chat"]
+    ov = m["overload"]
+    # the noisy tenant absorbed ALL the 429s; victims were never limited
+    assert set(ov["quota_rejections"]) == {"noisy"}
+    assert ov["quota_rejections"]["noisy"] > 1000
+    for slo, burn in m["cohort_burn"]["victims"].items():
+        assert burn["fast"] < 1.0 and burn["slow"] < 1.0, (slo, burn)
+    # rejected groups are shed, not failed: conservation still holds
+    assert m["failed_streams"] == 0
+    assert m["completed"] + ov["quota_rejections"]["noisy"] == \
+        m["arrivals"]
+
+
+def test_noisy_tenant_counterfactual_without_enforcement_victims_burn():
+    """The same flood with quotas off: victims pay for the noisy tenant
+    — their TTFT burn exceeds 1. This is the counterfactual proving the
+    drill above measures enforcement, not a gentle workload."""
+    artifact = run_sim(list(NOISY_ARGS))
+    m = artifact["models"]["sim-chat"]
+    assert "overload" not in m             # nothing enforced, none reported
+    victim = m["cohort_burn"]["victims"]
+    assert max(victim["ttft_p95"]["fast"],
+               victim["ttft_p95"]["slow"]) > 1.0
+    assert m["failed_streams"] == 0        # overload, not errors
+
+
+def test_overload_storm_brownout_ladder_reaches_stage2_and_recovers():
+    """Acceptance drill: a storm at ~3x fleet capacity. The ladder
+    climbs to stage >= 2 (clamping output budgets), sheds real work,
+    and walks back to stage 0 once the storm passes — with zero cold
+    routes, failed streams, or leaked KV along the way."""
+    artifact = run_sim([
+        "--users", "12000", "--max-groups", "6000", "--horizon", "1800",
+        "--settle-seconds", "400", "--replica-tokens-per-sec", "2000",
+        "--replica-max-streams", "64", "--max-replicas", "4",
+        "--drain-grace", "600", "--brownout", "--brownout-queue-depth",
+        "64", "--brownout-max-tokens-clamp", "48",
+    ])
+    v = artifact["violations"]
+    assert v["cold_routes"] == 0
+    assert v["failed_streams"] == 0
+    assert v["kv_leaked_blocks"] == 0
+    assert v["tenant_conservation_breaks"] == 0
+    bo = artifact["models"]["sim-chat"]["overload"]["brownout"]
+    assert bo["peak_stage"] >= 2           # the ladder engaged for real
+    assert bo["final_stage"] == 0          # and recovered once calm
+    assert bo["sheds"].get("max_tokens", 0) > 0
+    # hysteresis: stage moves one step at a time, never jumps
+    for tr in bo["transitions"]:
+        assert abs(tr["to"] - tr["from"]) == 1
+
+
+def test_overload_features_off_artifact_is_unchanged():
+    """Observe-only invariant at the sim tier: a run with no overload
+    flags reports no overload block and matches the plain drill."""
+    artifact = run_sim(["--users", "10000", "--per-user-rate", "0.02"])
+    m = artifact["models"]["sim-chat"]
+    assert "overload" not in m and "cohort_burn" not in m
+    assert_clean(artifact)
+
+
 @pytest.mark.slow
 def test_soak_million_users_multimodel():
     """10^6-user soak (weighted request groups keep it tractable): diurnal
