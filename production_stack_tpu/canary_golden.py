@@ -1,0 +1,313 @@
+"""Golden store + logit-fingerprint machinery for the correctness
+canary plane (docs/observability.md "Correctness canaries").
+
+Every correctness guarantee in this stack (greedy bit-identity across
+TP/disagg/tiering/spec) is proven at test time; in production a silent
+numeric drift — a recompile picking a different fusion, a sharding
+fallback, a future fp8 KV path — would serve wrong tokens with every
+gauge green. This module is the shared half of the always-on
+measurement plane: pinned synthetic probes, versioned golden records,
+and the two-part comparison the router's prober (router/canary.py)
+runs against every probe response:
+
+* **exact greedy token identity** — the generated token strings must
+  equal the golden capture exactly (greedy decoding is deterministic,
+  so any divergence is a correctness event, not noise);
+* **top-k logprob fingerprint** — per-step top-k ``{token: logprob}``
+  maps compared under an L-infinity tolerance band. The tolerance
+  lives on each golden record, not globally: bf16 fleets pin
+  ``tolerance=0.0`` (bit-exact logits through the JSON round trip),
+  while a future quantized fleet records a banded golden
+  (ROADMAP item 1's documented quality bound) without loosening the
+  bf16 models' records.
+
+Records are captured from a trusted engine's ``GET /debug/canary``
+(tools/canaryctl.py ``record``), stored as a JSON document, and loaded
+by the router at startup. Engine-side, record generation reuses the
+existing ``compute_logprobs`` sampling path — no new jit signature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_TOP_K = 5
+DEFAULT_MAX_TOKENS = 8
+STORE_FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class CanaryProbe:
+    """One pinned synthetic request: greedy, fixed prompt, logprobs on."""
+
+    id: str
+    prompt: str
+    max_tokens: int = DEFAULT_MAX_TOKENS
+    top_k: int = DEFAULT_TOP_K
+
+    def request_body(self, model: str) -> dict:
+        """The OpenAI /v1/completions body this probe sends. Pinned:
+        greedy (temperature 0), non-streaming, logprobs on — the same
+        body byte-for-byte every round, so responses are comparable."""
+        return {
+            "model": model,
+            "prompt": self.prompt,
+            "max_tokens": self.max_tokens,
+            "temperature": 0.0,
+            "logprobs": self.top_k,
+            "stream": False,
+        }
+
+
+# The pinned default probe set. Changing a prompt here invalidates every
+# golden record for that probe id — bump the id instead of editing in
+# place.
+DEFAULT_PROBES: Tuple[CanaryProbe, ...] = (
+    CanaryProbe(id="greedy-prose",
+                prompt="The quick brown fox jumps over the lazy"),
+    CanaryProbe(id="greedy-count",
+                prompt="1 2 3 4 5 6 7"),
+)
+
+
+def probe_by_id(probe_id: str) -> Optional[CanaryProbe]:
+    for p in DEFAULT_PROBES:
+        if p.id == probe_id:
+            return p
+    return None
+
+
+@dataclasses.dataclass
+class GoldenRecord:
+    """A versioned trusted capture for one (model, probe).
+
+    ``tokens`` are the greedy completion's token strings (identity
+    check); ``fingerprint`` is the per-step top-k ``{token: logprob}``
+    map (``None`` for steps the capture carried no top-k for).
+    ``tolerance`` is the per-record L-infinity logit-error band: 0.0
+    demands exact equality (bf16 fleets), a positive band admits a
+    quantized fleet's documented drift."""
+
+    model: str
+    probe: str
+    prompt: str
+    tokens: List[str]
+    fingerprint: List[Optional[Dict[str, float]]]
+    max_tokens: int = DEFAULT_MAX_TOKENS
+    top_k: int = DEFAULT_TOP_K
+    tolerance: float = 0.0
+    version: int = 1
+    created: float = 0.0
+    source: str = ""
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "GoldenRecord":
+        fields = {f.name for f in dataclasses.fields(GoldenRecord)}
+        return GoldenRecord(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class CanaryVerdict:
+    """Outcome of checking one probe response against its golden.
+
+    ``kind`` is empty on a pass, else one of ``token`` (greedy identity
+    broken), ``fingerprint`` (logit error over the record's tolerance),
+    ``missing_logprobs`` (response carried no fingerprint to check)."""
+
+    ok: bool
+    kind: str = ""
+    linf: float = 0.0
+    first_divergence: int = -1
+    detail: str = ""
+
+
+def fingerprint_of(logprobs_block: Optional[dict]
+                   ) -> Tuple[List[str], List[Optional[Dict[str, float]]]]:
+    """OpenAI completions ``logprobs`` block → (token strings, per-step
+    top-k maps). Tolerates absent/None blocks (empty fingerprint)."""
+    if not isinstance(logprobs_block, dict):
+        return [], []
+    tokens = [str(t) for t in (logprobs_block.get("tokens") or [])]
+    tops = logprobs_block.get("top_logprobs") or []
+    fingerprint: List[Optional[Dict[str, float]]] = []
+    for entry in tops:
+        if isinstance(entry, dict):
+            fingerprint.append({str(k): float(v) for k, v in entry.items()})
+        else:
+            fingerprint.append(None)
+    # pad so len(fingerprint) == len(tokens): identity can still be
+    # checked for steps the capture carried no top-k for
+    while len(fingerprint) < len(tokens):
+        fingerprint.append(None)
+    return tokens, fingerprint[: len(tokens)]
+
+
+def compare(record: GoldenRecord, tokens: List[str],
+            fingerprint: List[Optional[Dict[str, float]]]) -> CanaryVerdict:
+    """Two-part comparison: exact greedy token identity first (any
+    divergence is a ``token`` failure at the first differing step),
+    then the L-infinity logit-error check over each step's top-k
+    intersection against the record's tolerance band."""
+    if not tokens:
+        return CanaryVerdict(ok=False, kind="missing_logprobs",
+                             detail="response carried no logprobs block")
+    if tokens != record.tokens:
+        first = next((i for i, (a, b) in enumerate(zip(tokens, record.tokens))
+                      if a != b), min(len(tokens), len(record.tokens)))
+        got = tokens[first] if first < len(tokens) else "<eos>"
+        want = (record.tokens[first] if first < len(record.tokens)
+                else "<eos>")
+        return CanaryVerdict(
+            ok=False, kind="token", first_divergence=first,
+            detail=f"greedy token {first} diverged: got {got!r}, "
+                   f"golden {want!r}")
+    linf = 0.0
+    worst_step = -1
+    compared = 0
+    for i, (obs, gold) in enumerate(zip(fingerprint, record.fingerprint)):
+        if not obs or not gold:
+            continue
+        shared = set(obs) & set(gold)
+        if not shared:
+            # completely disjoint top-k sets are a drift event even
+            # before any value comparison — the ranked candidates moved
+            return CanaryVerdict(
+                ok=False, kind="fingerprint", linf=math.inf,
+                first_divergence=i,
+                detail=f"step {i}: top-{record.top_k} candidate sets are "
+                       "disjoint from the golden capture")
+        for tok in shared:
+            err = abs(obs[tok] - gold[tok])
+            compared += 1
+            if err > linf:
+                linf, worst_step = err, i
+    if record.fingerprint and not compared:
+        return CanaryVerdict(ok=False, kind="missing_logprobs",
+                             detail="response fingerprint had no "
+                                    "comparable top-k entries")
+    if linf > record.tolerance:
+        return CanaryVerdict(
+            ok=False, kind="fingerprint", linf=linf,
+            first_divergence=worst_step,
+            detail=f"L-inf logit error {linf:.6g} exceeds the record's "
+                   f"tolerance {record.tolerance:g} at step {worst_step}")
+    return CanaryVerdict(ok=True, linf=linf)
+
+
+def record_from_response(model: str, probe: CanaryProbe, payload: dict,
+                         *, tolerance: float = 0.0, source: str = "",
+                         created: float = 0.0, note: str = "",
+                         version: int = 1) -> GoldenRecord:
+    """Build a golden record from a trusted /v1/completions response."""
+    choices = payload.get("choices") or []
+    if not choices:
+        raise ValueError("response has no choices to capture")
+    tokens, fingerprint = fingerprint_of(choices[0].get("logprobs"))
+    if not tokens:
+        raise ValueError("response carried no logprobs; golden capture "
+                         "requires logprobs on (is the probe pinned?)")
+    return GoldenRecord(
+        model=model, probe=probe.id, prompt=probe.prompt, tokens=tokens,
+        fingerprint=fingerprint, max_tokens=probe.max_tokens,
+        top_k=probe.top_k, tolerance=float(tolerance), version=version,
+        created=created, source=source, note=note,
+    )
+
+
+def diff_records(a: GoldenRecord, b: GoldenRecord) -> dict:
+    """Drift report between two captures of the same (model, probe) —
+    what canaryctl ``diff`` renders. Token divergence is reported as
+    the first differing step (-1 when identical); logit error is the
+    L-infinity distance over the shared per-step top-k entries."""
+    verdict = compare(a, b.tokens, b.fingerprint)
+    return {
+        "model": a.model,
+        "probe": a.probe,
+        "versions": [a.version, b.version],
+        "tokens_identical": b.tokens == a.tokens,
+        "first_token_divergence": (verdict.first_divergence
+                                   if verdict.kind == "token" else -1),
+        "linf": None if math.isinf(verdict.linf) else round(verdict.linf, 8),
+        "within_tolerance": verdict.ok or verdict.kind == "",
+        "detail": verdict.detail,
+    }
+
+
+class GoldenStore:
+    """Versioned golden records keyed by (model, probe id), persisted as
+    one JSON document. Loading tolerates a missing file (empty store):
+    a fleet with no goldens probes for availability only and reports
+    ``no_golden`` outcomes until canaryctl seeds the store."""
+
+    def __init__(self,
+                 records: Optional[Dict[Tuple[str, str], GoldenRecord]] = None,
+                 path: str = ""):
+        self.records: Dict[Tuple[str, str], GoldenRecord] = dict(
+            records or {})
+        self.path = path
+
+    @staticmethod
+    def load(path: str) -> "GoldenStore":
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return GoldenStore(path=path)
+        records: Dict[Tuple[str, str], GoldenRecord] = {}
+        for raw in doc.get("records", []):
+            rec = GoldenRecord.from_dict(raw)
+            records[(rec.model, rec.probe)] = rec
+        return GoldenStore(records, path=path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.path
+        doc = {
+            "format_version": STORE_FORMAT_VERSION,
+            "records": [self.records[k].to_dict()
+                        for k in sorted(self.records)],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def lookup(self, model: str, probe_id: str) -> Optional[GoldenRecord]:
+        return self.records.get((model, probe_id))
+
+    def put(self, record: GoldenRecord) -> GoldenRecord:
+        """Insert/refresh a record. A refresh that changes the capture
+        bumps the version (an unchanged re-record keeps it), so fleet
+        surfaces can tell "new golden" from "same golden re-stamped"."""
+        key = (record.model, record.probe)
+        prev = self.records.get(key)
+        if prev is not None:
+            if (prev.tokens == record.tokens
+                    and prev.fingerprint == record.fingerprint
+                    and prev.tolerance == record.tolerance):
+                record.version = prev.version
+            else:
+                record.version = prev.version + 1
+        self.records[key] = record
+        return record
+
+    def models(self) -> List[str]:
+        return sorted({m for m, _ in self.records})
+
+    def snapshot(self) -> dict:
+        """JSON shape for the /debug/canary surfaces."""
+        return {
+            "path": self.path,
+            "records": [
+                {"model": rec.model, "probe": rec.probe,
+                 "version": rec.version, "tolerance": rec.tolerance,
+                 "tokens": len(rec.tokens), "created": rec.created,
+                 "source": rec.source}
+                for _, rec in sorted(self.records.items())
+            ],
+        }

@@ -393,6 +393,7 @@ class EngineServer:
         app.router.add_post("/debug/profile", self.profile)
         app.router.add_get("/debug/memory", self.memory_profile)
         app.router.add_get("/debug/perf", self.debug_perf)
+        app.router.add_get("/debug/canary", self.debug_canary)
         app.router.add_get("/debug/overload", self.debug_overload)
         app.router.add_get("/debug/tenants", self.debug_tenants)
         app.router.add_get("/debug/requests", self.debug_requests)
@@ -1803,6 +1804,73 @@ class EngineServer:
         if self.usage_ledger is not None:
             block["ledger"] = self.usage_ledger.stats()
         return web.json_response(block)
+
+    async def debug_canary(self, request: web.Request) -> web.Response:
+        """Golden-capture surface for the correctness canary plane
+        (docs/observability.md "Correctness canaries"): runs the pinned
+        probe set through the normal admission path — greedy, logprobs
+        on, attributed to the reserved ``_canary`` tenant — and returns
+        the resulting golden-record documents. ``tools/canaryctl.py
+        record`` captures this from a trusted engine to seed the
+        router's golden store. No new jit signature: the probes use the
+        same sampling/compute_logprobs path as any logprobs-on
+        completions request. ``?tolerance=`` stamps a per-record
+        L-infinity band for quantized fleets (default 0.0: bit-exact)."""
+        from production_stack_tpu.canary_golden import (
+            DEFAULT_PROBES,
+            record_from_response,
+        )
+        from production_stack_tpu.tenancy import CANARY_TENANT
+
+        try:
+            tolerance = float(request.query.get("tolerance", 0.0))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "tolerance must be a float",
+                           "type": "invalid_request_error"}},
+                status=400,
+            )
+        tk = self.engine.tokenizer
+        records, errors = [], []
+        for probe in DEFAULT_PROBES:
+            rid = f"canary-{probe.id}-{uuid.uuid4().hex[:8]}"
+            sampling = SamplingParams(
+                max_tokens=probe.max_tokens, temperature=0.0,
+                logprobs=probe.top_k,
+            )
+            prompt_ids = tk.encode(probe.prompt)
+            try:
+                gens = await self.async_engine.admit_batch(
+                    [(rid, prompt_ids, sampling,
+                      self.lora.slot_of(self.model_name), CANARY_TENANT)])
+                token_ids: list[int] = []
+                lps: list = []
+                async for out in gens[0]:
+                    token_ids.extend(out.new_token_ids)
+                    if out.new_logprobs:
+                        lps.extend(out.new_logprobs)
+            except Exception as e:  # a sick engine still answers canaryctl
+                errors.append({"probe": probe.id, "error": str(e)})
+                continue
+            payload = {"choices": [{
+                "text": tk.decode(token_ids),
+                "logprobs": _fmt_completion_logprobs(
+                    tk, token_ids, lps, probe.top_k),
+            }]}
+            try:
+                rec = record_from_response(
+                    self.model_name, probe, payload, tolerance=tolerance,
+                    source=f"engine:{self.model_name}", created=time.time(),
+                )
+            except ValueError as e:
+                errors.append({"probe": probe.id, "error": str(e)})
+                continue
+            records.append(rec.to_dict())
+        return web.json_response({
+            "model": self.model_name,
+            "records": records,
+            "errors": errors,
+        })
 
     async def memory_profile(self, request: web.Request) -> web.Response:
         """Device memory profile (pprof proto) — what holds HBM right now."""

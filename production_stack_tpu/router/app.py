@@ -315,6 +315,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(incident opens bypass it)")
     p.add_argument("--diagnostics-interval", type=float, default=5.0,
                    help="seconds between SLO page-transition polls")
+    # correctness canary plane (router/canary.py +
+    # production_stack_tpu/canary_golden.py; docs/observability.md
+    # "Correctness canaries")
+    p.add_argument("--canary", action="store_true", default=False,
+                   help="enable the correctness canary prober: pinned "
+                        "greedy probes (logprobs on) through the full "
+                        "serving path, checked for exact token identity "
+                        "and logit-fingerprint drift against the golden "
+                        "store")
+    p.add_argument("--canary-interval", type=float, default=30.0,
+                   help="seconds between canary probe rounds")
+    p.add_argument("--canary-golden-path", default="",
+                   help="golden store JSON (captured via "
+                        "tools/canaryctl.py record); empty = probe for "
+                        "availability only, outcomes report no_golden")
+    p.add_argument("--canary-timeout", type=float, default=30.0,
+                   help="per-probe end-to-end timeout in seconds")
+    p.add_argument("--canary-target", default="",
+                   help="base URL probes are POSTed to (default: the "
+                        "router's own listen address, so every probe "
+                        "exercises the full serving path)")
     p.add_argument("--external-providers-config", default=None,
                    help="YAML file mapping model ids to external providers")
     p.add_argument("--api-key-file", default=None)
@@ -350,6 +371,7 @@ class RouterApp:
         self._scale_task: Optional[asyncio.Task] = None
         self._incident_task: Optional[asyncio.Task] = None
         self._brownout_task: Optional[asyncio.Task] = None
+        self._canary_task: Optional[asyncio.Task] = None
 
     # -- initialization (reference: app.py initialize_all) -------------------
     def initialize(self) -> None:
@@ -569,6 +591,16 @@ class RouterApp:
             session_provider=lambda: self.request_service.session,
         )
 
+        from production_stack_tpu.router.canary import (
+            CanaryConfig,
+            initialize_canary_prober,
+        )
+
+        initialize_canary_prober(
+            CanaryConfig.from_args(args),
+            session_provider=lambda: self.request_service.session,
+        )
+
         if args.enable_batch_api:
             from production_stack_tpu.router.services.batch_service import (
                 BatchProcessor,
@@ -665,6 +697,7 @@ class RouterApp:
         app.router.add_get("/debug/scale", self.debug_scale)
         app.router.add_get("/debug/overload", self.debug_overload)
         app.router.add_get("/debug/fleet", self.debug_fleet)
+        app.router.add_get("/debug/canary", self.debug_canary)
         app.router.add_get("/debug/diagnostics", self.debug_diagnostics)
         app.router.add_get("/debug/diagnostics/{bundle_id}",
                            self.debug_diagnostics_bundle)
@@ -759,6 +792,11 @@ class RouterApp:
         im = current_incident_manager()
         if im is not None and im.config.enabled:
             self._incident_task = asyncio.create_task(im.worker())
+        from production_stack_tpu.router.canary import current_canary_prober
+
+        prober = current_canary_prober()
+        if prober is not None:
+            self._canary_task = asyncio.create_task(prober.worker())
 
     async def _on_stop(self, app) -> None:
         if self.batch_processor is not None:
@@ -777,6 +815,13 @@ class RouterApp:
             self._incident_task.cancel()
         if self._brownout_task:
             self._brownout_task.cancel()
+        if self._canary_task:
+            self._canary_task.cancel()
+        from production_stack_tpu.router.canary import current_canary_prober
+
+        prober = current_canary_prober()
+        if prober is not None:
+            await prober.close()
 
     async def _log_stats_worker(self) -> None:
         while True:
@@ -943,6 +988,19 @@ class RouterApp:
         snap = await fleet_snapshot(self.request_service.session)
         return web.json_response(snap, dumps=lambda o: json.dumps(
             o, default=str))
+
+    async def debug_canary(self, request: web.Request) -> web.Response:
+        """Correctness canary state: prober config, golden-store
+        summary, and per-(model, probe) last outcomes with logit error
+        (docs/observability.md "Correctness canaries"). The engine tier
+        serves its own GET /debug/canary with freshly-generated golden
+        records — this is the router's verdict surface."""
+        from production_stack_tpu.router.canary import current_canary_prober
+
+        prober = current_canary_prober()
+        if prober is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(prober.snapshot())
 
     async def debug_diagnostics(self, request: web.Request) -> web.Response:
         """Incident ledger + the router-tier bundle archive index.

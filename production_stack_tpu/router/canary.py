@@ -1,0 +1,384 @@
+"""Continuous correctness canary: an active prober inside the router.
+
+The prober periodically sends the pinned synthetic probe set
+(production_stack_tpu/canary_golden.py: greedy, fixed prompts,
+``logprobs`` on) per served model through the router's own serving
+surface — a real ``POST /v1/completions`` against the router's listen
+address, so every probe exercises admission, routing, failover and
+(on role-split fleets) the disagg two-hop path exactly as tenant
+traffic does. Each response is checked against the versioned golden
+store: exact greedy token identity plus the top-k logprob fingerprint
+under the record's L-infinity tolerance band.
+
+Probes are stamped ``x-canary: 1`` and attributed to the reserved
+``_canary`` tenant, so they are excluded from tenant metering, quotas
+and scale-advisor signals (request_service routes them through a null
+stats monitor) — observe-only by construction. The prober itself feeds
+the availability SLO series (``SLOTracker.record_attempt``), which is
+the point: an idle model keeps a live burn rate instead of a stale
+zero. Identity/drift failures open an idempotent ``canary_drift``
+incident (router/incidents.py) fanning diagnostic-bundle capture out
+to the engines serving the model; a clean round closes it.
+
+Exports (router/metrics.py):
+``vllm:canary_probes_total{model,outcome}``,
+``vllm:canary_ttft_seconds``, ``vllm:canary_logit_error{model}``,
+``vllm:canary_identity_failures_total{model,kind}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+import aiohttp
+
+from production_stack_tpu.canary_golden import (
+    DEFAULT_PROBES,
+    GoldenStore,
+    compare,
+    fingerprint_of,
+)
+from production_stack_tpu.router import metrics as m
+from production_stack_tpu.tenancy import CANARY_HEADER, CANARY_TENANT, TENANT_HEADER
+
+logger = logging.getLogger(__name__)
+
+# probe outcomes (the `outcome` label of vllm:canary_probes_total):
+#   ok               identity + fingerprint match the golden
+#   drift            golden comparison failed (kind in the identity-
+#                    failure counter: token / fingerprint /
+#                    missing_logprobs)
+#   no_golden        probe served fine but no golden record exists yet
+#   error            the serving path failed (HTTP error / timeout)
+OUTCOMES = ("ok", "drift", "no_golden", "error")
+
+
+@dataclasses.dataclass
+class CanaryConfig:
+    enabled: bool = False
+    interval: float = 30.0
+    golden_path: str = ""
+    timeout: float = 30.0
+    # base URL the probes are POSTed to; defaults to the router's own
+    # listen address so the probe traverses the full serving path
+    target: str = ""
+
+    @staticmethod
+    def from_args(args) -> Optional["CanaryConfig"]:
+        if not getattr(args, "canary", False):
+            return None
+        host = getattr(args, "host", "127.0.0.1") or "127.0.0.1"
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        port = getattr(args, "port", 8001)
+        return CanaryConfig(
+            enabled=True,
+            interval=max(float(getattr(args, "canary_interval", 30.0)), 0.05),
+            golden_path=getattr(args, "canary_golden_path", "") or "",
+            timeout=max(float(getattr(args, "canary_timeout", 30.0)), 0.1),
+            target=(getattr(args, "canary_target", "") or
+                    f"http://{host}:{port}"),
+        )
+
+
+@dataclasses.dataclass
+class ProbeState:
+    """Last observation per (model, probe id) — the /debug/canary and
+    fleet-join shape."""
+
+    model: str
+    probe: str
+    role_path: str = "unified"
+    outcome: str = ""
+    kind: str = ""
+    detail: str = ""
+    linf: float = 0.0
+    ttft: float = 0.0
+    golden_version: int = 0
+    last_ts: float = 0.0
+    rounds: int = 0
+    failures: int = 0
+
+
+class CanaryProber:
+    """The active prober loop. One round probes every (model, probe)
+    pair the fleet serves; rounds repeat every ``config.interval``
+    seconds (the app owns the asyncio task)."""
+
+    def __init__(self, config: CanaryConfig, session_provider=None):
+        self.config = config
+        self.golden = (GoldenStore.load(config.golden_path)
+                       if config.golden_path else GoldenStore())
+        self._session_provider = session_provider
+        self._own_session: Optional[aiohttp.ClientSession] = None
+        self.state: Dict[Tuple[str, str], ProbeState] = {}
+        self.rounds = 0
+        self.last_round_ts = 0.0
+
+    # -- plumbing ------------------------------------------------------------
+    def _session(self) -> aiohttp.ClientSession:
+        if self._session_provider is not None:
+            return self._session_provider()
+        if self._own_session is None or self._own_session.closed:
+            self._own_session = aiohttp.ClientSession()
+        return self._own_session
+
+    async def close(self) -> None:
+        if self._own_session is not None and not self._own_session.closed:
+            await self._own_session.close()
+
+    @staticmethod
+    def _slo_tracker():
+        from production_stack_tpu.router.slo import current_slo_tracker
+
+        return current_slo_tracker()
+
+    @staticmethod
+    def _incident_manager():
+        from production_stack_tpu.router.incidents import (
+            current_incident_manager,
+        )
+
+        return current_incident_manager()
+
+    @staticmethod
+    def _fleet_models() -> Dict[str, dict]:
+        """{model: {"role_path": unified|disagg, "urls": [engine urls]}}
+        from live service discovery — targets follow scale events with
+        no prober restart."""
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+
+        out: Dict[str, dict] = {}
+        try:
+            endpoints = get_service_discovery().get_endpoint_info()
+        except Exception:
+            return out
+        for ep in endpoints:
+            if ep.sleep:
+                continue
+            for model in ep.model_names:
+                rec = out.setdefault(model, {"roles": set(), "urls": []})
+                rec["urls"].append(ep.url)
+                rec["roles"].add(ep.role or "unified")
+        for model, rec in out.items():
+            roles = rec.pop("roles")
+            rec["role_path"] = ("disagg"
+                                if {"prefill", "decode"} <= roles
+                                else "unified")
+        return out
+
+    # -- one probe -----------------------------------------------------------
+    async def _probe_once(self, model: str, probe, role_path: str,
+                          urls) -> ProbeState:
+        st = self.state.get((model, probe.id))
+        if st is None:
+            st = self.state[(model, probe.id)] = ProbeState(
+                model=model, probe=probe.id)
+        st.role_path = role_path
+        st.rounds += 1
+        now = time.time()
+        record = self.golden.lookup(model, probe.id)
+        st.golden_version = record.version if record else 0
+
+        headers = {CANARY_HEADER: "1", TENANT_HEADER: CANARY_TENANT}
+        t0 = time.monotonic()
+        ok_http = False
+        payload = None
+        try:
+            async with self._session().post(
+                f"{self.config.target}/v1/completions",
+                json=probe.request_body(model), headers=headers,
+                timeout=aiohttp.ClientTimeout(total=self.config.timeout),
+            ) as resp:
+                payload = await resp.json(content_type=None)
+                ok_http = resp.status == 200
+                if not ok_http:
+                    st.detail = (f"HTTP {resp.status}: "
+                                 f"{str(payload)[:200]}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            st.detail = f"{type(e).__name__}: {e}"
+        ttft = time.monotonic() - t0
+
+        # availability feed: this is what keeps an idle model's burn
+        # rate live — one attempt per probe, good iff the serving path
+        # answered (correctness drift is an incident, not an outage)
+        tracker = self._slo_tracker()
+        if tracker is not None:
+            tracker.record_attempt(model, ok_http, now)
+            if ok_http:
+                tracker.record_ttft(model, ttft, now)
+
+        st.last_ts = now
+        st.ttft = ttft
+        m.canary_ttft_seconds.observe(ttft)
+
+        if not ok_http:
+            st.outcome, st.kind, st.linf = "error", "", 0.0
+            st.failures += 1
+            m.canary_probes_total.labels(model=model, outcome="error").inc()
+            return st
+
+        if record is None:
+            st.outcome, st.kind, st.linf, st.detail = "no_golden", "", 0.0, ""
+            m.canary_probes_total.labels(model=model,
+                                         outcome="no_golden").inc()
+            return st
+
+        choices = (payload or {}).get("choices") or []
+        tokens, fingerprint = fingerprint_of(
+            choices[0].get("logprobs") if choices else None)
+        verdict = compare(record, tokens, fingerprint)
+        st.linf = verdict.linf if math.isfinite(verdict.linf) else -1.0
+        if verdict.ok:
+            st.outcome, st.kind, st.detail = "ok", "", ""
+            m.canary_probes_total.labels(model=model, outcome="ok").inc()
+            m.canary_logit_error.labels(model=model).set(verdict.linf)
+            return st
+
+        st.outcome, st.kind, st.detail = "drift", verdict.kind, verdict.detail
+        st.failures += 1
+        m.canary_probes_total.labels(model=model, outcome="drift").inc()
+        m.canary_identity_failures_total.labels(
+            model=model, kind=verdict.kind).inc()
+        if math.isfinite(verdict.linf):
+            m.canary_logit_error.labels(model=model).set(verdict.linf)
+        logger.warning(
+            "canary drift on model %s probe %s (%s): %s",
+            model, probe.id, verdict.kind, verdict.detail)
+        self._open_drift_incident(model, probe.id, verdict, urls)
+        return st
+
+    def _open_drift_incident(self, model: str, probe_id: str, verdict,
+                             urls) -> None:
+        im = self._incident_manager()
+        if im is None:
+            return
+        record = self.golden.lookup(model, probe_id)
+        try:
+            im.open_incident(
+                "canary_drift", f"canary_drift:{model}",
+                window={
+                    "model": model, "probe": probe_id,
+                    "kind": verdict.kind,
+                    "linf": (verdict.linf
+                             if math.isfinite(verdict.linf) else None),
+                    "golden_version": record.version if record else 0,
+                    "detail": verdict.detail,
+                },
+                implicated=sorted(set(urls)),
+            )
+        except Exception:
+            logger.exception("canary_drift incident open failed")
+
+    def _close_if_clean(self, model: str) -> None:
+        """Every probe for the model passed this round → the drift
+        incident (if any) closes; idempotent-per-key semantics mean a
+        still-drifting model re-touches the same open incident."""
+        im = self._incident_manager()
+        if im is None:
+            return
+        try:
+            im.close_incident(f"canary_drift:{model}",
+                              "canary probes clean")
+        except Exception:
+            logger.exception("canary incident close failed")
+
+    # -- rounds --------------------------------------------------------------
+    async def run_round(self) -> None:
+        fleet = self._fleet_models()
+        for model in sorted(fleet):
+            rec = fleet[model]
+            outcomes = []
+            for probe in DEFAULT_PROBES:
+                st = await self._probe_once(model, probe, rec["role_path"],
+                                            rec["urls"])
+                outcomes.append(st.outcome)
+            if outcomes and all(o in ("ok", "no_golden") for o in outcomes):
+                self._close_if_clean(model)
+        self.rounds += 1
+        self.last_round_ts = time.time()
+
+    async def worker(self) -> None:
+        # stagger the first round past startup so discovery has settled
+        await asyncio.sleep(min(self.config.interval, 2.0))
+        while True:
+            try:
+                await self.run_round()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("canary probe round failed")
+            await asyncio.sleep(self.config.interval)
+
+    # -- surfaces ------------------------------------------------------------
+    def model_summary(self) -> Dict[str, dict]:
+        """Worst-state-per-model join for /debug/fleet and stacktop's
+        CANARY column."""
+        now = time.time()
+        out: Dict[str, dict] = {}
+        rank = {"": 0, "ok": 1, "no_golden": 2, "error": 3, "drift": 4}
+        for (model, _), st in sorted(self.state.items()):
+            cur = out.setdefault(model, {
+                "outcome": "", "kind": "", "linf": 0.0, "age": -1.0,
+                "golden_version": 0,
+            })
+            if rank.get(st.outcome, 0) > rank.get(cur["outcome"], 0):
+                cur["outcome"], cur["kind"] = st.outcome, st.kind
+            cur["linf"] = max(cur["linf"], round(st.linf, 8))
+            if st.last_ts:
+                age = round(now - st.last_ts, 1)
+                cur["age"] = age if cur["age"] < 0 else min(cur["age"], age)
+            cur["golden_version"] = max(cur["golden_version"],
+                                        st.golden_version)
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON document for the router's ``GET /debug/canary``."""
+        now = time.time()
+        return {
+            "enabled": self.config.enabled,
+            "interval": self.config.interval,
+            "target": self.config.target,
+            "rounds": self.rounds,
+            "last_round_age": (round(now - self.last_round_ts, 1)
+                               if self.last_round_ts else -1.0),
+            "golden": self.golden.snapshot(),
+            "probes": [
+                {
+                    "model": st.model, "probe": st.probe,
+                    "role_path": st.role_path, "outcome": st.outcome,
+                    "kind": st.kind, "detail": st.detail,
+                    "linf": round(st.linf, 8),
+                    "ttft": round(st.ttft, 4),
+                    "golden_version": st.golden_version,
+                    "age": (round(now - st.last_ts, 1)
+                            if st.last_ts else -1.0),
+                    "rounds": st.rounds, "failures": st.failures,
+                }
+                for _, st in sorted(self.state.items())
+            ],
+        }
+
+
+_prober: Optional[CanaryProber] = None
+
+
+def initialize_canary_prober(config: Optional[CanaryConfig],
+                             session_provider=None) -> Optional[CanaryProber]:
+    global _prober
+    _prober = (CanaryProber(config, session_provider)
+               if config is not None else None)
+    return _prober
+
+
+def current_canary_prober() -> Optional[CanaryProber]:
+    return _prober

@@ -41,8 +41,26 @@ async def _probe_engine(session, url: str) -> dict:
     return {"perf": perf, "ready": ready}
 
 
+def _canary_cell(ep, canary_by_model: dict) -> Optional[dict]:
+    """Worst canary verdict across the models this engine serves — the
+    prober probes per (model, role-path), so the join key is the model
+    name, not the engine URL."""
+    if not canary_by_model:
+        return None
+    rank = {"": 0, "ok": 1, "no_golden": 2, "error": 3, "drift": 4}
+    worst = None
+    for model in ep.model_names:
+        row = canary_by_model.get(model)
+        if row is None:
+            continue
+        if worst is None or (rank.get(row.get("outcome", ""), 0)
+                             > rank.get(worst.get("outcome", ""), 0)):
+            worst = row
+    return worst
+
+
 def _engine_row(ep, probe: dict, estats, rstats, reasons: dict,
-                incidents) -> dict:
+                incidents, canary_by_model: Optional[dict] = None) -> dict:
     perf = probe.get("perf") or {}
     ready = probe.get("ready")
     hbm = perf.get("hbm_bytes") or {}
@@ -88,6 +106,10 @@ def _engine_row(ep, probe: dict, estats, rstats, reasons: dict,
         "ttft": rstats.ttft if rstats else None,
         "tokens_per_second": tps or None,
         "unexpected_recompiles": compile_info.get("unexpected_recompiles"),
+        # correctness-canary verdict for this engine's model(s): last
+        # outcome + max logit error from the router's prober — None
+        # when the canary plane is off or hasn't probed yet
+        "canary": _canary_cell(ep, canary_by_model or {}),
         "incidents": (incidents.open_incidents_for(ep.url)
                       if incidents is not None else []),
     }
@@ -123,11 +145,16 @@ async def fleet_snapshot(session) -> dict:
     except AssertionError:
         request_stats = {}
     incidents = current_incident_manager()
+    from production_stack_tpu.router.canary import current_canary_prober
+
+    prober = current_canary_prober()
+    canary_by_model = prober.model_summary() if prober is not None else {}
     probes = await asyncio.gather(
         *(_probe_engine(session, ep.url) for ep in endpoints))
     engines = [
         _engine_row(ep, probe, engine_stats.get(ep.url),
-                    request_stats.get(ep.url), reasons, incidents)
+                    request_stats.get(ep.url), reasons, incidents,
+                    canary_by_model)
         for ep, probe in zip(endpoints, probes)
     ]
     tracker = current_slo_tracker()
@@ -146,6 +173,8 @@ async def fleet_snapshot(session) -> dict:
             "scale": advisor.snapshot() if advisor is not None else None,
             "incidents": (incidents.snapshot() if incidents is not None
                           else {"open": 0, "incidents": []}),
+            "canary": (prober.snapshot() if prober is not None
+                       else {"enabled": False}),
             "disagg": m.disagg_snapshot(),
         },
     }

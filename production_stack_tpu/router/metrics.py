@@ -113,6 +113,37 @@ slo_error_budget_remaining = Gauge(
     "Fraction of the 6h error budget unspent (negative = blown)",
     ["model", "slo"],
 )
+# correctness canary plane (router/canary.py +
+# production_stack_tpu/canary_golden.py): the router's active prober
+# sends pinned greedy probes through the full serving path and checks
+# token identity + top-k logprob fingerprints against the golden store.
+canary_probes_total = Counter(
+    "vllm:canary_probes",
+    "Correctness canary probes, by outcome (ok=identity+fingerprint "
+    "match the golden, drift=golden comparison failed, no_golden=no "
+    "trusted record to compare against, error=the serving path failed)",
+    ["model", "outcome"],
+)
+canary_ttft_seconds = Histogram(
+    "vllm:canary_ttft_seconds",
+    "Canary probe response time through the full serving path "
+    "(buffered greedy completion — a liveness floor for idle models)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             float("inf")),
+)
+canary_logit_error = Gauge(
+    "vllm:canary_logit_error",
+    "Last observed L-infinity logit error against the model's golden "
+    "fingerprint (0 = bit-exact; compare to the record's tolerance)",
+    ["model"],
+)
+canary_identity_failures_total = Counter(
+    "vllm:canary_identity_failures",
+    "Canary correctness failures, by kind (token=greedy identity "
+    "broken, fingerprint=logit error over the record's tolerance, "
+    "missing_logprobs=response carried nothing to verify)",
+    ["model", "kind"],
+)
 # tenant attribution plane (production_stack_tpu/tenancy.py): router-side
 # fairness gauges over the 10s-bin usage series (router/slo.py
 # TenantUsageTracker). Label cardinality is bounded: every refresh folds
@@ -265,15 +296,45 @@ def refresh_label_gauges(engine_stats: dict, request_stats: dict) -> None:
                     pass
 
 
+_slo_labels: set = set()
+
+
 def refresh_slo_gauges(tracker) -> None:
     """Export the SLO tracker's burn-rate series; no-op when no
-    objectives are configured (tracker is None)."""
+    objectives are configured (tracker is None). Windows with zero
+    observations are NO-DATA: their burn gauge is omitted (and a
+    previously-exported label removed) instead of publishing a stale
+    0.0 that would read as a healthy SLO on an idle model. The canary
+    prober (router/canary.py) keeps actively-probed models' windows
+    populated, so this omission only surfaces genuinely unmeasured
+    series."""
     if tracker is None:
         return
-    for model, slo, rates, remaining in tracker.gauge_rows():
+    live: set = set()
+    for model, slo, rates, remaining, counts in tracker.gauge_rows():
         for window, rate in rates.items():
-            slo_burn_rate.labels(model=model, slo=slo, window=window).set(rate)
-        slo_error_budget_remaining.labels(model=model, slo=slo).set(remaining)
+            if not counts.get(window):
+                continue
+            slo_burn_rate.labels(model=model, slo=slo,
+                                 window=window).set(rate)
+            live.add(("burn", model, slo, window))
+        if counts.get("6h"):
+            slo_error_budget_remaining.labels(model=model,
+                                              slo=slo).set(remaining)
+            live.add(("budget", model, slo, ""))
+    for key in list(_slo_labels):
+        if key in live:
+            continue
+        kind, model, slo, window = key
+        try:
+            if kind == "burn":
+                slo_burn_rate.remove(model, slo, window)
+            else:
+                slo_error_budget_remaining.remove(model, slo)
+        except KeyError:
+            pass
+    _slo_labels.clear()
+    _slo_labels.update(live)
 
 
 _tenant_labels: set = set()

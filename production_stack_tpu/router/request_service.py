@@ -41,9 +41,44 @@ from production_stack_tpu.router.stats import (
     get_engine_stats_scraper,
     get_request_stats_monitor,
 )
-from production_stack_tpu.tenancy import TENANT_HEADER, resolve_tenant
+from production_stack_tpu.tenancy import (
+    CANARY_HEADER,
+    CANARY_TENANT,
+    TENANT_HEADER,
+    resolve_tenant,
+)
 
 logger = init_logger(__name__)
+
+
+class _NullStatsMonitor:
+    """Stats sink for canary-stamped probes. The prober records its own
+    SLO observations (exactly one availability attempt per probe), and
+    synthetic traffic must never steer routing load estimates, scale
+    signals, or tenant usage — observe-only by construction."""
+
+    def on_new_request(self, *a, **k):
+        pass
+
+    def on_request_response(self, *a, **k):
+        pass
+
+    def on_request_complete(self, *a, **k):
+        pass
+
+    def on_request_swapped(self, *a, **k):
+        pass
+
+
+_NULL_MONITOR = _NullStatsMonitor()
+
+
+def _stats_monitor_for(request):
+    """The real request-stats monitor, or the null sink for requests
+    stamped ``x-canary: 1`` at admission."""
+    if hasattr(request, "get") and request.get("canary"):
+        return _NULL_MONITOR
+    return get_request_stats_monitor()
 
 HOP_BY_HOP = {
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
@@ -395,14 +430,24 @@ class RequestService:
         body["model"] = resolved
         rec["model"] = resolved
         # tenant identity for attribution, resolved once at admission and
-        # carried on the request for every backend hop (observe-only)
-        tenant = resolve_tenant(request.headers, body,
-                                header_name=self.tenant_header)
+        # carried on the request for every backend hop (observe-only).
+        # Canary-stamped probes (router/canary.py) are forced onto the
+        # reserved _canary tenant and bypass quotas/brownout shed: the
+        # prober must observe the serving path, not the admission plane,
+        # and its traffic may never debit a real tenant's bucket.
+        canary = request.headers.get(CANARY_HEADER) == "1"
+        if canary:
+            request["canary"] = True
+            rec["canary"] = True
+            tenant = CANARY_TENANT
+        else:
+            tenant = resolve_tenant(request.headers, body,
+                                    header_name=self.tenant_header)
         request["tenant"] = tenant
         rec["tenant"] = tenant
         m.num_incoming_requests_total.labels(model=resolved or "unknown").inc()
 
-        shed = self._admission_check(tenant, body, rec)
+        shed = None if canary else self._admission_check(tenant, body, rec)
         if shed is not None:
             return shed
 
@@ -713,7 +758,7 @@ class RequestService:
         prefix so the failover loop can replay the remainder. ``raw_body``
         (multipart audio) is relayed byte-identical instead of re-serialising
         ``body``."""
-        monitor = get_request_stats_monitor()
+        monitor = _stats_monitor_for(request)
         stream = bool(body.get("stream", False))
         strip_usage = False
         strip_chunk_usage = False
@@ -950,7 +995,7 @@ class RequestService:
         a prepared StreamResponse cannot. Raises BackendError on connect
         failure / 5xx / overload-429, mirroring ``_attempt``'s contract,
         and keeps the same stats/usage accounting."""
-        monitor = get_request_stats_monitor()
+        monitor = _stats_monitor_for(request)
         res = self.resilience
         tenant = self._tenant_of(request)
         headers = sanitize_headers(request.headers)
@@ -1069,7 +1114,7 @@ class RequestService:
                 request_id, t_start,
             )
 
-        monitor = get_request_stats_monitor()
+        monitor = _stats_monitor_for(request)
         prefill_body = dict(body)
         prefill_body.update(
             {
@@ -1150,7 +1195,7 @@ class RequestService:
         the continuation prompt itself is the re-prefill fallback. All
         three produce the same greedy completion."""
         res = self.resilience
-        monitor = get_request_stats_monitor()
+        monitor = _stats_monitor_for(request)
         deadline = self._request_deadline(request, t_start)
         res.budget.on_request()
         m.retry_budget_remaining.set(res.budget.remaining())

@@ -123,6 +123,18 @@ class _BinSeries:
         total = good + bad
         return bad / total if total else 0.0
 
+    def total(self, window: float, now: float) -> float:
+        """Observation count inside the trailing window — the export
+        layer omits burn gauges for windows with zero observations
+        (no-data, not a healthy zero)."""
+        count = 0
+        cutoff = now - window
+        for start, g, b in reversed(self.bins):
+            if start + BIN_SECONDS <= cutoff:
+                break
+            count += g + b
+        return count
+
 
 class SLOTracker:
     """Per-(model, slo) burn-rate series. Thread-compatible with the
@@ -171,6 +183,20 @@ class SLOTracker:
         return {w: series.bad_fraction(span, now) / budget
                 for w, span in WINDOWS.items()}
 
+    def window_observations(self, model: str, slo: str,
+                            now: Optional[float] = None) -> Dict[str, float]:
+        """Observation counts per window. Distinguishes "no data" from
+        "all good": an idle model's availability series has rate 0.0 in
+        every window, but only windows with observations are exported —
+        a stale zero would read as a healthy SLO when nothing was
+        measured at all. The canary prober exists to keep these counts
+        nonzero on idle models."""
+        now = now if now is not None else time.time()
+        series = self._series.get((model, slo))
+        if series is None:
+            return {w: 0.0 for w in WINDOWS}
+        return {w: series.total(span, now) for w, span in WINDOWS.items()}
+
     def error_budget_remaining(self, model: str, slo: str,
                                now: Optional[float] = None) -> float:
         """Fraction of the 6h window's error budget still unspent (can go
@@ -189,12 +215,16 @@ class SLOTracker:
         }
 
     def gauge_rows(self, now: Optional[float] = None):
-        """(model, slo, burn-rate-by-window, budget-remaining) per active
-        series — the shape router/metrics.py exports."""
+        """(model, slo, burn-rate-by-window, budget-remaining,
+        observations-by-window) per active series — the shape
+        router/metrics.py exports. Windows with zero observations are
+        no-data: the exporter omits (and removes) their burn gauge
+        instead of publishing a stale zero."""
         now = now if now is not None else time.time()
         for model, slo in sorted(self._series):
             yield (model, slo, self.burn_rates(model, slo, now),
-                   self.error_budget_remaining(model, slo, now))
+                   self.error_budget_remaining(model, slo, now),
+                   self.window_observations(model, slo, now))
 
     def page_firing(self, now: Optional[float] = None) -> bool:
         """True when ANY active series' fast-burn page condition holds —
@@ -210,13 +240,17 @@ class SLOTracker:
         """JSON document for ``GET /debug/slo``."""
         now = now if now is not None else time.time()
         series = []
-        for model, slo, rates, remaining in self.gauge_rows(now):
+        for model, slo, rates, remaining, counts in self.gauge_rows(now):
             threshold, budget = self.config.objectives(model)[slo]
             series.append({
                 "model": model, "slo": slo,
                 "objective": threshold, "error_budget": budget,
-                "burn_rate": {w: round(r, 4) for w, r in rates.items()},
-                "error_budget_remaining": round(remaining, 4),
+                # no-data windows are served as null, not a stale 0.0 —
+                # an idle model reads "unmeasured", not "perfect"
+                "burn_rate": {w: (round(r, 4) if counts[w] else None)
+                              for w, r in rates.items()},
+                "error_budget_remaining": (round(remaining, 4)
+                                           if counts["6h"] else None),
                 **self._flags(rates),
             })
         return {
@@ -253,17 +287,22 @@ class TenantUsageTracker:
     KINDS = ("requests", "ttft", "itl")
 
     def __init__(self, top_k: int = 8):
-        from production_stack_tpu.tenancy import OTHER
+        from production_stack_tpu.tenancy import CANARY_TENANT, OTHER
 
         self.top_k = max(int(top_k), 1)
         self.cap = max(4 * self.top_k, 64)
         self._other = OTHER
+        self._canary = CANARY_TENANT
         self._series: Dict[Tuple[str, str], _BinSeries] = {}
         self._tenants: set = set()
         self._last_seen: Dict[str, float] = {}
 
     def _admit(self, tenant: str, ts: float) -> str:
-        if tenant in self._tenants:
+        if tenant in self._tenants or tenant == self._canary:
+            # the reserved canary identity never falls through to
+            # "other": folding synthetic-probe usage into a shared
+            # bucket would contaminate real tenants' folded rows
+            self._tenants.add(tenant)
             self._last_seen[tenant] = max(self._last_seen.get(tenant, 0.0),
                                           ts)
             return tenant

@@ -48,6 +48,18 @@ from typing import Dict, Mapping, Optional
 ANONYMOUS = "anonymous"
 OTHER = "other"
 TENANT_HEADER = "x-tenant-id"
+# the correctness-canary plane's reserved identity: the router stamps
+# its synthetic probes with this tenant (plus the x-canary marker
+# header), so canary usage is attributed separately and NEVER folded
+# into a real tenant's rows or the "other" bucket — real tenants'
+# totals are bit-identical with the prober on or off, while the
+# conservation invariant (parts sum to total) still holds with the
+# _canary row included.
+CANARY_TENANT = "_canary"
+CANARY_HEADER = "x-canary"
+# identities that never compete for a top-K slot and never merge into
+# the fold bucket: they are kept as their own rows in every export
+_RESERVED = frozenset({OTHER, CANARY_TENANT})
 DEFAULT_TOP_K = 8
 
 # label-safe tenant ids: printable, short, no label-injection characters.
@@ -117,14 +129,21 @@ def fold_top_k(values: Mapping[str, float], k: int = DEFAULT_TOP_K,
 
     Deterministic (ties break by name) and conserving: the folded
     mapping's total equals the input's. A pre-existing ``other`` entry
-    never competes for a top-K slot — it is already the fold bucket."""
-    pool = {t: v for t, v in values.items() if t != other}
+    never competes for a top-K slot — it is already the fold bucket.
+    The reserved ``_canary`` identity (the router's synthetic probes)
+    likewise keeps its own row: canary usage is never merged into
+    ``other``, so real tenants' folded values are identical with the
+    prober on or off."""
+    reserved = {other} | _RESERVED
+    pool = {t: v for t, v in values.items() if t not in reserved}
     keep = sorted(pool, key=lambda t: (-pool[t], t))[: max(int(k), 0)]
     out = {t: pool[t] for t in keep}
     rest = sum(v for t, v in pool.items() if t not in out)
     rest += values.get(other, 0)
     if rest or (other in values):
         out[other] = rest
+    if CANARY_TENANT in values and CANARY_TENANT != other:
+        out[CANARY_TENANT] = values[CANARY_TENANT]
     return out
 
 
@@ -133,8 +152,11 @@ def fold_records(records: Mapping[str, Mapping[str, float]],
                  other: str = OTHER) -> Dict[str, Dict[str, float]]:
     """:func:`fold_top_k` for per-tenant record dicts: rank by
     ``weight_key``, fold the remainder by summing every numeric field —
-    each field's fleet total is conserved across the fold."""
-    pool = {t: dict(r) for t, r in records.items() if t != other}
+    each field's fleet total is conserved across the fold. The reserved
+    ``_canary`` row is carried through unfolded, same as
+    :func:`fold_top_k`."""
+    reserved = {other} | _RESERVED
+    pool = {t: dict(r) for t, r in records.items() if t not in reserved}
     keep = sorted(pool, key=lambda t: (-float(pool[t].get(weight_key, 0)), t)
                   )[: max(int(k), 0)]
     out = {t: pool[t] for t in keep}
@@ -149,6 +171,8 @@ def fold_records(records: Mapping[str, Mapping[str, float]],
                 folded[key] = folded.get(key, 0) + val
     if rest or (other in records):
         out[other] = folded
+    if CANARY_TENANT in records and CANARY_TENANT != other:
+        out[CANARY_TENANT] = dict(records[CANARY_TENANT])
     return out
 
 

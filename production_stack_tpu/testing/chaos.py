@@ -25,6 +25,12 @@ Event actions (``ChaosEvent.action``):
               then never progress, modelling a wedged device dispatch —
               drives the stuck-step watchdog / readiness-ejection path
   fault       arm an arbitrary fault spec string (testing/faults.py)
+  drift       arm a NUMERIC fault: the backend keeps serving 200s with
+              the right availability shape but its logprob fingerprint
+              (and, with ``wrong_token_at_step``, its greedy tokens)
+              silently drift — the failure mode only the correctness
+              canary plane can see. ``spec`` is the noise scale
+              (default 0.5) or a full fault spec string
   clear       clear all faults on the target
 
 Scenarios drive the FAKE fleet; real-engine drain/watchdog behavior is
@@ -56,12 +62,12 @@ class ChaosEvent:
     """One timed action against one backend of the fleet."""
 
     at: float           # seconds after ChaosScenario.run() starts
-    action: str         # kill | partition | heal | drain | hang | fault | clear
+    action: str  # kill | partition | heal | drain | hang | fault | drift | clear
     target: int         # backend index in the fleet
-    spec: Optional[str] = None  # fault spec for action in ("hang", "fault")
+    spec: Optional[str] = None  # spec for action in ("hang", "fault", "drift")
 
     _ACTIONS = ("kill", "partition", "heal", "drain", "hang", "fault",
-                "clear")
+                "drift", "clear")
 
     def __post_init__(self):
         if self.action not in self._ACTIONS:
@@ -162,6 +168,16 @@ class ChaosFleet:
             FaultSpec.parse(f"hang_after_ms={after_ms}"))
 
     def fault(self, i: int, spec: str) -> None:
+        self.engines[i].fault_state.set(FaultSpec.parse(spec))
+
+    def drift(self, i: int, spec: Optional[str] = None) -> None:
+        """Arm a silent numeric drift on backend ``i``: availability
+        stays green while the logprob fingerprint moves. ``spec`` may
+        be a bare noise scale ("0.5") or a full fault spec string
+        ("wrong_token_at_step=3")."""
+        spec = spec or "0.5"
+        if "=" not in spec:
+            spec = f"logit_noise_scale={float(spec)}"
         self.engines[i].fault_state.set(FaultSpec.parse(spec))
 
     def clear(self, i: int) -> None:
@@ -282,5 +298,7 @@ class ChaosScenario:
                        float(ev.spec) if ev.spec else 1.0)
         elif ev.action == "fault":
             fleet.fault(ev.target, ev.spec)
+        elif ev.action == "drift":
+            fleet.drift(ev.target, ev.spec)
         elif ev.action == "clear":
             fleet.clear(ev.target)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import json
 import time
 import uuid
@@ -29,6 +30,18 @@ from production_stack_tpu.testing.faults import (
     FaultState,
     fault_middleware,
 )
+
+
+def _canary_logprob(model: str, step: int, rank: int) -> float:
+    """Deterministic pseudo-logprob: a pure function of
+    (model, step, rank) via a hash, so a golden record captured from
+    one fake engine matches a probe answered by ANY clean fake of the
+    same model — exactly the bit-identity a real bf16 fleet promises.
+    rank 0 is the sampled (greedy) token; deeper ranks are strictly
+    less likely."""
+    h = hashlib.sha256(f"{model}|{step}|{rank}".encode()).digest()
+    frac = int.from_bytes(h[:8], "big") / 2 ** 64
+    return round(-0.01 - 1.5 * rank - frac, 6)
 
 
 class FakeEngine:
@@ -142,6 +155,7 @@ class FakeEngine:
         app.router.add_post("/v1/load_lora_adapter", self.load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self.unload_lora)
         app.router.add_get("/debug/perf", self.debug_perf)
+        app.router.add_get("/debug/canary", self.debug_canary)
         app.router.add_get("/debug/diagnostics", self.debug_diagnostics)
         app.router.add_post("/debug/diagnostics/capture",
                             self.debug_diagnostics_capture)
@@ -221,8 +235,91 @@ class FakeEngine:
                         drop_rate=s.drop_rate, stall_ms=s.stall_ms,
                         stream_abort_rate=s.stream_abort_rate,
                         stream_abort_after_ms=s.stream_abort_after_ms,
-                        hang_after_ms=s.hang_after_ms)
+                        hang_after_ms=s.hang_after_ms,
+                        logit_noise_scale=s.logit_noise_scale,
+                        wrong_token_at_step=s.wrong_token_at_step)
         return web.json_response(body)
+
+    # -- correctness-canary surface (mirrors the real engine server) ---------
+    def _generated_words(self, first: int, n: int) -> list:
+        """The canned greedy stream, with the wrong_token_at_step
+        numeric fault applied — both the response text and the logprob
+        fingerprint carry the swapped token, like a real engine whose
+        argmax flipped."""
+        words = [f"tok{i} " for i in range(first, first + n)]
+        spec = self.fault_state.spec
+        wrong_at = spec.wrong_token_at_step if spec else -1
+        idx = wrong_at - first
+        if 0 <= idx < len(words):
+            words[idx] = f"tok{wrong_at + 9000} "
+        return words
+
+    def _completion_logprobs(self, words: list, first: int,
+                             top_k: int) -> dict:
+        """OpenAI completions logprobs block from the deterministic
+        per-(model, step, rank) pseudo-logprob function, with the
+        logit_noise_scale fault folded in: each entry is perturbed by a
+        deterministic signed amount in [0.5, 1.0]x the scale, so any
+        armed noise is guaranteed to trip a 0-tolerance golden while
+        staying reproducible across probe rounds."""
+        spec = self.fault_state.spec
+        noise = spec.logit_noise_scale if spec else 0.0
+        tokens, tlps, tops, offsets = [], [], [], []
+        off = 0
+        for i, w in enumerate(words):
+            step = first + i
+            tokens.append(w)
+            offsets.append(off)
+            off += len(w)
+            top = {}
+            for rank in range(max(int(top_k), 1)):
+                tok = w if rank == 0 else f"tok{step}r{rank} "
+                lp = _canary_logprob(self.model, step, rank)
+                if noise:
+                    h = hashlib.sha256(
+                        f"noise|{self.model}|{step}|{rank}".encode()
+                    ).digest()
+                    frac = int.from_bytes(h[:8], "big") / 2 ** 64
+                    lp += (noise * (0.5 + 0.5 * frac)
+                           * (1 if rank % 2 == 0 else -1))
+                top[tok] = round(lp, 6)
+            tlps.append(top[w])
+            tops.append(top if top_k > 0 else None)
+        return {"tokens": tokens, "token_logprobs": tlps,
+                "top_logprobs": tops, "text_offset": offsets}
+
+    async def debug_canary(self, request):
+        """Golden-capture surface mirroring the real engine's GET
+        /debug/canary: runs the pinned probe set through the same
+        deterministic logprob path the serving endpoints use — faults
+        included, so a sickened fake captures its sick numerics exactly
+        like a real drifted engine would."""
+        from production_stack_tpu.canary_golden import (
+            DEFAULT_PROBES,
+            record_from_response,
+        )
+
+        try:
+            tolerance = float(request.query.get("tolerance", 0.0))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "tolerance must be a float"}},
+                status=400)
+        records = []
+        for probe in DEFAULT_PROBES:
+            first = self._resume_index({"prompt": probe.prompt}, chat=False)
+            words = self._generated_words(first, probe.max_tokens)
+            payload = {"choices": [{
+                "text": "".join(words),
+                "logprobs": self._completion_logprobs(
+                    words, first, probe.top_k),
+            }]}
+            rec = record_from_response(
+                self.model, probe, payload, tolerance=tolerance,
+                source=f"fake-engine:{self.model}", created=time.time())
+            records.append(rec.to_dict())
+        return web.json_response({"model": self.model, "records": records,
+                                  "errors": []})
 
     async def load_lora(self, request):
         body = await request.json()
@@ -467,10 +564,15 @@ class FakeEngine:
         self.running += 1
         self.total_requests += 1
         self.tenants_seen.append(request.headers.get("x-tenant-id") or "")
+        # completions logprobs (the canary probes pin logprobs=top_k):
+        # an int count, OpenAI-style; chat and streaming skip them
+        lp_raw = body.get("logprobs")
+        lp_n = (int(lp_raw) if not chat and lp_raw not in (None, False)
+                else None)
         try:
             await asyncio.sleep(self.ttft)
             first = self._resume_index(body, chat)
-            words = [f"tok{i} " for i in range(first, first + n)]
+            words = self._generated_words(first, n)
             usage = {"prompt_tokens": 8, "completion_tokens": n,
                      "total_tokens": 8 + n}
             if not stream:
@@ -481,7 +583,8 @@ class FakeEngine:
                      "finish_reason": "length"}
                     if chat else
                     {"index": 0, "text": text, "finish_reason": "length",
-                     "logprobs": None}
+                     "logprobs": (self._completion_logprobs(words, first, lp_n)
+                                  if lp_n is not None else None)}
                 )
                 payload = {"id": rid, "object": "chat.completion" if chat
                            else "text_completion", "created": created,

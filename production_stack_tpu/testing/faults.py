@@ -33,6 +33,19 @@ the flag wins when both are set):
                wedged XLA dispatch — the pod still answers /health 200
                while every request stalls. Drives the stuck-step watchdog
                and outlier-ejection paths without a real stuck TPU step
+  logit_noise_scale      NUMERIC fault: perturb every reported logprob by
+               a deterministic pseudo-noise of this magnitude while
+               leaving the generated tokens alone — a silent numeric
+               drift (wrong fusion, sharding fallback) that every
+               availability gauge misses. Drives the correctness
+               canary's fingerprint (L-infinity) detection. Applied by
+               the fake engine's response builder, not this middleware:
+               the fault lives in the payload, not the transport
+  wrong_token_at_step    NUMERIC fault: swap the generated token at this
+               0-based step for a different one — a greedy-identity
+               break (the canary's ``kind="token"`` failure). -1 (the
+               default) disables it; applied by the fake engine's
+               response builder like logit_noise_scale
   seed         deterministic PRNG seed (omit for nondeterministic)
 
 error_rate + drop_rate must not exceed 1 (they partition one roll);
@@ -62,6 +75,8 @@ class FaultSpec:
     stream_abort_rate: float = 0.0
     stream_abort_after_ms: float = 50.0
     hang_after_ms: float = 0.0
+    logit_noise_scale: float = 0.0
+    wrong_token_at_step: int = -1
     seed: Optional[int] = None
 
     @classmethod
@@ -76,9 +91,12 @@ class FaultSpec:
             if key not in ("error_rate", "latency_ms", "drop_rate",
                            "stall_ms", "stream_abort_rate",
                            "stream_abort_after_ms", "hang_after_ms",
+                           "logit_noise_scale", "wrong_token_at_step",
                            "seed"):
                 raise ValueError(f"unknown fault key {key!r}")
-            kwargs[key] = int(value) if key == "seed" else float(value)
+            kwargs[key] = (int(value)
+                           if key in ("seed", "wrong_token_at_step")
+                           else float(value))
         spec_obj = cls(**kwargs)
         if not 0 <= spec_obj.error_rate <= 1 or not 0 <= spec_obj.drop_rate <= 1:
             raise ValueError("rates must be in [0, 1]")
@@ -92,13 +110,19 @@ class FaultSpec:
                 or spec_obj.hang_after_ms < 0:
             raise ValueError("latency_ms/stall_ms/stream_abort_after_ms/"
                              "hang_after_ms must be >= 0")
+        if spec_obj.logit_noise_scale < 0:
+            raise ValueError("logit_noise_scale must be >= 0")
+        if spec_obj.wrong_token_at_step < -1:
+            raise ValueError("wrong_token_at_step must be >= 0, or -1 "
+                             "to disable")
         return spec_obj
 
     @property
     def active(self) -> bool:
         return bool(self.error_rate or self.latency_ms or self.drop_rate
                     or self.stall_ms or self.stream_abort_rate
-                    or self.hang_after_ms)
+                    or self.hang_after_ms or self.logit_noise_scale
+                    or self.wrong_token_at_step >= 0)
 
 
 class FaultState:

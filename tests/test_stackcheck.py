@@ -507,6 +507,53 @@ def test_overload_surface_is_inside_the_gates():
         assert "vllm:brownout_stage" in text
 
 
+def test_canary_surface_is_inside_the_gates():
+    """The correctness-canary surface (PR: router prober + golden store
+    + drift alerts) is covered by the gates, not grandfathered:
+    config-drift sees the --canary-* flags as declared router CLI flags
+    (so the helm canary template block stays honest), metric-hygiene
+    tracks the four vllm:canary_* families as defined in code AND
+    documented, the chart's routerSpec.canary block is consumed by the
+    router template with values-ci exercising the prober on CPU, and
+    both alert-rule copies carry the identity-failure page + probe
+    stall warning."""
+    from tools.stackcheck.passes import config_drift, metric_hygiene
+
+    ctx = core.Context(REPO)
+    router_flags = config_drift._parser_flags(
+        ctx, REPO / "production_stack_tpu" / "router" / "app.py")
+    assert {"--canary", "--canary-interval", "--canary-golden-path",
+            "--canary-timeout", "--canary-target"} <= router_flags
+
+    # exposition adds _total to the counters; the gate pins base names
+    canary = {"vllm:canary_probes", "vllm:canary_ttft_seconds",
+              "vllm:canary_logit_error", "vllm:canary_identity_failures"}
+    defined = metric_hygiene.code_metrics(ctx)
+    assert canary <= defined
+    documented = metric_hygiene.doc_refs(ctx)
+    assert canary <= documented
+
+    values = (REPO / "helm" / "values.yaml").read_text()
+    assert "canary:" in values and "goldenPath:" in values
+    values_ci = (REPO / "helm" / "values-ci.yaml").read_text()
+    assert "canary:" in values_ci
+    router_tmpl = (REPO / "helm" / "templates"
+                   / "deployment-router.yaml").read_text()
+    assert ("--canary" in router_tmpl
+            and "--canary-golden-path" in router_tmpl
+            and "routerSpec.canary" in router_tmpl)
+
+    # the drift page + stall warning ride the canary families in both
+    # rule copies (repo-root reference + chart-shipped)
+    for rules in (REPO / "observability" / "alert-rules.yaml",
+                  REPO / "helm" / "rules" / "alert-rules.yaml"):
+        text = rules.read_text()
+        assert "CanaryIdentityFailure" in text
+        assert "CanaryProbeStall" in text
+        assert "vllm:canary_identity_failures_total" in text
+        assert "vllm:canary_probes_total" in text
+
+
 def test_repo_has_no_active_findings():
     report = core.run_passes(
         REPO, baseline_path=REPO / core.BASELINE_DEFAULT)

@@ -1,0 +1,159 @@
+"""canaryctl — golden-record lifecycle for the correctness canary plane.
+
+The router's prober (production_stack_tpu/router/canary.py) checks every
+probe response against a versioned golden store; this tool is how that
+store gets made and audited. Pure stdlib so it runs from any operator
+box with nothing installed::
+
+    # capture goldens from a TRUSTED engine into the store the router
+    # loads at startup (--canary-golden-path)
+    python -m tools.canaryctl record --engine http://engine:8000 \\
+        --out golden.json
+
+    # what changed between two captures (a new checkpoint, a new
+    # compiler release) before blessing the new store
+    python -m tools.canaryctl diff golden.json golden-new.json
+
+    # live fleet drift: the router's /debug/canary verdict table
+    python -m tools.canaryctl drift --router http://router:8001
+
+``record`` talks to the engine tier's ``GET /debug/canary`` (both the
+real server and the fake expose it), which runs the pinned probe set
+through the engine's own sampling path and answers golden-record
+documents. ``--tolerance`` stamps a per-record L-infinity band for
+quantized fleets; bf16 fleets keep the 0.0 default (bit-exact).
+Re-recording into an existing store bumps each changed record's version
+and keeps unchanged ones, so "new golden" is visible in every surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+from production_stack_tpu.canary_golden import (
+    GoldenRecord,
+    GoldenStore,
+    diff_records,
+)
+
+
+def _get_json(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def cmd_record(args) -> int:
+    url = args.engine.rstrip("/") + "/debug/canary"
+    if args.tolerance:
+        url += f"?tolerance={args.tolerance}"
+    doc = _get_json(url, timeout=args.timeout)
+    for err in doc.get("errors") or []:
+        print(f"canaryctl: engine probe {err.get('probe')} failed: "
+              f"{err.get('error')}", file=sys.stderr)
+    raws = doc.get("records") or []
+    if not raws:
+        print("canaryctl: engine returned no golden records", file=sys.stderr)
+        return 1
+    store = GoldenStore.load(args.out)
+    changed = 0
+    for raw in raws:
+        rec = GoldenRecord.from_dict(raw)
+        prev = store.lookup(rec.model, rec.probe)
+        stored = store.put(rec)
+        if prev is None or stored.version != prev.version:
+            changed += 1
+        print(f"  {stored.model}/{stored.probe}: v{stored.version}"
+              f" ({len(stored.tokens)} tokens, tol {stored.tolerance:g})"
+              + ("" if prev is None or stored.version != prev.version
+                 else " [unchanged]"))
+    store.save(args.out)
+    print(f"canaryctl: wrote {len(raws)} record(s) "
+          f"({changed} new/changed) to {args.out}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    a, b = GoldenStore.load(args.store_a), GoldenStore.load(args.store_b)
+    keys = sorted(set(a.records) | set(b.records))
+    if not keys:
+        print("canaryctl: both stores are empty", file=sys.stderr)
+        return 1
+    drifted = 0
+    for key in keys:
+        ra, rb = a.records.get(key), b.records.get(key)
+        if ra is None or rb is None:
+            side = args.store_a if ra is None else args.store_b
+            print(f"  {key[0]}/{key[1]}: only missing from {side}")
+            drifted += 1
+            continue
+        d = diff_records(ra, rb)
+        if d["tokens_identical"] and d["within_tolerance"]:
+            print(f"  {key[0]}/{key[1]}: identical "
+                  f"(v{ra.version} -> v{rb.version}, "
+                  f"linf {d['linf'] if d['linf'] is not None else 'inf'})")
+            continue
+        drifted += 1
+        print(f"  {key[0]}/{key[1]}: DRIFT "
+              f"(v{ra.version} -> v{rb.version}) — {d['detail']}")
+    print(f"canaryctl: {drifted} of {len(keys)} record(s) drifted")
+    return 2 if drifted else 0
+
+
+def cmd_drift(args) -> int:
+    doc = _get_json(args.router.rstrip("/") + "/debug/canary",
+                    timeout=args.timeout)
+    if not doc.get("enabled"):
+        print("canaryctl: the router's canary plane is disabled "
+              "(start it with --canary)", file=sys.stderr)
+        return 1
+    from tools.stacktop import render_canary
+
+    print(render_canary({"router": {"canary": doc}}))
+    drifting = [p for p in doc.get("probes") or []
+                if p.get("outcome") == "drift"]
+    return 2 if drifting else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "canaryctl",
+        description="golden-record lifecycle for the correctness canaries")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    rec = sub.add_parser("record",
+                         help="capture goldens from a trusted engine")
+    rec.add_argument("--engine", required=True,
+                     help="trusted engine base URL (GET /debug/canary)")
+    rec.add_argument("--out", required=True,
+                     help="golden store path (merged into if it exists)")
+    rec.add_argument("--tolerance", type=float, default=0.0,
+                     help="per-record L-inf tolerance band (0.0 = "
+                          "bit-exact, the bf16 default)")
+    rec.add_argument("--timeout", type=float, default=60.0)
+    rec.set_defaults(fn=cmd_record)
+
+    dif = sub.add_parser("diff", help="compare two golden stores")
+    dif.add_argument("store_a")
+    dif.add_argument("store_b")
+    dif.set_defaults(fn=cmd_diff)
+
+    dri = sub.add_parser("drift",
+                         help="live fleet drift from the router's prober")
+    dri.add_argument("--router", default="http://localhost:8001",
+                     help="router base URL (GET /debug/canary)")
+    dri.add_argument("--timeout", type=float, default=10.0)
+    dri.set_defaults(fn=cmd_drift)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except OSError as e:
+        print(f"canaryctl: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,7 +28,7 @@ COLUMNS = (
     ("ENGINE", 28), ("MODEL", 14), ("ROLE", 7), ("STATUS", 10), ("CHIPS", 5),
     ("MFU", 6), ("ICI", 6), ("HBM", 12), ("KVFREE", 7), ("HOSTHIT", 7),
     ("WAIT", 5), ("RUN", 5), ("QPS", 6), ("TTFT", 7), ("TENANT", 14),
-    ("INCIDENTS", 14),
+    ("CANARY", 12), ("INCIDENTS", 14),
 )
 
 # --tenants mode: one row per tenant, aggregated across every engine's
@@ -36,6 +36,14 @@ COLUMNS = (
 TENANT_COLUMNS = (
     ("TENANT", 20), ("PREFILL", 10), ("DECODE", 10), ("CHIPSEC", 10),
     ("SHARE", 7), ("KVBLK", 7), ("REQS", 7), ("QUEUE", 8),
+)
+
+# --canary mode: one row per (model, probe) from the router's prober
+# state — golden version, probe age, outcome, logit error
+CANARY_COLUMNS = (
+    ("MODEL", 16), ("PROBE", 14), ("PATH", 8), ("OUTCOME", 10),
+    ("KIND", 12), ("LINF", 10), ("GOLDEN", 7), ("AGE", 7), ("ROUNDS", 7),
+    ("FAILS", 6),
 )
 
 
@@ -82,6 +90,20 @@ def _fmt_top_tenant(row: dict) -> str:
     return f"{name} {rec.get('chip_seconds', 0.0) / total * 100:.0f}%"
 
 
+def _fmt_canary(row: dict) -> str:
+    """Last canary verdict for this engine's model (worst across its
+    models): outcome plus the observed L-infinity logit error; '-' for
+    fleets without the canary plane or before the first probe."""
+    c = row.get("canary") or {}
+    outcome = c.get("outcome")
+    if not outcome:
+        return "-"
+    linf = c.get("linf")
+    if outcome in ("ok", "drift") and linf is not None and linf >= 0:
+        return f"{outcome} {linf:.2g}"
+    return outcome
+
+
 def _clip(s: str, width: int) -> str:
     s = str(s)
     return s if len(s) <= width else s[: width - 1] + "…"
@@ -104,6 +126,7 @@ def engine_row_cells(row: dict) -> list:
         _fmt_num(row.get("qps")),
         _fmt_num(row.get("ttft"), ".3f"),
         _fmt_top_tenant(row),
+        _fmt_canary(row),
         ",".join(row.get("incidents") or []) or "-",
     ]
 
@@ -209,6 +232,54 @@ def render_tenants(snapshot: dict) -> str:
     return "\n".join(lines)
 
 
+def render_canary(snapshot: dict) -> str:
+    """Pure /debug/fleet document → correctness-canary table: one row
+    per (model, probe) from the router's prober state, plus the golden
+    store's per-record versions."""
+    block = (snapshot.get("router") or {}).get("canary") or {}
+    lines = []
+    if not block.get("enabled"):
+        lines.append("(canary plane disabled — start the router with "
+                     "--canary)")
+        return "\n".join(lines)
+    header = "  ".join(name.ljust(width) for name, width in CANARY_COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    probes = block.get("probes") or []
+    for st in probes:
+        linf = st.get("linf")
+        cells = [
+            st.get("model", "-"),
+            st.get("probe", "-"),
+            st.get("role_path", "-"),
+            st.get("outcome") or "(pending)",
+            st.get("kind") or "-",
+            ("-" if linf is None or linf < 0 else f"{linf:.3g}"),
+            f"v{st.get('golden_version', 0)}",
+            _fmt_num(st.get("age"), ".1f"),
+            _fmt_num(st.get("rounds"), "d"),
+            _fmt_num(st.get("failures"), "d"),
+        ]
+        lines.append("  ".join(
+            _clip(cell, width).ljust(width)
+            for cell, (_, width) in zip(cells, CANARY_COLUMNS)))
+    if not probes:
+        lines.append("(no probes yet — first round pending)")
+    golden = block.get("golden") or {}
+    records = golden.get("records") or []
+    lines.append("")
+    lines.append(
+        f"golden store: {len(records)} record(s)"
+        + (f" @ {golden.get('path')}" if golden.get("path") else
+           " (empty — seed with tools/canaryctl.py record)"))
+    age = block.get("last_round_age")
+    if age is not None and age >= 0:
+        lines.append(f"last round: {age:.1f}s ago "
+                     f"(interval {block.get('interval')}s, "
+                     f"rounds {block.get('rounds')})")
+    return "\n".join(lines)
+
+
 def fetch_fleet(router: str, timeout: float = 10.0) -> dict:
     url = router.rstrip("/") + "/debug/fleet"
     with urllib.request.urlopen(url, timeout=timeout) as resp:
@@ -228,6 +299,10 @@ def main(argv=None) -> int:
                    help="per-tenant attribution table (tokens, "
                         "chip-seconds, fairness share) instead of the "
                         "engine table")
+    p.add_argument("--canary", action="store_true",
+                   help="correctness-canary table (per-model golden "
+                        "version, probe age, drift verdicts) instead "
+                        "of the engine table")
     args = p.parse_args(argv)
 
     while True:
@@ -246,6 +321,7 @@ def main(argv=None) -> int:
             stamp = time.strftime("%H:%M:%S", time.localtime(
                 snap.get("ts", time.time())))
             table = (render_tenants(snap) if args.tenants
+                     else render_canary(snap) if args.canary
                      else render_table(snap))
             out = f"stacktop @ {stamp}  ({args.router})\n" + table
         if args.watch:
