@@ -90,7 +90,8 @@ class AsyncEngine:
                 try:
                     self.step_observer(time.monotonic() - t_step)
                 except Exception:
-                    pass
+                    logging.getLogger(__name__).debug(
+                        "step_observer hook failed", exc_info=True)
             if outputs and self.loop is not None:
                 self.loop.call_soon_threadsafe(self._deliver, outputs)
 

@@ -34,6 +34,7 @@ Serving-path guarantees, by construction:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import tarfile
@@ -44,6 +45,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 _EVENT_TAIL = 64  # anomaly events kept for the /debug/diagnostics index
+
+_log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -152,6 +155,8 @@ class DiagnosticsManager:
                     capture_seconds=m.get("capture_seconds", 0.0),
                     detail=m.get("detail", {})))
             except Exception:
+                _log.debug("skipping unreadable bundle manifest under %s",
+                           path, exc_info=True)
                 continue
 
     # -- event log (no capture) ----------------------------------------------
@@ -270,7 +275,8 @@ class DiagnosticsManager:
             try:
                 self.on_bundle(bundle)
             except Exception:
-                pass
+                _log.debug("on_bundle hook failed for %s", bundle.id,
+                           exc_info=True)
 
     @staticmethod
     def _write(path: str, name: str, value: Any) -> None:

@@ -76,6 +76,10 @@ def init_kv_cache(
         return jnp.zeros(shape, dt)
 
     with set_mesh(mesh):
+        # stackcheck: disable=jit-cache-hygiene — one-shot pool
+        # allocation at engine startup: the wrapper exists only to apply
+        # out_shardings and is called exactly once, so there is no trace
+        # cache to lose
         return jax.jit(_zeros, out_shardings=sharding)()
 
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -36,6 +37,16 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from production_stack_tpu.engine.kv_cache import _HASH_SEED, _chain_hash
+
+_log = logging.getLogger(__name__)
+
+
+def _observe_put(fut) -> None:
+    """Done-callback for fire-and-forget put futures: a dropped future
+    swallows worker raises silently; this logs them instead."""
+    exc = fut.exception()
+    if exc is not None:
+        _log.debug("fire-and-forget put worker raised", exc_info=exc)
 
 
 def chain_hashes(tokens: Sequence[int], block_size: int) -> list[int]:
@@ -67,14 +78,17 @@ class HostKVStore:
             capacity_bytes if capacity_bytes > 0
             else capacity_blocks * bytes_per_block
         )  # 0 → fixed by the first slab's nbytes
-        self.used_bytes = 0
+        self.used_bytes = 0  # guarded-by: _lock
         self.store: "collections.OrderedDict[int, np.ndarray]" = (
             collections.OrderedDict()
-        )  # chain_hash -> (L, bs, 2KH, D) slab
-        self.stores = 0
-        self.hits = 0
-        self.queries = 0
-        self.evictions = 0
+        )  # chain_hash -> (L, bs, 2KH, D) slab; guarded-by: _lock
+        self.stores = 0  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.queries = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        # demotions is deliberately NOT lock-guarded: it is bumped in
+        # put()'s finally block after the lock is released (the demote
+        # hook must run outside the lock) — a benign stats race
         self.demotions = 0
         # fired with (chain_hash, slab) when an entry LRU-evicts — the
         # engine points this at the remote tier's fire-and-forget put
@@ -93,6 +107,8 @@ class HostKVStore:
         with self._lock:
             return chain_hash in self.store
 
+    # stackcheck: holds-lock=_lock — only called from put(), inside its
+    # with-lock block (the RLock makes the nesting explicit and cheap)
     def _evict_for(self, nbytes: int) -> list[tuple[int, np.ndarray]]:
         """Pop LRU entries until ``nbytes`` fits; returns the demoted
         entries so the hook can run OUTSIDE the lock."""
@@ -201,7 +217,7 @@ class RemoteKVClient:
         self._io = concurrent.futures.ThreadPoolExecutor(
             max_workers=io_threads, thread_name_prefix="remote-kv")
         self._local = threading.local()  # one Session per IO thread
-        self._pending_puts = 0
+        self._pending_puts = 0  # guarded-by: _pending_lock
         self._pending_lock = threading.Lock()
 
     def _session(self):
@@ -222,7 +238,10 @@ class RemoteKVClient:
                 headers={"X-KV-Meta": meta}, timeout=10,
             )
         except Exception:
-            pass  # warm tier is best-effort
+            # warm tier is best-effort: a failed put costs a future
+            # recompute, not correctness — but leave a trace for debugging
+            _log.debug("remote put %s failed (best-effort)", key,
+                       exc_info=True)
         finally:
             with self._pending_lock:
                 self._pending_puts -= 1
@@ -236,11 +255,16 @@ class RemoteKVClient:
             self._pending_puts += 1
         meta = json.dumps({"shape": list(slab.shape), "dtype": str(slab.dtype)})
         try:
-            self._io.submit(self._put_one, str(chain_hash), slab.tobytes(),
-                            meta)
+            fut = self._io.submit(self._put_one, str(chain_hash),
+                                  slab.tobytes(), meta)
         except RuntimeError:  # executor shut down (interpreter teardown)
             with self._pending_lock:
                 self._pending_puts -= 1
+        else:
+            # _put_one catches everything itself; the observer is the
+            # backstop for raises outside its try (argument marshalling,
+            # teardown races) that a dropped future would swallow
+            fut.add_done_callback(_observe_put)
 
     # -- gets: pipelined fetch with a batch deadline ----------------------
     def _fetch_one(self, chain_hash: int) -> Optional[np.ndarray]:

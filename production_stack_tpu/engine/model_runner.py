@@ -337,6 +337,10 @@ class ModelRunner:
             P(None),  # q_starts / unused
         )
         out_specs = (q_spec, P(None, None, None, AXIS_TENSOR, None))
+        # stackcheck: disable=jit-cache-hygiene — _sharded is only ever
+        # called at TRACE time inside the jitted step programs (prefill/
+        # decode), so the shard_map it builds is baked into the caller's
+        # cached trace; no per-dispatch reconstruction happens
         return shard_map(
             inner, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,

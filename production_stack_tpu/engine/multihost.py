@@ -210,13 +210,13 @@ class LeaderBroadcaster:
         self.server = socket.create_server((bind, port), backlog=16)
         self.server.settimeout(accept_timeout)
         # (socket, per-session frame-MAC key) — see _session_key
-        self.conns: list[tuple[socket.socket, bytes]] = []
+        self.conns: list[tuple[socket.socket, bytes]] = []  # guarded-by: lock
         # stackcheck: disable=lock-across-await — threading.Lock (not
         # asyncio) is correct here: broadcast() runs on the engine's sync
         # worker thread (no event loop), and the critical section is pure
         # socket sendall + counter bump with no await reachable while held
         self.lock = threading.Lock()
-        self.seq = 0
+        self.seq = 0  # guarded-by: lock
 
     def wait_for_followers(self) -> None:
         while len(self.conns) < self.num_followers:
@@ -260,7 +260,11 @@ class LeaderBroadcaster:
             conn.settimeout(None)
             logger.info("follower connected from %s (%d/%d)", addr,
                         len(self.conns) + 1, self.num_followers)
-            self.conns.append((conn, key))
+            # under the lock: broadcast() iterates conns under it from
+            # the worker thread, and a list.append racing that iteration
+            # is exactly the torn read the guarded-by annotation forbids
+            with self.lock:
+                self.conns.append((conn, key))
 
     def broadcast(self, method: str, args: tuple, kwargs: dict) -> None:
         with self.lock:
@@ -273,12 +277,13 @@ class LeaderBroadcaster:
         try:
             self.broadcast("_shutdown", (), {})
         except Exception:
-            pass
+            logger.debug("shutdown broadcast to followers failed",
+                         exc_info=True)
         for conn, _key in self.conns:
             try:
                 conn.close()
             except Exception:
-                pass
+                logger.debug("follower socket close failed", exc_info=True)
         self.server.close()
 
 

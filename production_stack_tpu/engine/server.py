@@ -57,6 +57,16 @@ from production_stack_tpu.engine.sampling import (
 )
 
 
+def _log_bg_task_failure(task: "asyncio.Task") -> None:
+    """Done-callback for fire-and-forget tasks: surface the exception a
+    dropped task would report only at GC time, if ever."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        _log.warning("background task failed", exc_info=exc)
+
+
 def _sampling_from_body(body: dict) -> SamplingParams:
     stop = body.get("stop") or ()
     if isinstance(stop, str):
@@ -242,6 +252,10 @@ class EngineServer:
         # this table until the attach splices them into a sequence (then
         # the scheduler owns them) or the TTL sweep frees them.
         self._kv_transfers: dict = {}
+        # strong refs to fire-and-forget tasks (TTL-sweep block frees):
+        # the loop holds tasks weakly, so an unreferenced task can be
+        # GC-cancelled mid-flight and its exception silently dropped
+        self._bg_tasks: set = set()
         # Floor for the Retry-After seconds advertised on overload 429s;
         # the actual value is derived from the admission queue's depth and
         # recent drain rate (scheduler.retry_after_hint), so a deep queue
@@ -1485,9 +1499,12 @@ class EngineServer:
             blocks = st["blocks"]
             _log.warning("kv transfer %s expired unattached; freeing "
                          "%d blocks", tid, len(blocks))
-            asyncio.ensure_future(self.async_engine.run_on_engine(
+            task = asyncio.ensure_future(self.async_engine.run_on_engine(
                 lambda eng, b=blocks: eng.scheduler.allocator.free_blocks(b)
             ))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
+            task.add_done_callback(_log_bg_task_failure)
 
     async def kv_recv(self, request: web.Request) -> web.Response:
         """Receiver for a PUSHED prefill→decode transfer (the body is the
@@ -1746,7 +1763,8 @@ class EngineServer:
                 try:
                     jax.profiler.stop_trace()
                 except Exception:
-                    pass
+                    _log.debug("profiler stop_trace cleanup failed",
+                               exc_info=True)
             self._profiling = False
             shutil.rmtree(tmp, ignore_errors=True)
 

@@ -7,6 +7,7 @@ bos/eos/pad. Any model config with vocab_size >= 259 can serve under it.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Protocol, Sequence
 
 
@@ -68,5 +69,8 @@ def get_tokenizer(path: Optional[str]) -> Tokenizer:
         try:
             return HFTokenizer(path)
         except Exception:
-            pass
+            logging.getLogger(__name__).warning(
+                "failed to load HF tokenizer from %r; falling back to "
+                "the byte tokenizer (served text will be raw bytes)",
+                path, exc_info=True)
     return ByteTokenizer()

@@ -97,6 +97,9 @@ def init_random(cfg: ModelConfig, mesh: Mesh, rules: ShardingRules, seed: int) -
         specs,
         is_leaf=lambda x: isinstance(x, tuple),
     )
+    # stackcheck: disable=jit-cache-hygiene — one-shot weight init at
+    # model load: jit here exists to materialise params directly into
+    # their shardings (no host round-trip), and runs once per process
     init_fn = jax.jit(model.init_params, static_argnums=0, out_shardings=out_shardings)
     return init_fn(cfg, jax.random.PRNGKey(seed))
 

@@ -26,7 +26,7 @@ DEFAULT_SIZE = 256
 class FlightRecorder:
     def __init__(self, size: int = DEFAULT_SIZE):
         self.size = max(1, int(size))
-        self._ring: deque = deque(maxlen=self.size)
+        self._ring: deque = deque(maxlen=self.size)  # guarded-by: _lock
         # begin()/finish() may be reached from the engine worker thread via
         # callbacks as well as the event loop; a lock keeps append/snapshot
         # consistent either way. threading.Lock (not asyncio.Lock) is
@@ -35,8 +35,8 @@ class FlightRecorder:
         # this lock is synchronous (deque append/list/clear); no await is
         # ever reached while it is held, from either calling context
         self._lock = threading.Lock()
-        self._dropped = 0
-        self._total = 0
+        self._dropped = 0  # guarded-by: _lock
+        self._total = 0  # guarded-by: _lock
 
     def begin(self, **fields: Any) -> Dict[str, Any]:
         """Open a record. Not yet visible in snapshot()."""

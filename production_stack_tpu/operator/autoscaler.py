@@ -372,7 +372,8 @@ class K8sFleetActuator(FleetActuator):
             if wait_m:
                 info.waiting = float(wait_m.group(1))
         except Exception:
-            pass
+            logger.debug("metrics scrape parse failed for %s",
+                         info.url, exc_info=True)
 
     async def drain(self, replica: ReplicaInfo) -> bool:
         if not replica.url:

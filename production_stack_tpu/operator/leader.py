@@ -114,7 +114,8 @@ class LeaderElector:
                                 self.identity)
                     return
                 except Exception:
-                    pass  # raced another replica; re-read
+                    # raced another replica; re-read on the next cycle
+                    logger.debug("lease create lost a race", exc_info=True)
             else:
                 holder = lease.get("spec", {}).get("holderIdentity")
                 if holder == self.identity or self._expired(lease):
@@ -129,7 +130,9 @@ class LeaderElector:
                         )
                         return
                     except Exception:
-                        pass  # conflict; retry
+                        # conflict; retry on the next cycle
+                        logger.debug("lease replace conflicted",
+                                     exc_info=True)
             await asyncio.sleep(self.lease_seconds / 3)
 
     async def renew_loop(self) -> None:

@@ -98,6 +98,10 @@ def pipelined_forward(
         return outputs
 
     stage_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    # stackcheck: disable=jit-cache-hygiene — pipelined_forward is only
+    # called at trace time under the caller's jit (pp_runner compiles it
+    # into per-stage step programs), so this shard_map is constructed
+    # once per enclosing trace, not per dispatch
     fn = shard_map(
         per_stage,
         mesh=mesh,

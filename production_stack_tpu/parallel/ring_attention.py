@@ -98,6 +98,9 @@ def ring_causal_attention(
     the head dim (tensor parallelism composes: heads are independent, so the
     ring only ever talks over ``axis_name``)."""
     spec = P(None, axis_name, head_axis, None)
+    # stackcheck: disable=jit-cache-hygiene — ring_causal_attention runs
+    # at trace time inside a jitted model forward; the shard_map is part
+    # of the enclosing trace and is never rebuilt per dispatch
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           soft_cap=soft_cap),

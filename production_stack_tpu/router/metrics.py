@@ -467,4 +467,8 @@ def refresh_self_metrics() -> None:
         router_mem_percent.set(psutil.virtual_memory().percent)
         router_disk_percent.set(psutil.disk_usage("/").percent)
     except Exception:
-        pass
+        # psutil is optional; the gauges just stay at their defaults
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "self-metrics refresh failed (psutil missing?)", exc_info=True)

@@ -46,6 +46,10 @@ class NativeHashTrie:
     def __del__(self):
         try:
             self._lib.ht_destroy(self._handle)
+        # stackcheck: disable=task-lifetime — __del__ can run during
+        # interpreter shutdown when the logging module (or _lib itself)
+        # is already torn down; logging here can raise and mask the
+        # original teardown path. Silent is the safe option.
         except Exception:
             pass
 

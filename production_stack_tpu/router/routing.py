@@ -283,7 +283,9 @@ class KvAwareRouter(Router):
                         data.get("total_tokens", 0)
                     )
         except Exception:
-            pass
+            # a failed probe scores as a zero-token match, not an error
+            logger.debug("prefix-cache probe to %s failed", url,
+                         exc_info=True)
         return url, 0, 0
 
     async def route_request(self, endpoints, engine_stats, request_stats,

@@ -246,7 +246,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
         except Exception:
             # unreachable: the /v1/models probe below decides health;
             # a definitive draining verdict needs an actual 503
-            pass
+            logger.debug("readiness probe inconclusive", exc_info=True)
 
     async def _probe(self, session: aiohttp.ClientSession, url: str) -> None:
         await self._probe_readiness(session, url)
@@ -494,7 +494,9 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 if resp.status == 200:
                     return bool((await resp.json()).get("is_sleeping"))
         except Exception:
-            pass
+            # an unreachable replica is treated as awake, not asleep
+            logger.debug("sleep-state probe to %s failed", url,
+                         exc_info=True)
         return False
 
 

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Pre-commit hook wrapper for the stackcheck suite: analyse only files
+# touched vs a ref (default HEAD), so the gate stays fast enough to run
+# on every commit. Install with:
+#
+#   ln -s ../../scripts/precommit-stackcheck.sh .git/hooks/pre-commit
+#
+# or call it from an existing hook. CI runs the full suite via
+# tests/test_stackcheck.py (tier-1); this wrapper is the fast local gate.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+exec python -m tools.stackcheck --changed "${1:-HEAD}"

@@ -1,0 +1,54 @@
+"""http-surface-drift fixture server: the registered route table.
+
+Routes here are the source of truth the pass checks docs, tool clients
+and helm probes against:
+
+* ``/debug/fixture_dash`` — registered AND documented (clean both ways)
+* ``/debug/fixture_undocumented`` — registered, missing from docs
+  (POSITIVE: reverse drift)
+* ``/debug/fixture_bundles/{bundle_id}`` — templated: exempt from the
+  reverse check, wildcard-matched by doc references
+* ``FIXTURE_POST_PATHS`` — registered through a module-constant loop
+  (the router/app.py PROXY_POST_PATHS idiom)
+* ``/health`` / ``/ready`` / ``/drain`` — the helm probe surface
+"""
+
+from aiohttp import web
+
+FIXTURE_POST_PATHS = ("/v1/fixture_echo", "/v1/fixture_stream")
+
+
+class FixtureHTTPServer:
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/ready", self.ready)
+        app.router.add_post("/drain", self.drain)
+        app.router.add_get("/debug/fixture_dash", self.dash)
+        app.router.add_get("/debug/fixture_undocumented", self.undoc)
+        app.router.add_get("/debug/fixture_bundles/{bundle_id}",
+                           self.bundle)
+        for p in FIXTURE_POST_PATHS:
+            app.router.add_post(p, self.echo)
+        return app
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def ready(self, request: web.Request) -> web.Response:
+        return web.json_response({"ready": True})
+
+    async def drain(self, request: web.Request) -> web.Response:
+        return web.json_response({"draining": True})
+
+    async def dash(self, request: web.Request) -> web.Response:
+        return web.json_response({"dash": True})
+
+    async def undoc(self, request: web.Request) -> web.Response:
+        return web.json_response({"undocumented": True})
+
+    async def bundle(self, request: web.Request) -> web.Response:
+        return web.json_response({"id": request.match_info["bundle_id"]})
+
+    async def echo(self, request: web.Request) -> web.Response:
+        return web.json_response(await request.json())

@@ -1,0 +1,114 @@
+"""jit-cache-hygiene fixture: positives + negatives for all four rules.
+
+POSITIVE: export_fresh (the PR 13 fresh-wrapper-per-call reproduction),
+call_unhashable_static, call_shape_static, branch_dynamic_slice,
+nested_decorated, plus one suppressed fresh wrapper.
+NEGATIVE: module-level wrapper, __init__ construction, cached_property,
+the self-cached memo (the fixed _io_fns form), self-container append,
+bucketed dispatch.
+"""
+
+import functools
+
+import jax
+
+
+def _gather(kv, i):
+    return kv[i]
+
+
+def _scatter(kv, i, d):
+    return kv.at[i].set(d)
+
+
+def _bucketed(x, n):
+    return x * n
+
+
+# NEGATIVE: module-level construction — one wrapper, one trace cache
+_gather_jit = jax.jit(_gather)
+
+# module-level wrapper with a static arg: the registry entry the
+# call-site rules (unhashable / shape-derived statics) check against
+_bucketed_jit = jax.jit(_bucketed, static_argnums=(1,))
+
+
+class FixtureRunner:
+    def __init__(self):
+        # NEGATIVE: __init__ runs once per instance — this IS the cache
+        self._init_fn = jax.jit(_scatter, donate_argnums=(0,))
+        self._steps = []
+
+    @functools.cached_property
+    def _encode(self):
+        # NEGATIVE: cached_property memoises the wrapper on first access
+        return jax.jit(_gather)
+
+    def _io_fns(self):
+        # NEGATIVE: the fixed model_runner._io_fns form — wrapper pair
+        # built once, cached on self through a chained assignment
+        cache = getattr(self, "_io_fn_cache", None)
+        if cache is None:
+            cache = self._io_fn_cache = (
+                jax.jit(_gather),
+                jax.jit(_scatter, donate_argnums=(0,)),
+            )
+        return cache
+
+    def _range_fns(self, n):
+        # NEGATIVE: memo-dict form — subscript store on a self-bound local
+        cache = getattr(self, "_range_fn_cache", None)
+        if cache is None:
+            cache = self._range_fn_cache = {}
+        if n not in cache:
+            cache[n] = jax.jit(_gather)
+        return cache[n]
+
+    def _compile_steps(self):
+        # NEGATIVE: caching via container mutation on a self attribute
+        self._steps.append(jax.jit(_gather))
+
+    def export_fresh(self, kv, idx):
+        # POSITIVE: the PR 13 bug — a fresh wrapper per call has an empty
+        # trace cache, so every tier demotion recompiled (~60 ms each)
+        gather = jax.jit(_gather)
+        return gather(kv, idx)
+
+    def nested_decorated(self, kv, idx):
+        # POSITIVE: @jax.jit on a nested def constructs a wrapper every
+        # time the enclosing method runs
+        @jax.jit
+        def _inner(k, i):
+            return k[i]
+
+        return _inner(kv, idx)
+
+    def export_suppressed(self, kv, idx):
+        # stackcheck: disable=jit-cache-hygiene — fixture: one-shot debug
+        # path, the wrapper is deliberately rebuilt per call here
+        gather = jax.jit(_gather)
+        return gather(kv, idx)
+
+
+def call_unhashable_static(x):
+    # POSITIVE: list literal in a static position — jit hashes static
+    # args at dispatch, so this raises (or silently retraces)
+    return _bucketed_jit(x, [4, 8])
+
+
+def call_shape_static(x):
+    # POSITIVE: shape-derived static — one retrace per distinct length
+    return _bucketed_jit(x, x.shape[0])
+
+
+def call_bucketed_ok(x):
+    # NEGATIVE: constant static value — one trace, reused forever
+    return _bucketed_jit(x, 8)
+
+
+def branch_dynamic_slice(x, n):
+    # POSITIVE: shape-dependent branch feeding an unbucketed dynamic
+    # slice into a known wrapper — one compile signature per length
+    if x.shape[0] > 8:
+        return _bucketed_jit(x[:n], 2)
+    return _bucketed_jit(x, 2)

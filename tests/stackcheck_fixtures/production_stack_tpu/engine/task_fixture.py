@@ -1,0 +1,97 @@
+"""task-lifetime fixture: positives + negatives for all three rules.
+
+POSITIVE: bare create_task, never-read ensure_future handle, dropped
+executor future (self attr + local executor), except-pass swallow.
+NEGATIVE: kept-set + discard callback (the incidents.py idiom), awaited
+handle, observed future, logged except, narrow except, plus one
+suppressed swallow.
+"""
+
+import asyncio
+import logging
+from concurrent.futures import ThreadPoolExecutor
+
+logger = logging.getLogger(__name__)
+
+
+async def work():
+    return 1
+
+
+def work_sync():
+    return 1
+
+
+def _observe(fut):
+    if fut.exception() is not None:
+        logger.warning("worker failed", exc_info=fut.exception())
+
+
+class TaskFixture:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(2)
+        self._tasks = set()
+
+    async def bad_spawn(self):
+        # POSITIVE: dropped task — GC can cancel it mid-flight
+        asyncio.create_task(work())
+
+    async def bad_handle(self):
+        # POSITIVE: handle bound but never read — dies at scope exit
+        t = asyncio.ensure_future(work())
+        return None
+
+    def bad_submit(self):
+        # POSITIVE: dropped executor future — a worker raise vanishes
+        self._pool.submit(work_sync)
+
+    def bad_submit_local(self):
+        ex = ThreadPoolExecutor(1)
+        # POSITIVE: future bound to a never-read local
+        f = ex.submit(work_sync)
+        ex.shutdown(wait=False)
+
+    def swallow(self):
+        try:
+            work_sync()
+        except Exception:
+            # POSITIVE: serving-tier swallow with no log and no counter
+            pass
+
+    async def good_spawn(self):
+        # NEGATIVE: kept reference + discard done-callback
+        t = asyncio.create_task(work())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def good_await(self):
+        # NEGATIVE: the handle is awaited
+        t = asyncio.ensure_future(work())
+        return await t
+
+    def good_submit(self):
+        # NEGATIVE: future observed by a done-callback
+        f = self._pool.submit(work_sync)
+        f.add_done_callback(_observe)
+
+    def good_log(self):
+        try:
+            work_sync()
+        except Exception:
+            # NEGATIVE: the failure leaves a log line
+            logger.debug("work failed", exc_info=True)
+
+    def good_narrow(self):
+        try:
+            work_sync()
+        except ValueError:
+            # NEGATIVE: a narrow except is a considered decision
+            pass
+
+    def swallow_suppressed(self):
+        try:
+            work_sync()
+        # stackcheck: disable=task-lifetime — fixture: suppression with a
+        # written rationale silences the swallow
+        except Exception:
+            pass

@@ -1,0 +1,58 @@
+"""lock-discipline fixture: positives + negatives.
+
+POSITIVE: plain assignment, augmented assignment, mutator call and
+subscript store to guarded attributes outside the lock.
+NEGATIVE: the same writes under ``with self._lock``, a nested with, a
+``holds-lock`` annotated helper, ``__init__`` writes, an unannotated
+attribute, plus one suppressed write.
+"""
+
+import threading
+
+
+class GuardedFixture:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._free = 0  # unannotated: the pass must leave this alone
+
+    def good_locked(self):
+        with self._lock:
+            self._items.append(1)
+            self._count += 1
+
+    def good_nested(self):
+        with self._lock:
+            if self._count > 0:
+                self._items.pop()
+
+    def good_unannotated(self):
+        # NEGATIVE: _free carries no guarded-by annotation
+        self._free += 1
+
+    # stackcheck: holds-lock=_lock — fixture: called only from
+    # good_locked-style blocks with the lock already taken
+    def good_held_helper(self):
+        self._count += 1
+
+    def bad_append(self):
+        # POSITIVE: mutator call outside the lock
+        self._items.append(2)
+
+    def bad_assign(self):
+        # POSITIVE: plain assignment outside the lock
+        self._count = 5
+
+    def bad_augassign(self):
+        # POSITIVE: augmented assignment outside the lock
+        self._count += 1
+
+    def bad_subscript(self):
+        # POSITIVE: subscript store through a guarded attribute
+        self._items[0] = 3
+
+    def suppressed_write(self):
+        # stackcheck: disable=lock-discipline — fixture: suppression with
+        # a written rationale silences the unlocked write
+        self._count = 9

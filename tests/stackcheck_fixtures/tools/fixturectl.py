@@ -1,0 +1,12 @@
+"""http-surface-drift fixture CLI: one live client path, one drifted.
+
+`/debug/fixture_dash` is registered by the fixture server (clean);
+`/debug/fixture_missing` is not (POSITIVE: client drift).
+"""
+
+GOOD_PATH = "/debug/fixture_dash"
+DRIFTED_PATH = "/debug/fixture_missing"
+
+
+def urls(base: str) -> list:
+    return [base + GOOD_PATH, base + DRIFTED_PATH]

@@ -1,7 +1,8 @@
-"""Tier-1 guard for tools/stackcheck: the five passes detect their
+"""Tier-1 guard for tools/stackcheck: the nine passes detect their
 fixture positives (and stay silent on the negatives), suppressions and
-the baseline round-trip, the --json shape is stable, and — the gate that
-matters — the real repo runs clean.
+the baseline round-trip, the --json shape is stable, --changed filters
+to git-touched files without unsoundness, and — the gate that matters —
+the real repo runs clean.
 
 The fixture mini-repo lives in tests/stackcheck_fixtures/ (see its
 README); fixture files and the expectations here are updated together.
@@ -30,10 +31,11 @@ def by_file(report, name):
 
 # ---- registry / framework -------------------------------------------------
 
-def test_all_five_passes_registered():
+def test_all_nine_passes_registered():
     assert sorted(core.all_passes()) == [
-        "async-blocking", "config-drift", "jit-purity",
-        "lock-across-await", "metric-hygiene",
+        "async-blocking", "config-drift", "http-surface-drift",
+        "jit-cache-hygiene", "jit-purity", "lock-across-await",
+        "lock-discipline", "metric-hygiene", "task-lifetime",
     ]
 
 
@@ -95,6 +97,134 @@ def test_jit_purity():
     assert sum("in jitted <lambda>" in f.message for f in found) == 1
     assert not any("good_kernel" in f.message for f in found)
     assert not any("host_helper" in f.message for f in found)
+
+
+# ---- jit-cache-hygiene ----------------------------------------------------
+
+def test_jit_cache_hygiene_positives():
+    r = fixture_report(only="jit-cache-hygiene")
+    found = by_file(r, "jit_cache_fixture.py")
+    assert r.findings == found  # nothing elsewhere in the fixtures
+    active = [f for f in found if f in r.active]
+    msgs = "\n".join(f.message for f in active)
+    assert len(active) == 5, msgs
+    # rule A: body-local wrapper construction — the PR 13 repro plus a
+    # nested @jax.jit decoration
+    ctor = [f for f in active if "fresh jax.jit wrapper" in f.message]
+    assert len(ctor) == 2
+    assert "export_fresh()" in msgs and "nested_decorated()" in msgs
+    assert "every call recompiles" in msgs
+    # rule B: unhashable literal at a registered wrapper's static slot
+    assert "unhashable list literal in static arg position 1" in msgs
+    # rule C: shape/len-derived value at a static slot
+    assert "shape/len-derived value in static arg position 1" in msgs
+    # rule D: shape-dependent branch + dynamically-sliced operand
+    assert ("shape-dependent branch feeds jitted _bucketed_jit a "
+            "dynamically-sliced operand" in msgs)
+
+
+def test_jit_cache_hygiene_negatives_and_suppression():
+    r = fixture_report(only="jit-cache-hygiene")
+    msgs = "\n".join(f.message for f in r.active)
+    # every caching idiom stays silent: module-level wrapper, __init__,
+    # cached_property, self-memo (incl. chained assign), memo-dict on a
+    # self-bound local, self-container append, hashable static call
+    for neg in ("in __init__()", "_encode", "_io_fns", "_range_fns",
+                "_compile_steps", "call_bucketed_ok"):
+        assert neg not in msgs, neg
+    sup = [f for f in r.suppressed if f.path.endswith("jit_cache_fixture.py")]
+    assert len(sup) == 1
+    assert "export_suppressed()" in sup[0].message
+
+
+def test_jit_cache_hygiene_model_runner_memo_is_clean():
+    """Acceptance pin: the real repo's model_runner._io_fns self-memo —
+    the *fix* for the PR 13 fresh-wrapper bug — must not be flagged,
+    while the pass stays active on the repo (zero active findings)."""
+    rep = core.run_passes(REPO, only="jit-cache-hygiene")
+    assert rep.active == [], "\n".join(f.render() for f in rep.active)
+    assert not any("_io_fns" in f.message for f in rep.findings)
+
+
+# ---- task-lifetime --------------------------------------------------------
+
+def test_task_lifetime_positives():
+    r = fixture_report(only="task-lifetime")
+    found = by_file(r, "task_fixture.py")
+    assert r.findings == found
+    active = [f for f in found if f in r.active]
+    msgs = "\n".join(f.message for f in active)
+    assert len(active) == 5, msgs
+    assert "create_task() result dropped" in msgs
+    assert "ensure_future() handle bound to 't' but never read" in msgs
+    assert sum("Executor.submit() future" in f.message
+               for f in active) == 2
+    assert "broad except with empty body in a serving-tier module" in msgs
+
+
+def test_task_lifetime_negatives_and_suppression():
+    r = fixture_report(only="task-lifetime")
+    found = by_file(r, "task_fixture.py")
+    # kept-set spawn, awaited future, observed submit, logging handler
+    # and narrow except all stay silent — only the designed lines fire
+    active_lines = sorted(f.line for f in found if f in r.active)
+    assert len(active_lines) == 5
+    sup = [f for f in found if f in r.suppressed]
+    assert len(sup) == 1
+    assert "broad except" in sup[0].message
+
+
+# ---- lock-discipline ------------------------------------------------------
+
+def test_lock_discipline_positives():
+    r = fixture_report(only="lock-discipline")
+    found = by_file(r, "guarded_fixture.py")
+    assert r.findings == found
+    active = [f for f in found if f in r.active]
+    msgs = "\n".join(f.message for f in active)
+    assert len(active) == 4, msgs
+    assert ".append() write to self._items" in msgs
+    assert sum("write to self._count" in f.message for f in active) == 2
+    assert "bad_subscript" in msgs
+    assert "guarded-by: _lock" in msgs
+
+
+def test_lock_discipline_negatives_and_suppression():
+    r = fixture_report(only="lock-discipline")
+    msgs = "\n".join(f.message for f in r.active)
+    # with-lock writes, nested with, the holds-lock helper, __init__
+    # and the unannotated attribute all stay silent
+    for neg in ("good_locked", "good_nested", "good_held_helper",
+                "good_unannotated", "__init__", "_free"):
+        assert neg not in msgs, neg
+    sup = [f for f in r.suppressed if f.path.endswith("guarded_fixture.py")]
+    assert len(sup) == 1
+    assert "suppressed_write" in sup[0].message
+
+
+# ---- http-surface-drift ---------------------------------------------------
+
+def test_http_surface_drift_both_directions():
+    r = fixture_report(only="http-surface-drift")
+    msgs = "\n".join(f"{f.path}: {f.message}" for f in r.findings)
+    assert len(r.findings) == 4, msgs
+    # direction 1: documented/referenced but never registered
+    assert ("documents endpoint /debug/fixture_ghost but no server "
+            "module registers that route" in msgs)
+    assert "client hits /debug/fixture_missing" in msgs
+    assert "probe path /readyz is not registered" in msgs
+    # direction 2: registered but undocumented
+    assert ("registers /debug/fixture_undocumented but no doc "
+            "mentions it" in msgs)
+    # negatives: the documented route, the loop-constant /v1 routes,
+    # the templated route, the live client path, and the real probe +
+    # preStop paths all stay silent
+    assert "fixture_dash" not in msgs
+    assert "fixture_echo" not in msgs and "fixture_stream" not in msgs
+    assert "fixture_bundles" not in msgs
+    assert "/drain" not in msgs
+    assert "path /health" not in msgs
+    assert "probe path /ready " not in msgs
 
 
 # ---- config-drift ---------------------------------------------------------
@@ -210,6 +340,64 @@ def test_cli_list():
     assert proc.returncode == 0
     for name in core.all_passes():
         assert name in proc.stdout
+    # stable ordering: rows come out in sorted-pass-name order
+    rows = [ln.split()[0] for ln in proc.stdout.splitlines() if ln.strip()]
+    assert rows == sorted(core.all_passes())
+
+
+def test_cli_changed_filters_but_passes_stay_sound(tmp_path):
+    """--changed reports only findings in git-touched files, while the
+    passes still analyse the full tree (so cross-file checks see
+    everything)."""
+    git = ["git", "-C", str(tmp_path)]
+    env_git = git + ["-c", "user.email=s@t", "-c", "user.name=s"]
+    pkg = tmp_path / "production_stack_tpu" / "engine"
+    pkg.mkdir(parents=True)
+    bad = ("import asyncio\n\n\n"
+           "async def spawn():\n"
+           "    asyncio.create_task(spawn())\n")
+    (pkg / "committed.py").write_text(bad)
+    subprocess.run(git + ["init", "-q"], check=True)
+    subprocess.run(git + ["add", "-A"], check=True)
+    subprocess.run(env_git + ["commit", "-qm", "seed"], check=True)
+
+    # clean tree: the committed finding exists but is filtered out
+    proc = _cli("--root", str(tmp_path), "--changed")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 active" in proc.stdout
+
+    # an untracked file with the same bug IS reported; the committed
+    # one stays filtered even though the pass saw it
+    (pkg / "fresh.py").write_text(bad)
+    proc = _cli("--root", str(tmp_path), "--changed")
+    assert proc.returncode == 1
+    assert "fresh.py" in proc.stdout
+    assert "committed.py" not in proc.stdout
+
+    # explicit REF argument: everything since the empty-ish base commit
+    subprocess.run(git + ["add", "-A"], check=True)
+    subprocess.run(env_git + ["commit", "-qm", "more"], check=True)
+    proc = _cli("--root", str(tmp_path), "--changed", "HEAD~1")
+    assert proc.returncode == 1
+    assert "fresh.py" in proc.stdout and "committed.py" not in proc.stdout
+
+
+def test_cli_changed_outside_git_falls_back_to_full_run(tmp_path):
+    pkg = tmp_path / "production_stack_tpu" / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "import asyncio\n\n\n"
+        "async def spawn():\n"
+        "    asyncio.create_task(spawn())\n")
+    env = {"GIT_CEILING_DIRECTORIES": str(tmp_path)}
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.stackcheck", "--root", str(tmp_path),
+         "--changed"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**__import__("os").environ, **env})
+    assert "running on the full tree" in proc.stderr
+    assert proc.returncode == 1
+    assert "mod.py" in proc.stdout
 
 
 # ---- the gate: this repo is clean -----------------------------------------

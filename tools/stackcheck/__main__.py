@@ -5,10 +5,43 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
 from tools.stackcheck import core
+
+
+def _changed_files(root: Path, ref: str):
+    """Repo-relative posix paths touched vs ``ref`` (tracked diffs plus
+    untracked files), or None when git can't answer (not a checkout, bad
+    ref) — callers fall back to a full run."""
+    try:
+        top = subprocess.run(
+            ["git", "-C", str(root), "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True).stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", str(root), "diff", "--name-only", ref],
+            capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "-C", str(root), "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    # git paths are toplevel-relative; re-anchor them on our root (which
+    # may be a subdirectory of the checkout)
+    out = set()
+    root = root.resolve()
+    for name in (diff + untracked).splitlines():
+        if not name:
+            continue
+        p = (Path(top) / name).resolve()
+        try:
+            out.add(p.relative_to(root).as_posix())
+        except ValueError:
+            continue  # outside the analysed root
+    return out
 
 
 def main(argv=None) -> int:
@@ -28,6 +61,11 @@ def main(argv=None) -> int:
                    help="repo root to analyse (default: this checkout)")
     p.add_argument("--list", action="store_true",
                    help="list registered passes and exit")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="report only findings in files touched vs a git "
+                        "ref (default HEAD); passes still see the full "
+                        "tree, so cross-file checks stay sound")
     args = p.parse_args(argv)
 
     if args.list:
@@ -39,10 +77,18 @@ def main(argv=None) -> int:
         Path(__file__).resolve().parent.parent.parent
     baseline = Path(args.baseline) if args.baseline else \
         root / core.BASELINE_DEFAULT
+    changed = None
+    if args.changed is not None:
+        changed = _changed_files(root, args.changed)
+        if changed is None:
+            print(f"stackcheck: cannot resolve --changed "
+                  f"{args.changed!r} via git; running on the full tree",
+                  file=sys.stderr)
     try:
         report = core.run_passes(
             root, only=args.only,
-            baseline_path=baseline if baseline.exists() else None)
+            baseline_path=baseline if baseline.exists() else None,
+            changed=changed)
     except KeyError as e:
         print(f"stackcheck: {e.args[0]}", file=sys.stderr)
         return 2

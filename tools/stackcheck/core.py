@@ -26,7 +26,7 @@ import dataclasses
 import json
 import re
 from pathlib import Path
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Set
 
 BASELINE_DEFAULT = "tools/stackcheck/baseline.json"
 
@@ -220,7 +220,14 @@ class Report:
 
 
 def run_passes(root: Path, only: Optional[str] = None,
-               baseline_path: Optional[Path] = None) -> Report:
+               baseline_path: Optional[Path] = None,
+               changed: Optional[Set[str]] = None) -> Report:
+    """Run passes over ``root``. With ``changed`` (a set of repo-relative
+    posix paths), every pass still analyses the full tree — cross-file
+    checks like config-drift and http-surface-drift need the whole repo
+    to judge any one file — but the report is filtered to findings whose
+    path is in the set. That keeps ``--changed`` fast to read, not
+    unsound."""
     ctx = Context(root)
     passes = all_passes()
     if only is not None:
@@ -231,6 +238,8 @@ def run_passes(root: Path, only: Optional[str] = None,
     findings: List[Finding] = []
     for name in sorted(passes):
         findings.extend(passes[name].run(ctx))
+    if changed is not None:
+        findings = [f for f in findings if f.path in changed]
     findings.sort(key=lambda f: (f.path, f.line, f.pass_name, f.message))
 
     cache: Dict[str, Dict[int, set]] = {}

@@ -6,7 +6,11 @@ through it)."""
 from tools.stackcheck.passes import (  # noqa: F401
     async_blocking,
     config_drift,
+    http_surface_drift,
+    jit_cache_hygiene,
     jit_purity,
     lock_across_await,
+    lock_discipline,
     metric_hygiene,
+    task_lifetime,
 )

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 # the async serving tiers: code here runs on (or next to) an event loop
 ASYNC_TIER_DIRS = (
@@ -49,6 +49,153 @@ def async_functions(tree: ast.AST) -> Iterator[ast.AsyncFunctionDef]:
     for node in ast.walk(tree):
         if isinstance(node, ast.AsyncFunctionDef):
             yield node
+
+
+def statements(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    """Every statement reachable in ``body`` WITHOUT entering nested
+    function/class bodies. Nested defs are yielded as statements (their
+    decorators execute in the enclosing body) but not descended into."""
+    for s in body:
+        yield s
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(s, field, None)
+            if sub:
+                yield from statements(sub)
+        if isinstance(s, ast.Try):
+            for h in s.handlers:
+                yield from statements(h.body)
+
+
+def expr_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Call nodes whose evaluation happens when ``stmt`` executes *as
+    this statement*: its own expressions (a compound statement's header —
+    ``if`` test, ``for`` iter, ``with`` items — but not its body, whose
+    statements ``statements()`` enumerates separately), plus — for a
+    nested def — its decorator list and argument defaults (evaluated at
+    definition time), but never the nested body."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        roots: List[ast.AST] = list(stmt.decorator_list)
+        roots += [d for d in stmt.args.defaults]
+        roots += [d for d in stmt.args.kw_defaults if d is not None]
+    elif isinstance(stmt, ast.ClassDef):
+        roots = list(stmt.decorator_list) + list(stmt.bases)
+    else:
+        roots = [stmt]
+    for root in roots:
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if node is not root:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef, ast.Lambda)):
+                    continue
+                if isinstance(node, (ast.stmt, ast.excepthandler)):
+                    continue  # nested statements are their own events
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def class_methods(tree: ast.AST) -> Iterator[Tuple[ast.ClassDef, ast.AST]]:
+    """(class, method) for every directly-contained def of every class."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield node, item
+
+
+# -- HTTP route tables ------------------------------------------------------
+
+# aiohttp UrlDispatcher registration methods -> index of the path arg
+ROUTE_METHODS = {"add_get": 0, "add_post": 0, "add_put": 0,
+                 "add_delete": 0, "add_patch": 0, "add_head": 0,
+                 "add_route": 1}
+
+
+def _string_seq(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            vals.append(el.value)
+        return vals
+    return None
+
+
+def route_table(tree: ast.AST) -> List[Tuple[str, str, int]]:
+    """(verb, path, lineno) for every aiohttp route registration in the
+    module: ``<x>.router.add_get("/p", h)`` and friends. A path given as
+    a Name resolves through module-level string tuples/lists — both the
+    direct form (``add_post(PATHS, …)``; unusual) and the loop form
+    (``for p in PATHS: app.router.add_post(p, …)``)."""
+    consts: Dict[str, List[str]] = {}
+    if isinstance(tree, ast.Module):
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                vals = _string_seq(node.value)
+                if vals is not None:
+                    consts[node.targets[0].id] = vals
+
+    out: List[Tuple[str, str, int]] = []
+
+    def visit(node: ast.AST, loop_vars: Dict[str, List[str]]) -> None:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            lv = dict(loop_vars)
+            if (isinstance(node.target, ast.Name)
+                    and isinstance(node.iter, ast.Name)
+                    and node.iter.id in consts):
+                lv[node.target.id] = consts[node.iter.id]
+            for child in ast.iter_child_nodes(node):
+                visit(child, lv)
+            return
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ROUTE_METHODS):
+            recv = dotted(node.func.value) or ""
+            if recv == "router" or recv.endswith(".router"):
+                idx = ROUTE_METHODS[node.func.attr]
+                verb = ("*" if node.func.attr == "add_route"
+                        else node.func.attr[4:].upper())
+                if len(node.args) > idx:
+                    arg = node.args[idx]
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)):
+                        out.append((verb, arg.value, node.lineno))
+                    elif isinstance(arg, ast.Name):
+                        for val in loop_vars.get(
+                                arg.id, consts.get(arg.id, [])):
+                            out.append((verb, val, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, loop_vars)
+
+    visit(tree, {})
+    return out
+
+
+def path_matches(path: str, routes) -> bool:
+    """Does ``path`` match any registered route, treating ``{param}``
+    segments (on either side) as single-segment wildcards?"""
+    if path in routes:
+        return True
+    segs = path.strip("/").split("/")
+    for route in routes:
+        rsegs = route.strip("/").split("/")
+        if len(rsegs) != len(segs):
+            continue
+        if all(r == s
+               or (r.startswith("{") and r.endswith("}"))
+               or (s.startswith("{") and s.endswith("}"))
+               for r, s in zip(rsegs, segs)):
+            return True
+    return False
 
 
 def is_lockish(expr: ast.AST) -> bool:

@@ -1,0 +1,197 @@
+"""http-surface-drift: the HTTP surface and its consumers must agree.
+
+config-drift's sibling for the HTTP plane. The source of truth is the
+set of routes *actually registered* on an aiohttp ``app.router`` (the
+route tables in ``engine/server.py``, ``router/app.py``,
+``kv_server.py``, ``whisper_server.py``, extracted by
+``_astutil.route_table``). Four checks against it:
+
+1. *Docs reference real routes.* Every ``/debug/...`` or ``/v1/...``
+   path mentioned in ``docs/**/*.md`` must match a registered route
+   (``{param}`` segments wildcard on either side). A doc that names
+   ``/debug/requets`` teaches operators a 404.
+2. *Debug routes are documented.* Every registered non-templated
+   ``/debug/*`` route must appear in some doc — an undocumented debug
+   surface is one nobody uses during the incident it was built for.
+   (``/v1/*`` is exempt from the reverse check: the OpenAI-compatible
+   surface is documented by reference, not per-route.)
+3. *CLI clients hit real routes.* String literals starting ``/debug/``
+   or ``/v1/`` in ``tools/*.py`` (stacktop, canaryctl, ...) must be
+   registered — a drifted client path fails only at 3am.
+4. *Helm probes hit real routes.* ``httpGet`` probe paths and the
+   preStop ``127.0.0.1:<port>/<path>`` drain hook in
+   ``helm/templates/*.yaml`` are checked against the route table of the
+   container's ``command: [..., "-m", "<module>"]`` module — a probe
+   path the server doesn't register means the kubelet kills healthy
+   pods (or the drain hook 404s and preStop does nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.stackcheck.core import Context, Finding, register
+from tools.stackcheck.passes._astutil import path_matches, route_table
+
+PASS = "http-surface-drift"
+
+# a /debug/... or /v1/... path token in prose/code; trailing sentence
+# punctuation stripped separately
+_PATH_TOKEN = re.compile(r"/(?:debug|v1)(?:/[A-Za-z0-9_{}.*-]+)+")
+_CMD = re.compile(r'command:\s*\[.*?"-m",\s*"([\w.]+)"')
+_IMAGE = re.compile(r"^\s*image:")
+_HTTPGET_INLINE = re.compile(r"httpGet:\s*\{\s*path:\s*([^\s,}]+)")
+_HTTPGET_OPEN = re.compile(r"^\s*httpGet:\s*$")
+_PROBE_PATH = re.compile(r"^\s*path:\s*(\S+)")
+_PRESTOP = re.compile(r"127\.0\.0\.1:[^/']*(/[\w/-]+)'")
+
+
+def _route_tables(ctx: Context) -> Dict[str, List[Tuple[str, str, int]]]:
+    """module dotted name -> route table, for every module under
+    production_stack_tpu/ that registers aiohttp routes."""
+    out: Dict[str, List[Tuple[str, str, int]]] = {}
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        routes = route_table(tree)
+        if routes:
+            module = ctx.rel(path)[:-3].replace("/", ".")
+            out[module] = routes
+    return out
+
+
+def _doc_tokens(ctx: Context) -> List[Tuple[str, int, str]]:
+    """(path-token, lineno, doc-rel-path) for every /debug|/v1 token in
+    the docs tree."""
+    out: List[Tuple[str, int, str]] = []
+    for doc in ctx.glob("docs/**/*.md"):
+        rel = ctx.rel(doc)
+        for lineno, line in enumerate(ctx.read(doc).splitlines(), 1):
+            for m in _PATH_TOKEN.finditer(line):
+                tok = m.group(0).rstrip(".,;:")
+                if tok.endswith("/*") or "*" in tok:
+                    continue  # glob-style prose ("/debug/*") is not a path
+                out.append((tok, lineno, rel))
+    return out
+
+
+def _check_docs(ctx: Context, all_paths: Set[str],
+                tokens: List[Tuple[str, int, str]]) -> List[Finding]:
+    out: List[Finding] = []
+    for tok, lineno, rel in tokens:
+        if not path_matches(tok, all_paths):
+            out.append(Finding(
+                PASS, rel, lineno,
+                f"documents endpoint {tok} but no server module "
+                f"registers that route — fix the doc or register the "
+                f"route"))
+    return out
+
+
+def _check_debug_documented(
+        ctx: Context, tables: Dict[str, List[Tuple[str, str, int]]],
+        tokens: List[Tuple[str, int, str]]) -> List[Finding]:
+    if not ctx.glob("docs/**/*.md"):
+        return []  # mini-repos without a docs tree skip the reverse check
+    doc_paths = {tok for tok, _, _ in tokens}
+    out: List[Finding] = []
+    for module, routes in sorted(tables.items()):
+        rel = module.replace(".", "/") + ".py"
+        for _verb, path, lineno in routes:
+            if not path.startswith("/debug/") or "{" in path:
+                continue
+            if not path_matches(path, doc_paths):
+                out.append(Finding(
+                    PASS, rel, lineno,
+                    f"registers {path} but no doc mentions it — an "
+                    f"undocumented debug surface goes unused during the "
+                    f"incident it was built for; add it to "
+                    f"docs/observability.md"))
+    return out
+
+
+def _check_tools(ctx: Context, all_paths: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    for tool in ctx.glob("tools/*.py"):
+        tree = ctx.parse(tool)
+        if tree is None:
+            continue
+        rel = ctx.rel(tool)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            m = _PATH_TOKEN.fullmatch(node.value)
+            if m is None:
+                continue
+            if not path_matches(node.value, all_paths):
+                out.append(Finding(
+                    PASS, rel, node.lineno,
+                    f"client hits {node.value} but no server module "
+                    f"registers that route — the CLI fails only when "
+                    f"someone finally runs it"))
+    return out
+
+
+def _check_helm(ctx: Context,
+                tables: Dict[str, List[Tuple[str, str, int]]]) -> \
+        List[Finding]:
+    out: List[Finding] = []
+    for tmpl in ctx.glob("helm/templates/*.yaml"):
+        rel = ctx.rel(tmpl)
+        module: Optional[str] = None
+        pending_httpget = 0
+        for lineno, line in enumerate(ctx.read(tmpl).splitlines(), 1):
+            if _IMAGE.match(line):
+                module = None
+            m = _CMD.search(line)
+            if m:
+                module = m.group(1)
+                continue
+            probes: List[Tuple[str, str]] = []
+            im = _HTTPGET_INLINE.search(line)
+            if im:
+                probes.append((im.group(1), "probe"))
+            elif _HTTPGET_OPEN.match(line):
+                pending_httpget = 3  # path: may trail port: by a line
+            elif pending_httpget:
+                pm = _PROBE_PATH.match(line)
+                if pm:
+                    probes.append((pm.group(1), "probe"))
+                    pending_httpget = 0
+                else:
+                    pending_httpget -= 1
+            for pre in _PRESTOP.finditer(line):
+                probes.append((pre.group(1), "preStop hook"))
+            if not probes or module is None:
+                continue
+            routes = tables.get(module)
+            if not routes:
+                continue  # sidecars/non-HTTP modules have no table
+            paths = {p for _v, p, _l in routes}
+            for path, kind in probes:
+                if not path_matches(path, paths):
+                    out.append(Finding(
+                        PASS, rel, lineno,
+                        f"{kind} path {path} is not registered by "
+                        f"{module} — the kubelet would kill healthy "
+                        f"pods (or the drain hook 404s)"))
+    return out
+
+
+@register(PASS, "registered /debug and /v1 route tables vs. docs, CLI "
+                "client paths, and helm probe/preStop paths")
+def run(ctx: Context) -> List[Finding]:
+    tables = _route_tables(ctx)
+    if not tables:
+        return []
+    all_paths: Set[str] = {p for routes in tables.values()
+                           for _v, p, _l in routes}
+    tokens = _doc_tokens(ctx)
+    return (_check_docs(ctx, all_paths, tokens)
+            + _check_debug_documented(ctx, tables, tokens)
+            + _check_tools(ctx, all_paths)
+            + _check_helm(ctx, tables))

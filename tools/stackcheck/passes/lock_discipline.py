@@ -1,0 +1,195 @@
+"""lock-discipline: ``# guarded-by:`` annotations, checked by AST.
+
+The PR 3/9 lock audits left prose notes ("_ring is only touched under
+_lock") in ``flight_recorder.py``/``multihost.py``/``kv_offload.py``.
+This pass turns that prose into a checked invariant:
+
+* Annotate an instance attribute where it is first assigned (normally in
+  ``__init__``)::
+
+      self._ring = []  # guarded-by: _lock
+
+* From then on, every *write* to ``self._ring`` anywhere in the class —
+  assignment, augmented assignment, ``del``, subscript/field stores
+  through it, and mutating method calls (``append``/``pop``/``update``/
+  ``clear``/...) — must happen inside ``with self._lock:`` (checked
+  lexically, nested ``with`` blocks included).
+* A method that is only ever called with the lock already held declares
+  it on its ``def`` line (or the comment block above)::
+
+      def _evict_for(self, n):  # stackcheck: holds-lock=_lock
+
+  which seeds the held-set for that method's whole body.
+
+``__init__``/``__post_init__`` are exempt (no concurrent reader can hold
+``self`` yet). Reads are not checked — this is a write-barrier lint, not
+a race detector; it catches the "append outside the lock while another
+thread iterates under it" class, not stale reads.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.stackcheck.core import Context, Finding, register
+
+PASS = "lock-discipline"
+
+_GUARD = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_HOLDS = re.compile(r"#\s*stackcheck:\s*holds-lock=([A-Za-z_]\w*)")
+
+_EXEMPT_FNS = {"__init__", "__post_init__"}
+# method calls that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "add", "insert", "extend", "update",
+             "remove", "discard", "pop", "popleft", "popitem", "clear",
+             "setdefault"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` at the root of an attribute/subscript chain -> ``X``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.value
+    return None
+
+
+def _guarded_attrs(cls: ast.ClassDef, lines: List[str]) -> Dict[str, str]:
+    """attr name -> lock attr, from ``# guarded-by:`` comments on
+    ``self.X = ...`` lines anywhere in the class."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if node.lineno > len(lines):
+            continue
+        m = _GUARD.search(lines[node.lineno - 1])
+        if not m:
+            continue
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                out[attr] = m.group(1)
+    return out
+
+
+def _held_on_entry(fn: ast.AST, lines: List[str]) -> Set[str]:
+    """Locks a ``holds-lock=`` annotation declares held for this method:
+    on the def line itself or in the comment block directly above it."""
+    held: Set[str] = set()
+    i = fn.lineno
+    if i <= len(lines):
+        m = _HOLDS.search(lines[i - 1])
+        if m:
+            held.add(m.group(1))
+    j = fn.lineno - 1
+    while j >= 1 and lines[j - 1].lstrip().startswith("#"):
+        m = _HOLDS.search(lines[j - 1])
+        if m:
+            held.add(m.group(1))
+        j -= 1
+    return held
+
+
+def _with_locks(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    for item in stmt.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _writes(stmt: ast.stmt, guards: Dict[str, str]) -> List[Tuple[int, str,
+                                                                  str]]:
+    """(lineno, attr, how) for every write this statement makes to a
+    guarded attribute."""
+    out: List[Tuple[int, str, str]] = []
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for t in targets:
+        attr = _self_attr(t)
+        if attr in guards:
+            out.append((t.lineno, attr, "assignment"))
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        func = stmt.value.func
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            attr = _self_attr(func.value)
+            if attr in guards:
+                out.append((stmt.value.lineno, attr, f".{func.attr}()"))
+    return out
+
+
+def _check_method(fn: ast.AST, guards: Dict[str, str],
+                  lines: List[str]) -> List[Tuple[int, str, str, str]]:
+    issues: List[Tuple[int, str, str, str]] = []
+
+    def visit(body: List[ast.stmt], held: Set[str]) -> None:
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                visit(s.body, held | _with_locks(s))
+                continue
+            for lineno, attr, how in _writes(s, guards):
+                lock = guards[attr]
+                if lock not in held:
+                    issues.append((lineno, attr, lock, how))
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if sub:
+                    visit(sub, held)
+            if isinstance(s, ast.Try):
+                for h in s.handlers:
+                    visit(h.body, held)
+
+    visit(fn.body, _held_on_entry(fn, lines))
+    return issues
+
+
+@register(PASS, "writes to '# guarded-by:' annotated attributes must hold "
+                "the declared lock")
+def run(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        lines = ctx.read(path).splitlines()
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guards = _guarded_attrs(cls, lines)
+            if not guards:
+                continue
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name in _EXEMPT_FNS:
+                    continue
+                for lineno, attr, lock, how in _check_method(
+                        item, guards, lines):
+                    out.append(Finding(
+                        PASS, rel, lineno,
+                        f"{how} write to self.{attr} in "
+                        f"{cls.name}.{item.name} outside 'with "
+                        f"self.{lock}' (attribute is guarded-by: {lock})"
+                        f" — take the lock, or annotate the method "
+                        f"holds-lock={lock} if every caller holds it"))
+    return out

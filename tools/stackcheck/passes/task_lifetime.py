@@ -1,0 +1,214 @@
+"""task-lifetime: background work whose failure nobody can observe.
+
+Three rules:
+
+1. *Dropped asyncio tasks* — ``asyncio.create_task(...)`` /
+   ``asyncio.ensure_future(...)`` (any receiver, including
+   ``loop.create_task``) whose handle is discarded: a bare expression
+   statement, or assigned to a local that is never read again. The event
+   loop holds tasks weakly, so a dropped handle can be garbage-collected
+   mid-flight (silent cancellation), and an exception in it is reported
+   only at GC time, if ever. Keep a reference (a set + ``discard``
+   done-callback, as in ``router/incidents.py``) or attach a
+   done-callback that logs.
+2. *Dropped executor futures* — ``<executor>.submit(...)`` on a
+   ``ThreadPoolExecutor``/``ProcessPoolExecutor`` (tracked through
+   ``self.<attr> = ThreadPoolExecutor(...)`` and local bindings) with the
+   future discarded the same way: a raise inside the worker vanishes
+   without ``add_done_callback`` or a kept future.
+3. *Silent swallows in the serving tiers* — ``except Exception: pass``
+   (or bare ``except:``/``except BaseException:``) with an empty body in
+   engine/router/operator modules. A swallow with no log line and no
+   counter turns an outage into a mystery; log at debug minimum, or
+   suppress with a rationale where even logging is unsafe (e.g. inside
+   ``__del__`` at interpreter shutdown).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.stackcheck.core import Context, Finding, register
+from tools.stackcheck.passes._astutil import (
+    ASYNC_TIER_DIRS,
+    call_name,
+    class_methods,
+    statements,
+)
+
+PASS = "task-lifetime"
+
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+
+def _spawn_kind(call: ast.Call) -> Optional[str]:
+    """'create_task'/'ensure_future' if this call spawns an asyncio task."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in _SPAWN_ATTRS:
+        return func.attr
+    if isinstance(func, ast.Name) and func.id in _SPAWN_ATTRS:
+        return func.id
+    return None
+
+
+def _is_executor_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node) or ""
+    return name.rsplit(".", 1)[-1] in _EXECUTOR_CTORS
+
+
+def _executor_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Instance attribute names bound to an Executor anywhere in the
+    class (``self._io = ThreadPoolExecutor(...)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_executor_ctor(node.value):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out.add(t.attr)
+    return out
+
+
+def _loaded_names(fn: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _submit_kind(call: ast.Call, exec_attrs: Set[str],
+                 exec_locals: Set[str]) -> bool:
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "submit"):
+        return False
+    recv = func.value
+    if (isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self" and recv.attr in exec_attrs):
+        return True
+    if isinstance(recv, ast.Name) and recv.id in exec_locals:
+        return True
+    return False
+
+
+def _dropped_handles(fn: ast.AST, exec_attrs: Set[str]) -> List[Finding]:
+    """Findings for task/future handles this function drops. Only a bare
+    expression statement or an assignment to a never-read local counts as
+    dropped — a handle passed onward, awaited, gathered, appended to a
+    container or stored on ``self`` is someone else's responsibility."""
+    issues: List[tuple] = []
+    loaded = _loaded_names(fn)
+    exec_locals: Set[str] = set()
+    for stmt in statements(fn.body):
+        if isinstance(stmt, ast.Assign) and _is_executor_ctor(stmt.value):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    exec_locals.add(t.id)
+        call: Optional[ast.Call] = None
+        target: Optional[str] = None  # local name, or None for bare Expr
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        elif (isinstance(stmt, ast.Assign)
+              and isinstance(stmt.value, ast.Call)
+              and len(stmt.targets) == 1
+              and isinstance(stmt.targets[0], ast.Name)):
+            call = stmt.value
+            target = stmt.targets[0].id
+        if call is None:
+            continue
+        spawn = _spawn_kind(call)
+        if spawn is not None:
+            if target is None:
+                issues.append((call.lineno, (
+                    f"{spawn}() result dropped: the loop holds tasks "
+                    f"weakly, so the task can be GC-cancelled mid-flight "
+                    f"and its exception swallowed — keep a reference "
+                    f"(task set + discard done-callback) or "
+                    f"add_done_callback")))
+            elif target not in loaded:
+                issues.append((call.lineno, (
+                    f"{spawn}() handle bound to {target!r} but never "
+                    f"read: the reference dies at scope exit, same "
+                    f"GC-cancellation hazard as dropping it — keep it "
+                    f"live or add_done_callback")))
+        elif _submit_kind(call, exec_attrs, exec_locals):
+            if target is None:
+                issues.append((call.lineno, (
+                    "Executor.submit() future dropped: a raise in the "
+                    "worker is silently swallowed — add_done_callback "
+                    "an observer or keep/await the future")))
+            elif target not in loaded:
+                issues.append((call.lineno, (
+                    f"Executor.submit() future bound to {target!r} but "
+                    f"never read: worker exceptions are silently "
+                    f"swallowed — observe it with add_done_callback")))
+    return issues
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    typ = handler.type
+    if typ is None:
+        return True
+    types = typ.elts if isinstance(typ, ast.Tuple) else [typ]
+    for t in types:
+        name = ""
+        if isinstance(t, ast.Name):
+            name = t.id
+        elif isinstance(t, ast.Attribute):
+            name = t.attr
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _empty_body(body: List[ast.stmt]) -> bool:
+    for s in body:
+        if isinstance(s, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant)
+                and s.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+def _in_async_tier(rel: str) -> bool:
+    return any(rel == d or rel.startswith(d.rstrip("/") + "/")
+               for d in ASYNC_TIER_DIRS)
+
+
+@register(PASS, "dropped asyncio task / executor-future handles; silent "
+                "except-pass swallows in the serving tiers")
+def run(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in ctx.py_files("production_stack_tpu"):
+        tree = ctx.parse(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+
+        exec_attrs_by_fn = {}
+        for cls, method in class_methods(tree):
+            exec_attrs_by_fn[id(method)] = _executor_attrs(cls)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            attrs = exec_attrs_by_fn.get(id(node), set())
+            for lineno, msg in _dropped_handles(node, attrs):
+                out.append(Finding(PASS, rel, lineno, msg))
+
+        if not _in_async_tier(rel):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ExceptHandler)
+                    and _broad_handler(node) and _empty_body(node.body)):
+                out.append(Finding(
+                    PASS, rel, node.lineno,
+                    "broad except with empty body in a serving-tier "
+                    "module: the failure leaves no log line and no "
+                    "counter — log at debug minimum, or suppress with a "
+                    "rationale if even logging is unsafe here"))
+    return out
