@@ -757,12 +757,27 @@ def run_bench() -> None:
             nn_on_outs[r] == nn_off_outs[r] for r in nn_on_outs),
     }
 
+    # config-cohort stamp for the shared perf ledger (the parent appends
+    # this artifact there): records compare only within a cohort, and
+    # only the child knows the real jax/chip identity
+    from production_stack_tpu import perf_ledger as _pl
+
+    _dev = jax.local_devices()[0]
+    bench_fp = _pl.fingerprint(
+        model=model, role="unified", tensor_parallel=1,
+        attention_impl=cfg.attention_impl, dtype=cfg.model.dtype,
+        quantization=quant or "", speculative=False, n_chips=1,
+        jax_version=str(jax.__version__), platform=str(_dev.platform),
+        chip=str(getattr(_dev, "device_kind", "") or ""))
+
     target = 2000.0
     print(json.dumps({
         "metric": f"output throughput ({model}, {quant or 'bf16'}, "
                   f"{num_seqs} concurrent, "
                   f"{prompt_len}p/{out_len}o, 1 chip)",
         "status": "ok",
+        "ts": time.time(),
+        "fingerprint": bench_fp,
         "value": round(tok_per_s, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_per_s / target, 3),
@@ -874,6 +889,45 @@ def _pool_state() -> dict:
     return state
 
 
+def _publish_artifact(artifact: dict) -> dict:
+    """Join this run into the shared perf ledger
+    (production_stack_tpu/perf_ledger.py; path env ``PSTPU_PERF_LEDGER``,
+    empty string disables): stamp a degraded fingerprint when the child
+    never reported one (infra failure before backend init), embed the
+    cohort's last-known-good marks BEFORE appending — so a pool outage
+    reads as a STALE trajectory with a dated baseline instead of a
+    missing one — then append the run in the shared schema. Best-effort:
+    ledger trouble never breaks the driver contract (the JSON line).
+    The import is jax-free by design (parent never initialises a
+    backend)."""
+    path = os.environ.get("PSTPU_PERF_LEDGER", "perf_ledger.jsonl")
+    if not path:
+        return artifact
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from production_stack_tpu import perf_ledger as pl
+
+        fp = artifact.get("fingerprint") or pl.fingerprint(
+            quantization=os.environ.get("PSTPU_BENCH_QUANT", "int8") or "")
+        artifact.setdefault("fingerprint", fp)
+        records, _ = pl.read_records(path)
+        good = pl.last_known_good(records, pl.fingerprint_id(fp))
+        artifact["last_known_good"] = None if good is None else {
+            "ts": good.get("ts"),
+            "kind": good.get("kind"),
+            "age_s": round(time.time() - float(good.get("ts") or 0), 1),
+            "marks": good.get("marks") or {},
+        }
+        artifact["trajectory"] = (
+            "fresh" if artifact.get("status") == "ok"
+            else "stale" if good is not None else "gone")
+        pl.PerfLedger(path).append_bench(time.time(), fp, artifact)
+    except Exception as e:
+        print(f"perf-ledger publish failed ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+    return artifact
+
+
 def _run_child(ready_timeout: float, timeout: float) -> tuple[dict | None, str]:
     """Run the benchmark in ONE child; return (parsed JSON line, diag).
 
@@ -976,6 +1030,8 @@ def main() -> None:
     min_attempts = int(os.environ.get("PSTPU_BENCH_ATTEMPTS", "3"))
     errors: list[str] = []
     start = time.monotonic()
+    attempt = 0
+    wedged = True  # only wedge-shaped failures extend into the budget
 
     # the artifact must exist even if the DRIVER's watchdog terminates
     # this parent mid-claim-budget: flush the diagnostics-so-far as the
@@ -983,7 +1039,7 @@ def main() -> None:
     import signal
 
     def _flush_artifact(signum, frame):
-        print(json.dumps({
+        print(json.dumps(_publish_artifact({
             "metric": "output throughput (backend unavailable)",
             "status": "infra_failure",
             "failure_class": "terminated-mid-claim",
@@ -992,15 +1048,14 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": (" | ".join(errors) or "claim loop still waiting")
             + f" (terminated by signal {signum} mid-claim-budget)",
+            "attempts": attempt,
             "claim_window_s": round(time.monotonic() - start, 1),
             "pool_state": _pool_state(),
-        }), flush=True)
+        })), flush=True)
         raise SystemExit(0)
 
     signal.signal(signal.SIGTERM, _flush_artifact)
     signal.signal(signal.SIGINT, _flush_artifact)
-    attempt = 0
-    wedged = True  # only wedge-shaped failures extend into the budget
     while True:
         if attempt:
             # a deterministic child failure (import error, bad config —
@@ -1024,7 +1079,7 @@ def main() -> None:
         reaped = _reap_stale_holders()
         result, diag = _run_child(probe_timeout, bench_timeout)
         if result is not None:
-            print(json.dumps(result))
+            print(json.dumps(_publish_artifact(result)))
             return
         wedged = "BACKEND-READY" in diag or "backend init" in diag
         if wedged:
@@ -1042,7 +1097,7 @@ def main() -> None:
     uniq: dict[str, int] = {}
     for e in errors:
         uniq[e] = uniq.get(e, 0) + 1
-    print(json.dumps({
+    print(json.dumps(_publish_artifact({
         "metric": "output throughput (backend unavailable)",
         "status": "infra_failure",
         "failure_class": _failure_class(errors),
@@ -1054,7 +1109,7 @@ def main() -> None:
         "attempts": attempt,
         "claim_window_s": round(time.monotonic() - start, 1),
         "pool_state": _pool_state(),
-    }))
+    })))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""perf_ci_gate — pin the CPU-stable perf invariants of a ledger run.
+
+Wall-clock marks (tok/s, MFU) are hardware-shaped: green on TPU,
+meaningless noise on the CPU chart CI runs. This gate pins what IS
+stable on any backend (docs/observability.md "Perf ledger & cost-model
+drift"), so the perf plane has an enforceable CI check that is green on
+CPU and still meaningful on TPU:
+
+* ``unexpected_recompiles == 0`` in every engine record — a shape that
+  leaked past warmup fails the gate wherever it runs;
+* ``ragged_stream_utilization`` of the run's final snapshot inside a
+  band (the scheduler packing the same workload must fill the stream
+  the same way, CPU or TPU);
+* with TWO ledgers (same workload, two builds): scheduled-token
+  IDENTITY per cohort — prompt/generation token totals, ragged
+  dispatch and live-token counts must match exactly. Scheduling is
+  host-side and deterministic; a drifted count is a behavior change,
+  not noise.
+
+Exit codes: 0 = gate passes, 2 = violation, 1 = usage error.
+
+Examples:
+    perf_ci_gate.py run.jsonl
+    perf_ci_gate.py run.jsonl --util-band 0.05,1.0
+    perf_ci_gate.py before.jsonl after.jsonl --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from production_stack_tpu import perf_ledger as pl  # noqa: E402
+
+IDENTITY_MARKS = ("prompt_tokens_total", "generation_tokens_total",
+                  "ragged_dispatches_total", "ragged_live_tokens_total")
+
+
+def _engine_records(path: str) -> Dict[str, List[dict]]:
+    records, _ = pl.read_records(path, include_backups=False)
+    cohorts = pl.group_by_cohort(
+        [r for r in records if r.get("kind") == pl.ENGINE_KIND])
+    if not cohorts:
+        raise SystemExit(f"perf_ci_gate: no engine records in {path}")
+    return cohorts
+
+
+def check_ledger(cohorts: Dict[str, List[dict]], util_lo: float,
+                 util_hi: float) -> List[dict]:
+    violations: List[dict] = []
+    for fpid, recs in sorted(cohorts.items()):
+        for rec in recs:
+            n = rec.get("marks", {}).get("unexpected_recompiles", 0)
+            if n:
+                violations.append({
+                    "check": "unexpected_recompiles", "cohort": fpid,
+                    "value": n, "want": 0,
+                    "detail": f"{n} recompile(s) after steady state",
+                })
+                break
+        final = recs[-1].get("marks", {})
+        util = final.get("ragged_stream_utilization")
+        if util is not None and final.get("ragged_dispatches_total", 0):
+            if not util_lo <= util <= util_hi:
+                violations.append({
+                    "check": "ragged_stream_utilization", "cohort": fpid,
+                    "value": util, "want": [util_lo, util_hi],
+                    "detail": "final stream utilization outside band",
+                })
+    return violations
+
+
+def check_identity(a: Dict[str, List[dict]],
+                   b: Dict[str, List[dict]]) -> List[dict]:
+    violations: List[dict] = []
+    for fpid in sorted(set(a) & set(b)):
+        ma, mb = a[fpid][-1].get("marks", {}), b[fpid][-1].get("marks", {})
+        for mark in IDENTITY_MARKS:
+            va, vb = ma.get(mark), mb.get(mark)
+            if va is None or vb is None:
+                continue
+            if va != vb:
+                violations.append({
+                    "check": "scheduled_identity", "cohort": fpid,
+                    "metric": mark, "value": [va, vb],
+                    "detail": f"{mark}: {va} != {vb} between segments",
+                })
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_ci_gate",
+        description="pin CPU-stable perf invariants of a ledger run "
+                    "(rc 2 on violation)")
+    ap.add_argument("ledger", help="perf-ledger JSONL")
+    ap.add_argument("ledger2", nargs="?", default="",
+                    help="second ledger: enables scheduled-token "
+                         "identity checks between the two segments")
+    ap.add_argument("--util-band", default="0.01,1.0",
+                    metavar="LO,HI",
+                    help="accepted ragged_stream_utilization range for "
+                         "the final snapshot (default 0.01,1.0)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    try:
+        lo, hi = (float(x) for x in args.util_band.split(","))
+    except ValueError:
+        raise SystemExit(f"perf_ci_gate: bad --util-band {args.util_band!r}")
+
+    cohorts = _engine_records(args.ledger)
+    violations = check_ledger(cohorts, lo, hi)
+    if args.ledger2:
+        cohorts2 = _engine_records(args.ledger2)
+        violations += check_ledger(cohorts2, lo, hi)
+        violations += check_identity(cohorts, cohorts2)
+
+    doc = {"ledger": args.ledger, "ledger2": args.ledger2 or None,
+           "cohorts": sorted(cohorts), "violations": violations,
+           "pass": not violations}
+    if args.as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for v in violations:
+            print(f"perf_ci_gate: FAIL [{v['check']}] cohort "
+                  f"{v['cohort']}: {v['detail']}")
+        print("perf_ci_gate: "
+              + ("PASS" if not violations else
+                 f"{len(violations)} violation(s)"))
+    return 0 if not violations else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""perfdiff — compare two perf-ledger segments (or bench artifacts)
+cohort by cohort and fail loudly on regression.
+
+The perf ledger (production_stack_tpu/perf_ledger.py, documented in
+docs/observability.md "Perf ledger & cost-model drift") stamps every
+record with a config fingerprint; this tool only ever compares marks
+WITHIN a cohort — a tok/s delta between different configs is a config
+change, not a regression. Inputs may be:
+
+* a JSONL ledger file (engine snapshots and/or bench records), or
+* a single-JSON bench artifact (bench.py output) — converted to one
+  ledger record on the fly.
+
+Each cohort side reduces to the mean of every numeric mark (nested
+marks flatten to dotted keys, e.g. ``costmodel_drift_ratio.decode``);
+the registry below says which direction is good and how much relative
+slack each metric gets before the verdict flips.
+
+Exit codes: 0 = no regression, 2 = regression in at least one shared
+cohort, 1 = usage error (unreadable input, no comparable cohorts).
+
+Examples:
+    perfdiff.py baseline.jsonl candidate.jsonl
+    perfdiff.py baseline.jsonl candidate.jsonl --json
+    perfdiff.py base.jsonl cand.jsonl --threshold decode_tps=0.25
+    perfdiff.py base.jsonl cand.jsonl --promote baseline.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from production_stack_tpu import perf_ledger as pl  # noqa: E402
+
+# metric -> (direction, default relative threshold). direction "higher"
+# = bigger is better; "lower" = bigger is worse. A candidate outside
+# baseline*(1 -/+ threshold) in the bad direction is a regression.
+# Drift ratios get wide slack: they are noisy gauges whose *band*
+# enforcement lives in the engine — perfdiff only catches gross drift
+# between segments (the e2e drill's x50 inflation, not jitter).
+METRICS: Dict[str, Tuple[str, float]] = {
+    "value_tok_s_chip": ("higher", 0.05),
+    "mfu": ("higher", 0.10),
+    "prefill_tps": ("higher", 0.15),
+    "decode_tps": ("higher", 0.15),
+    "ragged_stream_utilization": ("higher", 0.10),
+    "costmodel_drift_ratio.prefill": ("lower", 1.0),
+    "costmodel_drift_ratio.decode": ("lower", 1.0),
+    "costmodel_episodes": ("lower", 0.0),
+    "unexpected_recompiles": ("lower", 0.0),
+}
+
+
+def load_side(path: str) -> Tuple[List[dict], int]:
+    """Load one comparison side: JSONL ledger or single-JSON artifact."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            head = f.read(1 << 20)
+    except OSError as e:
+        raise SystemExit(f"perfdiff: cannot read {path}: {e}")
+    head_stripped = head.lstrip()
+    if head_stripped.startswith("{") and "\n{" not in head_stripped:
+        try:
+            artifact = json.loads(head)
+        except ValueError as e:
+            raise SystemExit(f"perfdiff: {path} is not valid JSON: {e}")
+        if "kind" not in artifact:
+            # single-JSON bench artifact → one synthetic ledger record
+            # (a one-line ledger is NOT an artifact: its record already
+            # carries kind/marks and falls through to read_records)
+            fp = artifact.get("fingerprint") or pl.fingerprint()
+            return [pl.bench_record(float(artifact.get("ts", 0.0)), fp,
+                                    artifact)], 0
+    records, skipped = pl.read_records(path, include_backups=False)
+    return records, skipped
+
+
+def _flatten(marks: dict, prefix: str = "") -> Dict[str, float]:
+    flat: Dict[str, float] = {}
+    for key, value in (marks or {}).items():
+        name = f"{prefix}{key}"
+        if isinstance(value, dict):
+            flat.update(_flatten(value, f"{name}."))
+        elif isinstance(value, bool):
+            continue
+        elif isinstance(value, (int, float)):
+            flat[name] = float(value)
+    return flat
+
+
+def reduce_cohort(records: List[dict]) -> Dict[str, float]:
+    """Mean of every numeric mark across a cohort's records."""
+    sums: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for rec in records:
+        if rec.get("kind") == pl.BENCH_KIND and rec.get("status") != "ok":
+            continue  # infra failures carry no marks worth averaging
+        for name, value in _flatten(rec.get("marks") or {}).items():
+            sums[name] = sums.get(name, 0.0) + value
+            counts[name] = counts.get(name, 0) + 1
+    return {name: sums[name] / counts[name] for name in sums}
+
+
+def judge(base: Dict[str, float], cand: Dict[str, float],
+          thresholds: Dict[str, float]) -> List[dict]:
+    rows: List[dict] = []
+    for metric, (direction, default_thr) in sorted(METRICS.items()):
+        if metric not in base or metric not in cand:
+            continue
+        thr = thresholds.get(metric, default_thr)
+        b, c = base[metric], cand[metric]
+        if b == 0.0:
+            # no baseline signal: only a lower-is-better metric that
+            # appears from zero is judged (e.g. recompiles 0 -> 3)
+            regression = direction == "lower" and c > thr
+        elif direction == "higher":
+            regression = c < b * (1.0 - thr)
+        else:
+            regression = c > b * (1.0 + thr)
+        change = (c - b) / b if b else None
+        rows.append({
+            "metric": metric, "direction": direction,
+            "baseline": b, "candidate": c,
+            "change": change, "threshold": thr,
+            "regression": bool(regression),
+        })
+    return rows
+
+
+def parse_thresholds(items: List[str]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for item in items or []:
+        metric, sep, value = item.partition("=")
+        if not sep or metric not in METRICS:
+            raise SystemExit(
+                f"perfdiff: bad --threshold {item!r} "
+                f"(want metric=frac, metric one of {sorted(METRICS)})")
+        try:
+            out[metric] = float(value)
+        except ValueError:
+            raise SystemExit(f"perfdiff: bad --threshold value {value!r}")
+    return out
+
+
+def diff(baseline_path: str, candidate_path: str,
+         thresholds: Optional[Dict[str, float]] = None) -> dict:
+    """The comparison document (importable entry point for tests)."""
+    base_records, base_skipped = load_side(baseline_path)
+    cand_records, cand_skipped = load_side(candidate_path)
+    base_cohorts = pl.group_by_cohort(base_records)
+    cand_cohorts = pl.group_by_cohort(cand_records)
+    shared = sorted(set(base_cohorts) & set(cand_cohorts))
+    cohorts = {}
+    for fpid in shared:
+        rows = judge(reduce_cohort(base_cohorts[fpid]),
+                     reduce_cohort(cand_cohorts[fpid]),
+                     thresholds or {})
+        sample = (cand_cohorts[fpid][-1].get("fingerprint")
+                  or base_cohorts[fpid][-1].get("fingerprint") or {})
+        cohorts[fpid] = {
+            "fingerprint": sample,
+            "baseline_records": len(base_cohorts[fpid]),
+            "candidate_records": len(cand_cohorts[fpid]),
+            "metrics": rows,
+            "regressions": [r["metric"] for r in rows if r["regression"]],
+        }
+    return {
+        "baseline": baseline_path,
+        "candidate": candidate_path,
+        "skipped_lines": {"baseline": base_skipped,
+                          "candidate": cand_skipped},
+        "cohorts_compared": shared,
+        "cohorts_baseline_only": sorted(set(base_cohorts) - set(cand_cohorts)),
+        "cohorts_candidate_only": sorted(set(cand_cohorts) - set(base_cohorts)),
+        "cohorts": cohorts,
+        "regression": any(c["regressions"] for c in cohorts.values()),
+    }
+
+
+def render(doc: dict) -> str:
+    lines = [f"perfdiff: {doc['baseline']} -> {doc['candidate']}"]
+    if not doc["cohorts_compared"]:
+        lines.append("  no shared cohorts to compare")
+    for fpid, block in sorted(doc["cohorts"].items()):
+        fp = block["fingerprint"]
+        label = ":".join(str(fp.get(k, "?")) for k in
+                         ("model", "platform", "attention_impl")) or fpid
+        lines.append(f"  cohort {fpid} ({label}) — "
+                     f"{block['baseline_records']} vs "
+                     f"{block['candidate_records']} record(s)")
+        for row in block["metrics"]:
+            mark = "REGRESSION" if row["regression"] else "ok"
+            change = ("" if row["change"] is None
+                      else f" ({row['change']:+.1%})")
+            lines.append(
+                f"    {row['metric']:<34} {row['baseline']:>12.4g} -> "
+                f"{row['candidate']:>12.4g}{change}  [{mark}]")
+    for key, label in (("cohorts_baseline_only", "baseline-only"),
+                       ("cohorts_candidate_only", "candidate-only")):
+        if doc[key]:
+            lines.append(f"  {label} cohorts (not compared): "
+                         + ", ".join(doc[key]))
+    lines.append("RESULT: "
+                 + ("REGRESSION" if doc["regression"] else "no regression"))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perfdiff",
+        description="compare two perf-ledger segments or bench artifacts "
+                    "cohort by cohort (rc 2 on regression)")
+    ap.add_argument("baseline", help="baseline ledger JSONL or bench JSON")
+    ap.add_argument("candidate", help="candidate ledger JSONL or bench JSON")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full comparison document as JSON")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="override a metric's relative slack "
+                         "(repeatable)")
+    ap.add_argument("--promote", default="",
+                    metavar="PATH",
+                    help="on success (rc 0), copy the candidate file to "
+                         "PATH — baseline promotion for CI")
+    args = ap.parse_args(argv)
+    thresholds = parse_thresholds(args.threshold)
+    doc = diff(args.baseline, args.candidate, thresholds)
+    if args.as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(render(doc))
+    if not doc["cohorts_compared"]:
+        print("perfdiff: no comparable cohorts (fingerprints disjoint?)",
+              file=sys.stderr)
+        return 1
+    if doc["regression"]:
+        return 2
+    if args.promote:
+        shutil.copyfile(args.candidate, args.promote)
+        print(f"perfdiff: promoted {args.candidate} -> {args.promote}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
